@@ -1,0 +1,22 @@
+"""dynamo-tpu: TPU-native distributed LLM inference-serving framework.
+
+A ground-up rebuild of the capabilities of NVIDIA Dynamo (the reference
+distributed inference stack) designed TPU-first:
+
+- OpenAI-compatible HTTP frontend with SSE streaming.
+- Distributed runtime: namespace/component/endpoint discovery with
+  lease-based liveness, push RPC, streamed responses over TCP — backed by a
+  native C++ control-plane server (the etcd+NATS-equivalent).
+- KV-cache-aware routing over a global prefix radix tree.
+- Disaggregated prefill/decode with worker-to-worker KV-block migration
+  (ICI within a slice, host-staged DCN across slices).
+- Multi-tier KV block manager (HBM -> host DRAM -> SSD).
+- A real JAX/XLA engine: continuous batching over a paged KV cache held as
+  a sharded HBM tensor, pjit/GSPMD tensor parallelism over the ICI mesh,
+  Pallas paged-attention kernels, on-device sampling.
+- SLA/load planner that autoscales workers.
+
+Layer map mirrors SURVEY.md section 1 (reference layers L0-L7).
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,19 @@
+"""dynamo-tpu CLI entrypoint (``dynamo-tpu run in=<input> out=<engine>``).
+
+Mirrors the reference's launcher surface (launch/dynamo-run/src/main.rs);
+subcommands are filled in as the corresponding subsystems land.
+"""
+from __future__ import annotations
+
+import sys
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    from dynamo_tpu.launch.run import run_cli  # deferred: pulls in jax
+
+    return run_cli(argv)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,153 @@
+"""Token sequences and chained block hashing.
+
+The KV router, the engine's paged-KV block registry, and the multi-tier block
+manager all identify a block of `block_size` tokens by a *chained* content
+hash: `hash(block) = xxh3_64(parent_hash || token_bytes, seed=1337)`. The
+chain makes a block hash identify the entire prefix ending at that block, so
+equal hashes imply an identical prefix — the property prefix-cache routing
+relies on.
+
+Design parity with the reference's token layer (lib/llm/src/tokens.rs:315-318
+chained sequence_hash; tokens.rs:394 TokenBlock; tokens.rs:480
+TokenBlockSequence; kv_router.rs:178-184 split for routing), re-implemented
+from scratch. Hash consistency is *internal* (router <-> engine <-> KVBM), so
+every component in this repo must go through this module — never hash tokens
+ad hoc.
+"""
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass, field
+from typing import Iterable, Optional, Sequence
+
+import numpy as np
+import xxhash
+
+HASH_SEED = 1337
+# Hash value used as the parent of the first block in a sequence (optionally
+# replaced by a salt hash when multiple models share one control plane).
+NO_PARENT = 0
+
+
+def hash_tokens(tokens: Sequence[int], parent: int = NO_PARENT, seed: int = HASH_SEED) -> int:
+    """Chained content hash of one block of tokens."""
+    data = struct.pack("<Q", parent) + np.asarray(tokens, dtype=np.dtype("<u4")).tobytes()
+    return xxhash.xxh3_64_intdigest(data, seed=seed)
+
+
+def salt_hash(salt: str) -> int:
+    """Root parent hash for a (model, lora, ...) namespace salt."""
+    if not salt:
+        return NO_PARENT
+    return xxhash.xxh3_64_intdigest(salt.encode("utf-8"), seed=HASH_SEED)
+
+
+def compute_block_hashes(
+    tokens: Sequence[int], block_size: int, salt: str = ""
+) -> list[int]:
+    """Hashes of all *complete* blocks of a token sequence.
+
+    This is the router-side entry point (reference kv_router.rs:178-184):
+    the trailing partial block is not hashed because it cannot be cached.
+    """
+    parent = salt_hash(salt)
+    out: list[int] = []
+    for start in range(0, len(tokens) - len(tokens) % block_size, block_size):
+        parent = hash_tokens(tokens[start : start + block_size], parent)
+        out.append(parent)
+    return out
+
+
+@dataclass(frozen=True)
+class TokenBlock:
+    """An immutable, complete block of `block_size` tokens plus its chain hash."""
+
+    tokens: tuple[int, ...]
+    block_hash: int
+    parent_hash: int
+    position: int  # block index within the sequence
+
+
+@dataclass
+class TokenBlockSequence:
+    """A growing token sequence chunked into hash-chained blocks.
+
+    Used by the engine to track per-request token state: complete blocks are
+    eligible for registration in the reuse pool / publication as KV events;
+    the partial tail is not.
+    """
+
+    block_size: int
+    salt: str = ""
+    blocks: list[TokenBlock] = field(default_factory=list)
+    partial: list[int] = field(default_factory=list)
+
+    def __post_init__(self):
+        if self.block_size <= 0:
+            raise ValueError("block_size must be positive")
+
+    @classmethod
+    def from_tokens(
+        cls, tokens: Iterable[int], block_size: int, salt: str = ""
+    ) -> "TokenBlockSequence":
+        seq = cls(block_size=block_size, salt=salt)
+        seq.extend(tokens)
+        return seq
+
+    @property
+    def total_tokens(self) -> int:
+        return len(self.blocks) * self.block_size + len(self.partial)
+
+    @property
+    def tokens(self) -> list[int]:
+        out: list[int] = []
+        for b in self.blocks:
+            out.extend(b.tokens)
+        out.extend(self.partial)
+        return out
+
+    @property
+    def last_hash(self) -> int:
+        return self.blocks[-1].block_hash if self.blocks else salt_hash(self.salt)
+
+    def block_hashes(self) -> list[int]:
+        return [b.block_hash for b in self.blocks]
+
+    def append(self, token: int) -> Optional[TokenBlock]:
+        """Append one token; returns the newly completed block, if any."""
+        self.partial.append(int(token))
+        if len(self.partial) == self.block_size:
+            return self._seal()
+        return None
+
+    def extend(self, tokens: Iterable[int]) -> list[TokenBlock]:
+        """Append many tokens; returns all newly completed blocks."""
+        new_blocks: list[TokenBlock] = []
+        for t in tokens:
+            b = self.append(t)
+            if b is not None:
+                new_blocks.append(b)
+        return new_blocks
+
+    def _seal(self) -> TokenBlock:
+        parent = self.last_hash
+        blk = TokenBlock(
+            tokens=tuple(self.partial),
+            block_hash=hash_tokens(self.partial, parent),
+            parent_hash=parent,
+            position=len(self.blocks),
+        )
+        self.blocks.append(blk)
+        self.partial = []
+        return blk
+
+    def truncate(self, num_tokens: int) -> None:
+        """Drop tokens beyond `num_tokens` (used on preemption/restart)."""
+        if num_tokens >= self.total_tokens:
+            return
+        keep_blocks, rem = divmod(num_tokens, self.block_size)
+        if keep_blocks < len(self.blocks):
+            self.partial = list(self.blocks[keep_blocks].tokens[:rem])
+        else:
+            self.partial = self.partial[:rem]
+        self.blocks = self.blocks[:keep_blocks]

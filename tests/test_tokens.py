@@ -1,0 +1,69 @@
+"""Tests for the chained block-hash token layer (mirrors reference tokens.rs tests)."""
+import random
+
+from dynamo_tpu.tokens import (
+    NO_PARENT,
+    TokenBlockSequence,
+    compute_block_hashes,
+    hash_tokens,
+    salt_hash,
+)
+
+
+def test_hash_determinism_and_chaining():
+    a = hash_tokens([1, 2, 3, 4])
+    assert a == hash_tokens([1, 2, 3, 4])
+    assert a != hash_tokens([1, 2, 3, 5])
+    # chaining: same tokens, different parent -> different hash
+    assert hash_tokens([1, 2, 3, 4], parent=a) != a
+
+
+def test_compute_block_hashes_ignores_partial_tail():
+    toks = list(range(10))
+    h4 = compute_block_hashes(toks, block_size=4)
+    assert len(h4) == 2  # 10 tokens -> 2 complete blocks of 4, tail of 2 dropped
+    # prefix property: first block hash equal across longer sequences
+    h4b = compute_block_hashes(list(range(12)), block_size=4)
+    assert h4b[:2] == h4
+    assert len(h4b) == 3
+
+
+def test_sequence_incremental_matches_batch():
+    random.seed(0)
+    toks = [random.randrange(32000) for _ in range(133)]
+    seq = TokenBlockSequence(block_size=16)
+    completed = seq.extend(toks)
+    assert [b.block_hash for b in completed] == seq.block_hashes()
+    assert seq.block_hashes() == compute_block_hashes(toks, 16)
+    assert seq.total_tokens == 133
+    assert len(seq.partial) == 133 % 16
+    assert seq.tokens == toks
+
+
+def test_salt_separates_models():
+    toks = list(range(32))
+    assert compute_block_hashes(toks, 16, salt="model-a") != compute_block_hashes(
+        toks, 16, salt="model-b"
+    )
+    assert salt_hash("") == NO_PARENT
+
+
+def test_truncate():
+    toks = list(range(100))
+    seq = TokenBlockSequence.from_tokens(toks, 16)
+    seq.truncate(40)
+    assert seq.total_tokens == 40
+    assert seq.tokens == toks[:40]
+    assert seq.block_hashes() == compute_block_hashes(toks[:40], 16)
+    # re-extending reproduces the original chain
+    seq.extend(toks[40:])
+    assert seq.block_hashes() == compute_block_hashes(toks, 16)
+
+
+def test_append_returns_block_on_boundary():
+    seq = TokenBlockSequence(block_size=4)
+    assert seq.append(1) is None
+    assert seq.append(2) is None
+    assert seq.append(3) is None
+    blk = seq.append(4)
+    assert blk is not None and blk.position == 0 and blk.parent_hash == NO_PARENT
