@@ -10,7 +10,11 @@ import sys
 
 def main(argv: list[str] | None = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
-    from dynamo_tpu.launch.run import run_cli  # deferred: pulls in jax
+    try:
+        from dynamo_tpu.launch.run import run_cli  # deferred: pulls in jax
+    except ImportError as e:
+        print(f"dynamo-tpu: launcher not available ({e})", file=sys.stderr)
+        return 2
 
     return run_cli(argv)
 

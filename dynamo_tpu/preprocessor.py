@@ -1,0 +1,175 @@
+"""OpenAI request preprocessing: chat template + tokenization.
+
+Turns a validated OpenAI request into a `PreprocessedRequest` for the engine:
+apply model defaults, render the chat template (jinja2, HF
+`tokenizer_config.json` `chat_template`), tokenize, and attach stop/sampling
+options. Mirrors the reference OpenAIPreprocessor
+(lib/llm/src/preprocessor.rs:104; template rendering
+preprocessor/prompt/template/tokcfg.rs).
+"""
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Any, Optional, Union
+
+from dynamo_tpu.protocols.common import PreprocessedRequest
+from dynamo_tpu.protocols.openai import ChatCompletionRequest, CompletionRequest
+from dynamo_tpu.tokenizer import Tokenizer
+
+DEFAULT_CHAT_TEMPLATE = (
+    "{% for message in messages %}"
+    "<|{{ message.role }}|>\n{{ message.content }}\n"
+    "{% endfor %}"
+    "{% if add_generation_prompt %}<|assistant|>\n{% endif %}"
+)
+
+
+@dataclass
+class PromptFormatter:
+    """Renders OpenAI `messages` into a prompt string via a jinja2 template."""
+
+    template: str = DEFAULT_CHAT_TEMPLATE
+    bos_token: str = ""
+    eos_token: str = ""
+
+    @classmethod
+    def from_dir(cls, path: str) -> "PromptFormatter":
+        tc = os.path.join(path, "tokenizer_config.json")
+        template, bos, eos = DEFAULT_CHAT_TEMPLATE, "", ""
+        if os.path.exists(tc):
+            with open(tc) as f:
+                cfg = json.load(f)
+            t = cfg.get("chat_template")
+            if isinstance(t, list):  # multi-template form: pick "default"
+                t = next((e.get("template") for e in t if e.get("name") == "default"), None)
+            if isinstance(t, str):
+                template = t
+            for name, var in (("bos_token", "bos"), ("eos_token", "eos")):
+                v = cfg.get(name)
+                if isinstance(v, dict):
+                    v = v.get("content")
+                if name == "bos_token":
+                    bos = v or ""
+                else:
+                    eos = v or ""
+        return cls(template=template, bos_token=bos, eos_token=eos)
+
+    def _compiled(self):
+        # compile once per formatter; render() is on the per-request hot path
+        tpl = getattr(self, "_tpl", None)
+        if tpl is None:
+            import jinja2
+
+            env = jinja2.Environment(
+                loader=jinja2.BaseLoader(), trim_blocks=True, lstrip_blocks=True
+            )
+            env.globals["raise_exception"] = _raise_exception
+            env.filters["tojson"] = lambda v, **kw: json.dumps(v, **kw)
+            tpl = self._tpl = env.from_string(self.template)
+        return tpl
+
+    def render(
+        self,
+        messages: list[dict[str, Any]],
+        *,
+        tools: Optional[list[dict[str, Any]]] = None,
+        add_generation_prompt: bool = True,
+        **extra: Any,
+    ) -> str:
+        ctx = {
+            "messages": messages,
+            "tools": tools,
+            "add_generation_prompt": add_generation_prompt,
+            "bos_token": self.bos_token,
+            "eos_token": self.eos_token,
+        }
+        # user-supplied chat_template_args must not shadow the core context
+        ctx.update({k: v for k, v in extra.items() if k not in ("messages",)})
+        ctx["messages"] = messages
+        return self._compiled().render(**ctx)
+
+
+def _raise_exception(msg: str):
+    raise ValueError(msg)
+
+
+def _flatten_content(content: Union[str, list, None]) -> str:
+    """OpenAI content may be a list of typed parts; keep the text parts."""
+    if content is None:
+        return ""
+    if isinstance(content, str):
+        return content
+    parts = []
+    for p in content:
+        if isinstance(p, dict) and p.get("type") == "text":
+            parts.append(p.get("text", ""))
+    return "".join(parts)
+
+
+@dataclass
+class OpenAIPreprocessor:
+    """model defaults + template + tokenize -> PreprocessedRequest."""
+
+    tokenizer: Tokenizer
+    formatter: PromptFormatter = field(default_factory=PromptFormatter)
+    model_name: str = ""
+    default_max_tokens: Optional[int] = None
+    context_length: Optional[int] = None
+
+    def preprocess_chat(self, req: ChatCompletionRequest) -> PreprocessedRequest:
+        messages = [
+            {
+                "role": m.role,
+                "content": _flatten_content(m.content),
+                **({"tool_calls": m.tool_calls} if m.tool_calls else {}),
+                **({"tool_call_id": m.tool_call_id} if m.tool_call_id else {}),
+                **({"name": m.name} if m.name else {}),
+            }
+            for m in req.messages
+        ]
+        prompt = self.formatter.render(
+            messages, tools=req.tools, **(req.chat_template_args or {})
+        )
+        token_ids = self.tokenizer.encode(prompt)
+        return self._finish(req, token_ids, formatted_prompt=prompt)
+
+    def preprocess_completion(self, req: CompletionRequest) -> PreprocessedRequest:
+        p = req.prompt
+        if isinstance(p, str):
+            token_ids = self.tokenizer.encode(p)
+        elif p and isinstance(p[0], int):
+            token_ids = list(p)  # pre-tokenized
+        elif p and isinstance(p[0], str):
+            if len(p) != 1:
+                raise ValueError("batch prompts not supported on this endpoint")
+            token_ids = self.tokenizer.encode(p[0])
+        elif p and isinstance(p[0], list):
+            if len(p) != 1:
+                raise ValueError("batch prompts not supported on this endpoint")
+            token_ids = list(p[0])
+        else:
+            raise ValueError("empty prompt")
+        return self._finish(req, token_ids)
+
+    def _finish(self, req, token_ids: list[int], formatted_prompt: Optional[str] = None) -> PreprocessedRequest:
+        if self.context_length and len(token_ids) >= self.context_length:
+            raise ValueError(
+                f"prompt length {len(token_ids)} exceeds context length {self.context_length}"
+            )
+        stop = req.to_stop_conditions(self.default_max_tokens)
+        stop.stop_token_ids = list(
+            dict.fromkeys(list(stop.stop_token_ids) + list(self.tokenizer.eos_token_ids))
+        )
+        pre = PreprocessedRequest(
+            token_ids=token_ids,
+            model=req.model or self.model_name,
+            stop_conditions=stop,
+            sampling_options=req.to_sampling(),
+            output_options=req.to_output_options(),
+        )
+        nvext = req.nvext or {}
+        if nvext.get("annotations"):
+            pre.annotations = list(nvext["annotations"])
+        return pre

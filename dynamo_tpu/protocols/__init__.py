@@ -1,0 +1,22 @@
+"""Wire protocols: internal engine request/response types and OpenAI-compatible
+HTTP types with SSE streaming.
+
+Mirrors the reference's protocol layer (lib/llm/src/protocols/: common.rs
+StopConditions/SamplingOptions, openai/* request/response types, codec.rs SSE)
+re-designed as plain Python dataclasses + pydantic validation.
+"""
+from dynamo_tpu.protocols.common import (
+    FinishReason,
+    LLMEngineOutput,
+    PreprocessedRequest,
+    SamplingOptions,
+    StopConditions,
+)
+
+__all__ = [
+    "FinishReason",
+    "LLMEngineOutput",
+    "PreprocessedRequest",
+    "SamplingOptions",
+    "StopConditions",
+]

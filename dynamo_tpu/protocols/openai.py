@@ -1,0 +1,239 @@
+"""OpenAI-compatible HTTP API types (chat completions, completions, models).
+
+Pydantic models for request validation plus plain dict builders for
+responses. Mirrors the reference's protocol surface
+(lib/llm/src/protocols/openai/*: request types, validate.rs bounds,
+chat_completions/delta.rs DeltaGenerator) — re-derived from the public
+OpenAI API shape, not translated.
+"""
+from __future__ import annotations
+
+import time
+import uuid
+from typing import Any, Literal, Optional, Union
+
+from pydantic import BaseModel, Field, field_validator
+
+from dynamo_tpu.protocols.common import (
+    FinishReason,
+    OutputOptions,
+    SamplingOptions,
+    StopConditions,
+)
+
+
+class ChatMessage(BaseModel):
+    role: str
+    content: Union[str, list[dict[str, Any]], None] = None
+    name: Optional[str] = None
+    tool_calls: Optional[list[dict[str, Any]]] = None
+    tool_call_id: Optional[str] = None
+
+
+class StreamOptions(BaseModel):
+    include_usage: bool = False
+
+
+class _CommonRequest(BaseModel):
+    model: str
+    stream: bool = False
+    stream_options: Optional[StreamOptions] = None
+    max_tokens: Optional[int] = Field(default=None, ge=1)
+    max_completion_tokens: Optional[int] = Field(default=None, ge=1)
+    temperature: Optional[float] = Field(default=None, ge=0.0, le=2.0)
+    top_p: Optional[float] = Field(default=None, gt=0.0, le=1.0)
+    top_k: Optional[int] = Field(default=None, ge=-1)
+    frequency_penalty: Optional[float] = Field(default=None, ge=-2.0, le=2.0)
+    presence_penalty: Optional[float] = Field(default=None, ge=-2.0, le=2.0)
+    repetition_penalty: Optional[float] = Field(default=None, gt=0.0)
+    stop: Union[str, list[str], None] = None
+    seed: Optional[int] = None
+    n: int = Field(default=1, ge=1, le=8)
+    logprobs: Union[bool, int, None] = None
+    top_logprobs: Optional[int] = Field(default=None, ge=0, le=20)
+    user: Optional[str] = None
+    # dynamo extensions (reference nvext): per-request annotations & routing hints
+    nvext: Optional[dict[str, Any]] = None
+
+    @field_validator("stop")
+    @classmethod
+    def _cap_stops(cls, v):
+        if isinstance(v, list) and len(v) > 8:
+            raise ValueError("at most 8 stop sequences")
+        return v
+
+    def stop_list(self) -> list[str]:
+        if self.stop is None:
+            return []
+        return [self.stop] if isinstance(self.stop, str) else list(self.stop)
+
+    def to_sampling(self) -> SamplingOptions:
+        return SamplingOptions(
+            temperature=self.temperature,
+            top_p=self.top_p,
+            top_k=self.top_k,
+            frequency_penalty=self.frequency_penalty,
+            presence_penalty=self.presence_penalty,
+            repetition_penalty=self.repetition_penalty,
+            seed=self.seed,
+            n=self.n,
+        )
+
+    def to_stop_conditions(self, default_max_tokens: Optional[int] = None) -> StopConditions:
+        return StopConditions(
+            max_tokens=self.max_completion_tokens or self.max_tokens or default_max_tokens,
+            stop=self.stop_list(),
+            ignore_eos=bool((self.nvext or {}).get("ignore_eos", False)),
+        )
+
+    def to_output_options(self) -> OutputOptions:
+        n = None
+        if self.logprobs is True:
+            n = self.top_logprobs or 0
+        elif isinstance(self.logprobs, int) and not isinstance(self.logprobs, bool):
+            n = self.logprobs
+        return OutputOptions(logprobs=n)
+
+
+class ChatCompletionRequest(_CommonRequest):
+    messages: list[ChatMessage]
+    tools: Optional[list[dict[str, Any]]] = None
+    tool_choice: Union[str, dict[str, Any], None] = None
+    response_format: Optional[dict[str, Any]] = None
+    chat_template_args: Optional[dict[str, Any]] = None
+
+    @field_validator("messages")
+    @classmethod
+    def _nonempty(cls, v):
+        if not v:
+            raise ValueError("messages must be non-empty")
+        return v
+
+
+class CompletionRequest(_CommonRequest):
+    prompt: Union[str, list[str], list[int], list[list[int]]]
+    echo: bool = False
+    suffix: Optional[str] = None
+    best_of: Optional[int] = None
+
+
+class EmbeddingRequest(BaseModel):
+    model: str
+    input: Union[str, list[str], list[int], list[list[int]]]
+    encoding_format: Literal["float", "base64"] = "float"
+    dimensions: Optional[int] = None
+    user: Optional[str] = None
+
+
+# ---------------------------------------------------------------------------
+# Response builders (dicts — serialized straight to JSON)
+# ---------------------------------------------------------------------------
+
+
+def _usage(prompt_tokens: int, completion_tokens: int) -> dict[str, int]:
+    return {
+        "prompt_tokens": prompt_tokens,
+        "completion_tokens": completion_tokens,
+        "total_tokens": prompt_tokens + completion_tokens,
+    }
+
+
+def make_id(prefix: str = "chatcmpl") -> str:
+    return f"{prefix}-{uuid.uuid4().hex}"
+
+
+def chat_completion_response(
+    *,
+    rid: str,
+    model: str,
+    choices: list[dict[str, Any]],
+    prompt_tokens: int,
+    completion_tokens: int,
+    created: Optional[int] = None,
+) -> dict[str, Any]:
+    return {
+        "id": rid,
+        "object": "chat.completion",
+        "created": created or int(time.time()),
+        "model": model,
+        "choices": choices,
+        "usage": _usage(prompt_tokens, completion_tokens),
+    }
+
+
+def completion_response(
+    *,
+    rid: str,
+    model: str,
+    choices: list[dict[str, Any]],
+    prompt_tokens: int,
+    completion_tokens: int,
+    created: Optional[int] = None,
+) -> dict[str, Any]:
+    return {
+        "id": rid,
+        "object": "text_completion",
+        "created": created or int(time.time()),
+        "model": model,
+        "choices": choices,
+        "usage": _usage(prompt_tokens, completion_tokens),
+    }
+
+
+def model_list_response(models: list[str]) -> dict[str, Any]:
+    now = int(time.time())
+    return {
+        "object": "list",
+        "data": [
+            {"id": m, "object": "model", "created": now, "owned_by": "dynamo-tpu"}
+            for m in models
+        ],
+    }
+
+
+class DeltaGenerator:
+    """Builds OpenAI streaming chunks from engine output deltas.
+
+    One per request; mirrors reference
+    protocols/openai/chat_completions/delta.rs DeltaGenerator.
+    """
+
+    def __init__(self, model: str, *, chat: bool = True, rid: Optional[str] = None, n: int = 1):
+        self.chat = chat
+        self.model = model
+        self.rid = rid or make_id("chatcmpl" if chat else "cmpl")
+        self.created = int(time.time())
+        self._first_sent = [False] * n
+
+    def _chunk(self, choices: list[dict[str, Any]], usage: Optional[dict] = None) -> dict[str, Any]:
+        out = {
+            "id": self.rid,
+            "object": "chat.completion.chunk" if self.chat else "text_completion",
+            "created": self.created,
+            "model": self.model,
+            "choices": choices,
+        }
+        if usage is not None:
+            out["usage"] = usage
+        return out
+
+    def text_chunk(self, text: str, index: int = 0) -> dict[str, Any]:
+        if self.chat:
+            delta: dict[str, Any] = {"content": text}
+            if not self._first_sent[index]:
+                delta["role"] = "assistant"
+                self._first_sent[index] = True
+            choice = {"index": index, "delta": delta, "finish_reason": None}
+        else:
+            choice = {"index": index, "text": text, "finish_reason": None}
+        return self._chunk([choice])
+
+    def finish_chunk(self, reason: FinishReason, index: int = 0) -> dict[str, Any]:
+        if self.chat:
+            choice = {"index": index, "delta": {}, "finish_reason": reason.to_openai()}
+        else:
+            choice = {"index": index, "text": "", "finish_reason": reason.to_openai()}
+        return self._chunk([choice])
+
+    def usage_chunk(self, prompt_tokens: int, completion_tokens: int) -> dict[str, Any]:
+        return self._chunk([], usage=_usage(prompt_tokens, completion_tokens))

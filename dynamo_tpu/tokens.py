@@ -145,6 +145,10 @@ class TokenBlockSequence:
         """Drop tokens beyond `num_tokens` (used on preemption/restart)."""
         if num_tokens >= self.total_tokens:
             return
+        if num_tokens <= 0:
+            self.blocks = []
+            self.partial = []
+            return
         keep_blocks, rem = divmod(num_tokens, self.block_size)
         if keep_blocks < len(self.blocks):
             self.partial = list(self.blocks[keep_blocks].tokens[:rem])

@@ -1,0 +1,147 @@
+"""Preprocessor + backend tests using the offline test tokenizer."""
+import pytest
+
+from dynamo_tpu.backend import Backend, StopJail
+from dynamo_tpu.preprocessor import OpenAIPreprocessor, PromptFormatter
+from dynamo_tpu.protocols.common import (
+    FinishReason,
+    LLMEngineOutput,
+    StopConditions,
+)
+from dynamo_tpu.protocols.openai import ChatCompletionRequest, CompletionRequest
+from dynamo_tpu.tokenizer import DecodeStream, make_test_tokenizer
+
+
+@pytest.fixture
+def tok():
+    return make_test_tokenizer([f"w{i}" for i in range(50)] + ["hello", "world", "STOP"])
+
+
+def test_preprocess_chat_renders_template_and_tokenizes(tok):
+    pre = OpenAIPreprocessor(tokenizer=tok, model_name="test")
+    req = ChatCompletionRequest(
+        model="test",
+        messages=[{"role": "user", "content": "hello world"}],
+        max_tokens=4,
+    )
+    out = pre.preprocess_chat(req)
+    assert out.token_ids  # template rendered then tokenized
+    assert out.stop_conditions.max_tokens == 4
+    assert set(tok.eos_token_ids) <= set(out.stop_conditions.stop_token_ids)
+
+
+def test_preprocess_custom_template(tok):
+    fmt = PromptFormatter(template="{% for m in messages %}{{ m.content }} {% endfor %}")
+    pre = OpenAIPreprocessor(tokenizer=tok, formatter=fmt)
+    req = ChatCompletionRequest(
+        model="t", messages=[{"role": "user", "content": "hello world"}]
+    )
+    out = pre.preprocess_chat(req)
+    assert out.token_ids == tok.encode("hello world")
+
+
+def test_preprocess_completion_token_ids(tok):
+    pre = OpenAIPreprocessor(tokenizer=tok)
+    out = pre.preprocess_completion(CompletionRequest(model="m", prompt=[5, 6, 7]))
+    assert out.token_ids == [5, 6, 7]
+
+
+def test_context_length_enforced(tok):
+    pre = OpenAIPreprocessor(tokenizer=tok, context_length=2)
+    with pytest.raises(ValueError, match="context length"):
+        pre.preprocess_completion(CompletionRequest(model="m", prompt=[1, 2, 3]))
+
+
+def test_multimodal_content_flattened(tok):
+    pre = OpenAIPreprocessor(tokenizer=tok)
+    req = ChatCompletionRequest(
+        model="t",
+        messages=[
+            {
+                "role": "user",
+                "content": [
+                    {"type": "text", "text": "hello"},
+                    {"type": "image_url", "image_url": {"url": "x"}},
+                    {"type": "text", "text": " world"},
+                ],
+            }
+        ],
+    )
+    out = pre.preprocess_chat(req)
+    assert out.token_ids
+
+
+def test_stop_jail_partial_and_full():
+    j = StopJail(["<END>"])
+    out, stopped = j.push("hello <E")
+    assert out == "hello " and not stopped  # "<E" jailed
+    out, stopped = j.push("ND> trailing")
+    assert out == "" and stopped  # stop completed; nothing after it leaks
+    j2 = StopJail(["<END>"])
+    out, stopped = j2.push("a <Eb")
+    assert out == "a <Eb" and not stopped  # diverged -> released
+
+
+def test_decode_stream_incremental(tok):
+    ids = tok.encode("hello world w1 w2")
+    ds = DecodeStream(tok, prompt_ids=ids[:2])
+    text = "".join(ds.step(t) for t in ids[2:])
+    assert text == " w1 w2"
+
+
+async def collect(agen):
+    return [x async for x in agen]
+
+
+async def engine_stream(token_lists, finish=None):
+    for i, toks in enumerate(token_lists):
+        last = i == len(token_lists) - 1
+        yield LLMEngineOutput(token_ids=toks, finish_reason=finish if last else None)
+
+
+async def test_backend_eos_token(tok):
+    b = Backend(tok)
+    ids = tok.encode("hello world")
+    stream = engine_stream([[ids[0]], [ids[1]], [2]])  # 2 = </s>
+    outs = await collect(
+        b.transform(stream, prompt_ids=[], stop=StopConditions(stop_token_ids=[2]))
+    )
+    assert outs[-1].finish_reason == FinishReason.EOS
+    text = "".join(o.text or "" for o in outs)
+    assert "hello" in text and "world" in text
+
+
+async def test_backend_max_tokens(tok):
+    b = Backend(tok)
+    ids = [tok.encode("w1")[0]] * 10
+    stream = engine_stream([[i] for i in ids])
+    outs = await collect(
+        b.transform(stream, prompt_ids=[], stop=StopConditions(max_tokens=3))
+    )
+    assert outs[-1].finish_reason == FinishReason.LENGTH
+    assert sum(len(o.token_ids) for o in outs) == 3
+
+
+async def test_backend_stop_string(tok):
+    b = Backend(tok)
+    w = {t: tok.encode(t)[0] for t in ["hello", "STOP", "world"]}
+    stream = engine_stream([[w["hello"]], [w["STOP"]], [w["world"]]])
+    outs = await collect(
+        b.transform(stream, prompt_ids=[], stop=StopConditions(stop=["STOP"]))
+    )
+    assert outs[-1].finish_reason == FinishReason.STOP
+    text = "".join(o.text or "" for o in outs)
+    assert "world" not in text and "STOP" not in text
+
+
+async def test_backend_ignore_eos(tok):
+    b = Backend(tok)
+    stream = engine_stream([[2], [tok.encode("w1")[0]]], finish=FinishReason.LENGTH)
+    outs = await collect(
+        b.transform(
+            stream,
+            prompt_ids=[],
+            stop=StopConditions(stop_token_ids=[2], ignore_eos=True),
+        )
+    )
+    assert outs[-1].finish_reason == FinishReason.LENGTH

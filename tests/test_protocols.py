@@ -1,0 +1,79 @@
+"""Protocol-layer tests: validation, SSE round-trip, delta/aggregation."""
+import pytest
+from pydantic import ValidationError
+
+from dynamo_tpu.protocols.aggregator import aggregate_chunks
+from dynamo_tpu.protocols.common import FinishReason
+from dynamo_tpu.protocols.openai import (
+    ChatCompletionRequest,
+    CompletionRequest,
+    DeltaGenerator,
+)
+from dynamo_tpu.protocols.sse import SseDecoder, encode_done, encode_event
+
+
+def chat_req(**kw):
+    base = {"model": "m", "messages": [{"role": "user", "content": "hi"}]}
+    base.update(kw)
+    return ChatCompletionRequest(**base)
+
+
+def test_request_validation_bounds():
+    chat_req(temperature=0.7, top_p=0.9, max_tokens=10)
+    with pytest.raises(ValidationError):
+        chat_req(temperature=3.0)
+    with pytest.raises(ValidationError):
+        chat_req(max_tokens=0)
+    with pytest.raises(ValidationError):
+        chat_req(messages=[])
+    with pytest.raises(ValidationError):
+        chat_req(stop=[str(i) for i in range(9)])
+    r = chat_req(stop="END", max_completion_tokens=5)
+    sc = r.to_stop_conditions(default_max_tokens=99)
+    assert sc.stop == ["END"] and sc.max_tokens == 5
+    assert chat_req().to_stop_conditions(77).max_tokens == 77
+
+
+def test_completion_request_prompt_forms():
+    CompletionRequest(model="m", prompt="hello")
+    CompletionRequest(model="m", prompt=[1, 2, 3])
+
+
+def test_sse_roundtrip():
+    dec = SseDecoder()
+    chunks = [encode_event({"i": i}) for i in range(3)] + [encode_done()]
+    blob = b"".join(chunks)
+    # feed in awkward byte splits
+    events = []
+    for i in range(0, len(blob), 7):
+        events.extend(dec.feed(blob[i : i + 7]))
+    assert [e.json()["i"] for e in events[:3]] == [0, 1, 2]
+    assert events[3].is_done
+
+
+def test_delta_generator_and_aggregate():
+    gen = DeltaGenerator("mymodel", chat=True)
+    chunks = [
+        gen.text_chunk("Hel"),
+        gen.text_chunk("lo"),
+        gen.finish_chunk(FinishReason.EOS),
+        gen.usage_chunk(5, 2),
+    ]
+    # role only on first delta
+    assert chunks[0]["choices"][0]["delta"]["role"] == "assistant"
+    assert "role" not in chunks[1]["choices"][0]["delta"]
+    final = aggregate_chunks(chunks)
+    assert final["object"] == "chat.completion"
+    assert final["choices"][0]["message"]["content"] == "Hello"
+    assert final["choices"][0]["finish_reason"] == "stop"
+    assert final["usage"]["total_tokens"] == 7
+
+
+def test_completion_delta_aggregate():
+    gen = DeltaGenerator("m", chat=False)
+    final = aggregate_chunks(
+        [gen.text_chunk("a"), gen.text_chunk("b"), gen.finish_chunk(FinishReason.LENGTH)]
+    )
+    assert final["object"] == "text_completion"
+    assert final["choices"][0]["text"] == "ab"
+    assert final["choices"][0]["finish_reason"] == "length"
