@@ -76,7 +76,7 @@ class PromptFormatter:
         *,
         tools: Optional[list[dict[str, Any]]] = None,
         add_generation_prompt: bool = True,
-        **extra: Any,
+        extra: Optional[dict[str, Any]] = None,
     ) -> str:
         ctx = {
             "messages": messages,
@@ -85,9 +85,8 @@ class PromptFormatter:
             "bos_token": self.bos_token,
             "eos_token": self.eos_token,
         }
-        # user-supplied chat_template_args must not shadow the core context
-        ctx.update({k: v for k, v in extra.items() if k not in ("messages",)})
-        ctx["messages"] = messages
+        # user chat_template_args may override defaults but never the messages
+        ctx.update({k: v for k, v in (extra or {}).items() if k != "messages"})
         return self._compiled().render(**ctx)
 
 
@@ -130,7 +129,7 @@ class OpenAIPreprocessor:
             for m in req.messages
         ]
         prompt = self.formatter.render(
-            messages, tools=req.tools, **(req.chat_template_args or {})
+            messages, tools=req.tools, extra=req.chat_template_args
         )
         token_ids = self.tokenizer.encode(prompt)
         return self._finish(req, token_ids, formatted_prompt=prompt)
