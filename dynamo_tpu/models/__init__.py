@@ -1,0 +1,15 @@
+"""Model families: pure-JAX forward passes designed for the paged-KV engine.
+
+Each model module exposes:
+  - ``init_params(config, rng)``: random-init parameter pytree (bf16).
+  - ``load_hf_params(config, path)``: load safetensors weights from an HF dir.
+  - ``prefill(...)`` / ``decode_step(...)``: jittable forward entry points
+    operating on the paged KV cache.
+  - ``param_shardings(config, mesh)``: NamedSharding pytree for TP over mesh.
+
+The flagship family is llama (covers Llama-2/3/3.x and
+DeepSeek-R1-Distill-Llama, the reference benchmark model —
+/root/reference examples use DeepSeek-R1-Distill-Llama-8B).
+"""
+
+from dynamo_tpu.models.config import ModelConfig  # noqa: F401

@@ -1,0 +1,324 @@
+"""Llama-family forward pass (Llama-2/3/3.x, DeepSeek-R1-Distill-Llama).
+
+Design notes (TPU-first):
+  - Parameters are a pytree whose per-layer leaves are STACKED on a leading
+    layer axis and the decoder runs as one ``lax.scan`` — one compiled layer
+    body regardless of depth (compile time stays flat from 4 to 80 layers).
+  - The KV cache is a paged pool per layer: ``[L, num_pages, page_size,
+    kv_heads, head_dim]``; requests address it through page tables. Page 0 is
+    a reserved scratch page — padding/inactive writes land there so real
+    pages are never corrupted by masked lanes.
+  - Tensor parallelism is pure GSPMD: `param_shardings`/`cache_shardings`
+    put head/hidden dims on the ``tp`` mesh axis; XLA inserts the ICI
+    collectives. No hand-written comm (contrast: reference engines use NCCL
+    inside vLLM — SURVEY.md §2.5).
+  - Prefill is B=1 over a padded token bucket (positions q_start..q_start+T);
+    decode is a fixed-slot batch, one token per slot. Both are jittable with
+    static shapes; the engine buckets prompt lengths to bound recompiles.
+
+Parity: this is the TPU engine the reference delegates to vLLM for
+(launch/dynamo-run subprocess engines; SURVEY.md §2.1 L3).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from dynamo_tpu.models.config import ModelConfig
+from dynamo_tpu.ops.attention import paged_decode_attention, prefill_attention
+from dynamo_tpu.ops.rope import apply_rope, rope_cos_sin, rope_inv_freq
+
+Params = dict[str, Any]
+Cache = dict[str, jnp.ndarray]
+
+
+# ---------------------------------------------------------------------------
+# Parameters
+
+def init_params(config: ModelConfig, rng: jax.Array | int = 0) -> Params:
+    """Random-init parameters (bf16). Weight values only matter for quality,
+    not performance, so benchmarks use this; serving uses load_hf_params."""
+    if isinstance(rng, int):
+        rng = jax.random.PRNGKey(rng)
+    c = config
+    dtype = jnp.dtype(c.dtype)
+    keys = jax.random.split(rng, 12)
+
+    def rnd(key, *shape, scale=None):
+        scale = scale or (1.0 / np.sqrt(shape[-2] if len(shape) > 1 else shape[-1]))
+        return (jax.random.normal(key, shape, jnp.float32) * scale).astype(dtype)
+
+    L, H, I, V = c.num_layers, c.hidden_size, c.intermediate_size, c.vocab_size
+    params: Params = {
+        "embed": rnd(keys[0], V, H, scale=0.02),
+        "layers": {
+            "ln1": jnp.ones((L, H), dtype),
+            "ln2": jnp.ones((L, H), dtype),
+            "wq": rnd(keys[1], L, H, c.q_dim),
+            "wk": rnd(keys[2], L, H, c.kv_dim),
+            "wv": rnd(keys[3], L, H, c.kv_dim),
+            "wo": rnd(keys[4], L, c.q_dim, H),
+            "wg": rnd(keys[5], L, H, I),
+            "wu": rnd(keys[6], L, H, I),
+            "wd": rnd(keys[7], L, I, H),
+        },
+        "norm_f": jnp.ones((H,), dtype),
+    }
+    if not c.tie_word_embeddings:
+        params["lm_head"] = rnd(keys[8], H, V, scale=0.02)
+    return params
+
+
+def param_shardings(config: ModelConfig, mesh: Mesh) -> Params:
+    """NamedSharding pytree: Megatron-style TP over the `tp` mesh axis.
+    qkv/gate/up shard the output (head/hidden) dim; o/down shard the input
+    dim; embedding + lm_head shard the vocab dim."""
+    def ns(*spec):
+        return NamedSharding(mesh, P(*spec))
+
+    out: Params = {
+        "embed": ns("tp", None),
+        "layers": {
+            "ln1": ns(None, None),
+            "ln2": ns(None, None),
+            "wq": ns(None, None, "tp"),
+            "wk": ns(None, None, "tp"),
+            "wv": ns(None, None, "tp"),
+            "wo": ns(None, "tp", None),
+            "wg": ns(None, None, "tp"),
+            "wu": ns(None, None, "tp"),
+            "wd": ns(None, "tp", None),
+        },
+        "norm_f": ns(None),
+    }
+    if not config.tie_word_embeddings:
+        out["lm_head"] = ns(None, "tp")
+    return out
+
+
+# ---------------------------------------------------------------------------
+# KV cache
+
+def init_cache(
+    config: ModelConfig, num_pages: int, page_size: int, dtype=None
+) -> Cache:
+    """Paged KV pool. Page 0 is the reserved scratch page (see module doc)."""
+    c = config
+    dtype = dtype or jnp.dtype(c.dtype)
+    shape = (c.num_layers, num_pages, page_size, c.num_kv_heads, c.head_dim)
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+
+def cache_shardings(config: ModelConfig, mesh: Mesh) -> Cache:
+    s = NamedSharding(mesh, P(None, None, None, "tp", None))
+    return {"k": s, "v": s}
+
+
+# ---------------------------------------------------------------------------
+# Forward pieces
+
+def rms_norm(x: jnp.ndarray, w: jnp.ndarray, eps: float) -> jnp.ndarray:
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    return (xf * jax.lax.rsqrt(var + eps)).astype(x.dtype) * w
+
+
+def _mlp(h, wg, wu, wd):
+    return (jax.nn.silu(h @ wg) * (h @ wu)) @ wd
+
+
+def _logits(config: ModelConfig, params: Params, h: jnp.ndarray) -> jnp.ndarray:
+    h = rms_norm(h, params["norm_f"], config.rms_norm_eps)
+    if config.tie_word_embeddings:
+        return h.astype(jnp.float32) @ params["embed"].T.astype(jnp.float32)
+    return h.astype(jnp.float32) @ params["lm_head"].astype(jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# Prefill
+
+@functools.partial(jax.jit, static_argnums=(0,), donate_argnums=(2,))
+def prefill(
+    config: ModelConfig,
+    params: Params,
+    cache: Cache,
+    tokens: jnp.ndarray,      # [T] int32, padded to a page-size multiple
+    page_table: jnp.ndarray,  # [max_pages] int32 (pages covering [0, padded end))
+    q_start: jnp.ndarray,     # scalar int32: #tokens already cached (page-aligned)
+    seq_len: jnp.ndarray,     # scalar int32: total valid context length
+) -> tuple[Cache, jnp.ndarray]:
+    """Run T new tokens through the model, writing their KV into pages.
+
+    Returns (cache, logits[vocab]) where logits are for the LAST VALID token
+    (position seq_len-1). Supports prefix-cache continuation: with q_start>0
+    the first q_start tokens' KV is already in the pages listed by
+    page_table and is attended to but not recomputed.
+
+    CALLER CONTRACT (checked host-side by the engine scheduler, not here —
+    lax.dynamic_slice silently clamps under jit): q_start must be
+    page-aligned and q_start//page_size + T//page_size <= len(page_table),
+    with all written entries real (non-zero) pages.
+    """
+    c = config
+    T = tokens.shape[0]
+    ps = cache["k"].shape[2]
+    inv_freq = jnp.asarray(
+        rope_inv_freq(c.head_dim, c.rope_theta, c.rope_scaling_dict)
+    )
+    positions = q_start + jnp.arange(T, dtype=jnp.int32)
+    cos, sin = rope_cos_sin(positions, inv_freq)
+
+    h = params["embed"][tokens].astype(cache["k"].dtype)
+
+    # page indices that receive the new tokens' KV
+    n_new_pages = T // ps
+    write_idx = jax.lax.dynamic_slice_in_dim(
+        page_table, q_start // ps, n_new_pages
+    )  # [T/ps]
+
+    def layer_fn(h, xs):
+        (lp, k_pages, v_pages) = xs
+        x = rms_norm(h, lp["ln1"], c.rms_norm_eps)
+        q = (x @ lp["wq"]).reshape(T, c.num_heads, c.head_dim)
+        k = (x @ lp["wk"]).reshape(T, c.num_kv_heads, c.head_dim)
+        v = (x @ lp["wv"]).reshape(T, c.num_kv_heads, c.head_dim)
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+        # write new KV into the page pool
+        k_pages = k_pages.at[write_idx].set(
+            k.reshape(n_new_pages, ps, c.num_kv_heads, c.head_dim)
+        )
+        v_pages = v_pages.at[write_idx].set(
+            v.reshape(n_new_pages, ps, c.num_kv_heads, c.head_dim)
+        )
+        attn = prefill_attention(q, k_pages, v_pages, page_table, q_start, seq_len)
+        h = h + attn.reshape(T, c.q_dim) @ lp["wo"]
+        x2 = rms_norm(h, lp["ln2"], c.rms_norm_eps)
+        h = h + _mlp(x2, lp["wg"], lp["wu"], lp["wd"])
+        return h, (k_pages, v_pages)
+
+    h, (k_new, v_new) = jax.lax.scan(
+        layer_fn, h, (params["layers"], cache["k"], cache["v"])
+    )
+    last = seq_len - q_start - 1  # index of last valid token within T
+    logits = _logits(c, params, h[last])
+    return {"k": k_new, "v": v_new}, logits
+
+
+# ---------------------------------------------------------------------------
+# Decode
+
+@functools.partial(jax.jit, static_argnums=(0,), donate_argnums=(2,))
+def decode_step(
+    config: ModelConfig,
+    params: Params,
+    cache: Cache,
+    tokens: jnp.ndarray,       # [B] int32 — last sampled token per slot
+    page_tables: jnp.ndarray,  # [B, max_pages] int32 (inactive slots: zeros)
+    ctx_lens: jnp.ndarray,     # [B] int32 — context length INCLUDING this token
+) -> tuple[Cache, jnp.ndarray]:
+    """One decode step for all slots. Returns (cache, logits [B, vocab])."""
+    c = config
+    B = tokens.shape[0]
+    ps = cache["k"].shape[2]
+    inv_freq = jnp.asarray(
+        rope_inv_freq(c.head_dim, c.rope_theta, c.rope_scaling_dict)
+    )
+    positions = jnp.maximum(ctx_lens - 1, 0)
+    cos, sin = rope_cos_sin(positions, inv_freq)  # [B, hd]
+
+    h = params["embed"][tokens].astype(cache["k"].dtype)  # [B, H]
+
+    page_idx = jnp.take_along_axis(
+        page_tables, (positions // ps)[:, None], axis=1
+    )[:, 0]                       # [B] page receiving this token's KV
+    offset = positions % ps       # [B]
+
+    def layer_fn(h, xs):
+        (lp, k_pages, v_pages) = xs
+        x = rms_norm(h, lp["ln1"], c.rms_norm_eps)
+        q = (x @ lp["wq"]).reshape(B, c.num_heads, c.head_dim)
+        k = (x @ lp["wk"]).reshape(B, c.num_kv_heads, c.head_dim)
+        v = (x @ lp["wv"]).reshape(B, c.num_kv_heads, c.head_dim)
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+        k_pages = k_pages.at[page_idx, offset].set(k)
+        v_pages = v_pages.at[page_idx, offset].set(v)
+        attn = paged_decode_attention(q, k_pages, v_pages, page_tables, ctx_lens)
+        h = h + attn.reshape(B, c.q_dim) @ lp["wo"]
+        x2 = rms_norm(h, lp["ln2"], c.rms_norm_eps)
+        h = h + _mlp(x2, lp["wg"], lp["wu"], lp["wd"])
+        return h, (k_pages, v_pages)
+
+    h, (k_new, v_new) = jax.lax.scan(
+        layer_fn, h, (params["layers"], cache["k"], cache["v"])
+    )
+    logits = _logits(c, params, h)
+    return {"k": k_new, "v": v_new}, logits
+
+
+# ---------------------------------------------------------------------------
+# HF weight loading
+
+_HF_LAYER_MAP = {
+    "input_layernorm.weight": ("ln1", False),
+    "post_attention_layernorm.weight": ("ln2", False),
+    "self_attn.q_proj.weight": ("wq", True),
+    "self_attn.k_proj.weight": ("wk", True),
+    "self_attn.v_proj.weight": ("wv", True),
+    "self_attn.o_proj.weight": ("wo", True),
+    "mlp.gate_proj.weight": ("wg", True),
+    "mlp.up_proj.weight": ("wu", True),
+    "mlp.down_proj.weight": ("wd", True),
+}
+
+
+def params_from_state_dict(
+    config: ModelConfig, raw: dict[str, jnp.ndarray], dtype=None
+) -> Params:
+    """Build our param pytree from HF-named tensors (torch state_dict names).
+
+    Torch linear weights are [out, in]; ours are [in, out] — transposed here.
+    Per-layer tensors are stacked on the leading layer axis.
+    """
+    dtype = jnp.dtype(config.dtype) if dtype is None else jnp.dtype(dtype)
+    L = config.num_layers
+    layers: dict[str, list] = {k: [None] * L for (k, _) in _HF_LAYER_MAP.values()}
+    for hf_suffix, (ours, transpose) in _HF_LAYER_MAP.items():
+        for l in range(L):
+            t = jnp.asarray(raw[f"model.layers.{l}.{hf_suffix}"])
+            layers[ours][l] = t.T if transpose else t
+
+    params: Params = {
+        "embed": jnp.asarray(raw["model.embed_tokens.weight"], dtype),
+        "layers": {
+            k: jnp.stack(v).astype(dtype) for k, v in layers.items()
+        },
+        "norm_f": jnp.asarray(raw["model.norm.weight"], dtype),
+    }
+    if not config.tie_word_embeddings:
+        params["lm_head"] = jnp.asarray(raw["lm_head.weight"]).T.astype(dtype)
+    return params
+
+
+def load_hf_params(config: ModelConfig, model_dir: str, dtype=None) -> Params:
+    """Load llama safetensors weights from a local HF model directory."""
+    import glob
+    import os
+
+    from safetensors import safe_open
+
+    raw: dict[str, jnp.ndarray] = {}
+    files = sorted(glob.glob(os.path.join(model_dir, "*.safetensors")))
+    if not files:
+        raise FileNotFoundError(f"no safetensors in {model_dir}")
+    for fp in files:
+        with safe_open(fp, framework="flax") as f:
+            for name in f.keys():
+                raw[name] = f.get_tensor(name)
+    return params_from_state_dict(config, raw, dtype)

@@ -1,0 +1,5 @@
+"""TPU compute ops: attention over paged KV, RoPE, sampling primitives.
+
+Each op has a pure-jnp reference implementation (runs anywhere, used on the
+CPU test mesh) and, where hot, a Pallas TPU kernel selected at trace time.
+"""

@@ -1,0 +1,95 @@
+"""Attention over a paged KV cache.
+
+The KV cache for one layer is a page pool ``k_pages/v_pages:
+[num_pages, page_size, num_kv_heads, head_dim]``; a request's context is the
+concatenation of the pages listed in its page table. This mirrors the paged
+layout the reference gets from vLLM (SURVEY.md §7 "Paged attention on TPU")
+but laid out for TPU: the trailing (kv_heads, head_dim) axes shard over the
+``tp`` mesh axis and head_dim stays a 128-lane multiple for real models.
+
+This module holds the pure-jnp reference implementations. The Pallas TPU
+kernels (dynamo_tpu.ops.pallas) override them at trace time on TPU backends.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def repeat_kv(x: jnp.ndarray, n_rep: int) -> jnp.ndarray:
+    """[..., kv_heads, hd] -> [..., kv_heads*n_rep, hd] (GQA head expansion)."""
+    if n_rep == 1:
+        return x
+    return jnp.repeat(x, n_rep, axis=-2)
+
+
+def gather_pages(pages: jnp.ndarray, page_table: jnp.ndarray) -> jnp.ndarray:
+    """pages [P, ps, kvh, hd], page_table [n] -> contiguous [n*ps, kvh, hd]."""
+    g = pages[page_table]  # [n, ps, kvh, hd]
+    n, ps, kvh, hd = g.shape
+    return g.reshape(n * ps, kvh, hd)
+
+
+def prefill_attention(
+    q: jnp.ndarray,            # [T, n_heads, hd] — new tokens (padded)
+    k_pages: jnp.ndarray,      # [P, ps, kv_heads, hd]
+    v_pages: jnp.ndarray,
+    page_table: jnp.ndarray,   # [max_pages] int32 — pages covering [0, seq_len)
+    q_start: jnp.ndarray,      # scalar int32 — #tokens already cached (page-aligned)
+    seq_len: jnp.ndarray,      # scalar int32 — total valid context length
+) -> jnp.ndarray:
+    """Causal attention of T new tokens (positions q_start..q_start+T) against
+    the full paged context [0, seq_len). Returns [T, n_heads, hd]."""
+    T, n_heads, hd = q.shape
+    kv_heads = k_pages.shape[2]
+    k = gather_pages(k_pages, page_table)  # [S, kvh, hd]
+    v = gather_pages(v_pages, page_table)
+    S = k.shape[0]
+    k = repeat_kv(k, n_heads // kv_heads)
+    v = repeat_kv(v, n_heads // kv_heads)
+
+    scale = 1.0 / jnp.sqrt(jnp.asarray(hd, jnp.float32))
+    # [heads, T, S]
+    scores = jnp.einsum("tnh,snh->nts", q.astype(jnp.float32), k.astype(jnp.float32))
+    scores = scores * scale
+    q_pos = q_start + jnp.arange(T)[:, None]       # [T, 1]
+    k_pos = jnp.arange(S)[None, :]                 # [1, S]
+    mask = (k_pos <= q_pos) & (k_pos < seq_len)    # causal + validity
+    scores = jnp.where(mask[None], scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("nts,snh->tnh", probs, v.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+def paged_decode_attention(
+    q: jnp.ndarray,            # [B, n_heads, hd] — one new token per slot
+    k_pages: jnp.ndarray,      # [P, ps, kv_heads, hd]
+    v_pages: jnp.ndarray,
+    page_tables: jnp.ndarray,  # [B, max_pages] int32
+    ctx_lens: jnp.ndarray,     # [B] int32 — context length incl. current token
+) -> jnp.ndarray:
+    """Single-token attention for a batch of decode slots. Returns [B, n_heads, hd]."""
+    B, n_heads, hd = q.shape
+    ps = k_pages.shape[1]
+    kv_heads = k_pages.shape[2]
+    n_rep = n_heads // kv_heads
+    max_pages = page_tables.shape[1]
+    S = max_pages * ps
+
+    k = k_pages[page_tables]   # [B, max_pages, ps, kvh, hd]
+    v = v_pages[page_tables]
+    k = k.reshape(B, S, kv_heads, hd)
+    v = v.reshape(B, S, kv_heads, hd)
+    k = repeat_kv(k, n_rep)
+    v = repeat_kv(v, n_rep)
+
+    scale = 1.0 / jnp.sqrt(jnp.asarray(hd, jnp.float32))
+    scores = jnp.einsum("bnh,bsnh->bns", q.astype(jnp.float32), k.astype(jnp.float32))
+    scores = scores * scale
+    mask = jnp.arange(S)[None, :] < ctx_lens[:, None]   # [B, S]
+    scores = jnp.where(mask[:, None, :], scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bns,bsnh->bnh", probs, v.astype(jnp.float32))
+    return out.astype(q.dtype)

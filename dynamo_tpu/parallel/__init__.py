@@ -1,0 +1,16 @@
+"""Parallelism: device meshes, sharding rules, collectives.
+
+The reference delegates intra-model parallelism to its engines (NCCL inside
+vLLM/TRT-LLM — SURVEY.md §2.5); here it is first-class: a
+``jax.sharding.Mesh`` over ICI with named axes, GSPMD shardings on the
+parameter/cache pytrees, and XLA collectives inserted by the compiler.
+"""
+
+from dynamo_tpu.parallel.mesh import (  # noqa: F401
+    AXIS_DATA,
+    AXIS_EXPERT,
+    AXIS_SEQ,
+    AXIS_TENSOR,
+    MeshConfig,
+    make_mesh,
+)

@@ -1,0 +1,71 @@
+"""Device mesh construction and axis naming.
+
+Axis convention (fixed across the whole framework):
+  - ``dp``: data parallel — independent replicas of the whole model; the
+    router balances across them (reference "Basic Routing").
+  - ``tp``: tensor parallel — Megatron-style partition of attention heads and
+    MLP hidden dim; collectives ride ICI.
+  - ``sp``: sequence/context parallel — ring/blockwise attention for
+    long-context prefill (absent in the reference, SURVEY.md §2.5).
+  - ``ep``: expert parallel — MoE expert dispatch via all_to_all.
+
+A dense TP-only engine uses mesh shape {dp:1, tp:N, sp:1, ep:1}; all axes
+always exist so sharding specs are uniform.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+AXIS_DATA = "dp"
+AXIS_SEQ = "sp"
+AXIS_TENSOR = "tp"
+AXIS_EXPERT = "ep"
+
+# Mesh axis order: dp outermost (slowest-varying, may span DCN), then sp, then
+# tp innermost (fastest-varying — TP collectives are the most
+# latency-sensitive, so tp neighbours must be ICI neighbours).
+AXIS_ORDER = (AXIS_DATA, AXIS_SEQ, AXIS_EXPERT, AXIS_TENSOR)
+
+
+@dataclass(frozen=True)
+class MeshConfig:
+    dp: int = 1
+    sp: int = 1
+    ep: int = 1
+    tp: int = 1
+
+    @property
+    def num_devices(self) -> int:
+        return self.dp * self.sp * self.ep * self.tp
+
+    def axis_sizes(self) -> dict[str, int]:
+        return {AXIS_DATA: self.dp, AXIS_SEQ: self.sp,
+                AXIS_EXPERT: self.ep, AXIS_TENSOR: self.tp}
+
+
+def make_mesh(config: MeshConfig | None = None, devices=None) -> Mesh:
+    """Build the framework mesh over the given (or all) devices."""
+    if devices is None:
+        devices = jax.devices()
+    if config is None:
+        config = MeshConfig(tp=len(devices))
+    n = config.num_devices
+    if n > len(devices):
+        raise ValueError(
+            f"mesh needs {n} devices, have {len(devices)}"
+        )
+    shape = tuple(config.axis_sizes()[a] for a in AXIS_ORDER)
+    dev = np.asarray(devices[:n]).reshape(shape)
+    return Mesh(dev, AXIS_ORDER)
+
+
+def named(mesh: Mesh, *spec) -> NamedSharding:
+    return NamedSharding(mesh, P(*spec))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())

@@ -8,7 +8,7 @@ see SURVEY.md §4.7).
 """
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
@@ -19,7 +19,17 @@ os.environ.setdefault("DYNAMO_TPU_TEST", "1")
 import asyncio  # noqa: E402
 import inspect  # noqa: E402
 
+import jax  # noqa: E402
 import pytest  # noqa: E402
+
+# The axon TPU plugin force-registers itself ("axon,cpu") even when
+# JAX_PLATFORMS=cpu is set; override at the config level so tests always run
+# on the virtual 8-device CPU platform.
+jax.config.update("jax_platforms", "cpu")
+
+# XLA CPU dispatches f32 matmuls to reduced-precision paths by default;
+# golden tests against torch need exact f32 accumulation.
+jax.config.update("jax_default_matmul_precision", "highest")
 
 
 def pytest_configure(config):
