@@ -1,0 +1,104 @@
+"""Benchmark: steady-state decode throughput of the TPU engine.
+
+Runs the full continuous-batching engine (host scheduler + fused
+decode/sample on device) on Llama-3.2-1B shapes, bf16, on whatever
+accelerator `jax.devices()` offers (the driver runs this on one real v5e
+chip). Prints ONE JSON line.
+
+vs_baseline: the reference publishes a decode exemplar of 51.22 tok/s/GPU
+(TP=4 profile_sla output, docs/architecture/load_planner.md:56 — see
+BASELINE.md). Model/hardware differ, so treat the ratio as a tracking
+number across rounds, not a head-to-head.
+"""
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import time
+
+BASELINE_DECODE_TOK_S = 51.22
+
+
+async def run_bench() -> dict:
+    from dynamo_tpu.engine.config import EngineConfig
+    from dynamo_tpu.engine.engine import TpuEngine
+    from dynamo_tpu.models.config import ModelConfig
+    from dynamo_tpu.parallel.mesh import MeshConfig
+    from dynamo_tpu.protocols.common import PreprocessedRequest, StopConditions
+
+    tiny = os.environ.get("DYNAMO_BENCH_TINY") == "1"
+    if tiny:
+        cfg = ModelConfig.tiny()
+        ecfg = EngineConfig(
+            num_pages=128, page_size=16, max_pages_per_seq=16,
+            max_decode_slots=8, prefill_buckets=(64,), cache_dtype="float32",
+        )
+        prompt_len, max_tokens, n_requests = 48, 32, 8
+    else:
+        cfg = ModelConfig.llama3_1b()
+        ecfg = EngineConfig(
+            num_pages=1024, page_size=64, max_pages_per_seq=32,
+            max_decode_slots=16, prefill_buckets=(128,),
+        )
+        prompt_len, max_tokens, n_requests = 100, 256, 16
+
+    eng = TpuEngine(cfg, ecfg, mesh_config=MeshConfig(tp=1))
+    eng.start()
+
+    import numpy as np
+
+    rng = np.random.RandomState(0)
+
+    def make_req(i):
+        return PreprocessedRequest(
+            token_ids=rng.randint(1, cfg.vocab_size, size=prompt_len).tolist(),
+            stop_conditions=StopConditions(max_tokens=max_tokens, ignore_eos=True),
+        )
+
+    async def drive(req):
+        first = None
+        n = 0
+        async for out in eng.generate(req):
+            if first is None and out.token_ids:
+                first = time.monotonic()
+            n += len(out.token_ids)
+        return first, n
+
+    # warmup: trigger all compilations (prefill bucket + decode + sampling)
+    await drive(make_req(-1))
+
+    t0 = time.monotonic()
+    results = await asyncio.gather(*[drive(make_req(i)) for i in range(n_requests)])
+    t1 = time.monotonic()
+    await eng.stop()
+
+    total_tokens = sum(n for _, n in results)
+    ttfts = sorted(f - t0 for f, _ in results if f is not None)
+    decode_tok_s = total_tokens / (t1 - t0)
+    return {
+        "decode_tok_s": decode_tok_s,
+        "total_tokens": total_tokens,
+        "wall_s": t1 - t0,
+        "ttft_p50_s": ttfts[len(ttfts) // 2] if ttfts else None,
+    }
+
+
+def main():
+    stats = run_bench()
+    if asyncio.iscoroutine(stats):
+        stats = asyncio.run(stats)
+    print(
+        json.dumps(
+            {
+                "metric": "decode_throughput_llama3.2-1b_bf16_agg",
+                "value": round(stats["decode_tok_s"], 2),
+                "unit": "tok/s/chip",
+                "vs_baseline": round(stats["decode_tok_s"] / BASELINE_DECODE_TOK_S, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

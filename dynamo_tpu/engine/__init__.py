@@ -1,0 +1,11 @@
+"""The TPU engine: continuous batching over a paged KV cache.
+
+This is the layer the reference outsources to vLLM/SGLang/TRT-LLM
+subprocesses (SURVEY.md §2.1 L3, launch/dynamo-run/src/subprocess/*). Here it
+is native: a JAX model (dynamo_tpu.models) driven by a host-side scheduler —
+bucketed prefill, fixed-slot decode batch, page allocator with prefix reuse,
+on-device sampling — exposed through the AsyncEngine contract
+(generate(PreprocessedRequest) -> stream of LLMEngineOutput).
+"""
+
+from dynamo_tpu.engine.config import EngineConfig  # noqa: F401

@@ -1,0 +1,48 @@
+"""Engine runtime configuration (the vLLM-engine-args equivalent —
+reference MockEngineArgs mocker/protocols.rs:72-94 and vllm_inc.py flags)."""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+
+def _default_buckets() -> tuple[int, ...]:
+    return (128, 256, 512, 1024, 2048, 4096)
+
+
+@dataclass
+class EngineConfig:
+    """Knobs of the continuous-batching TPU engine."""
+
+    # paged KV
+    num_pages: int = 512          # total pages incl. reserved page 0
+    page_size: int = 64           # tokens per page (also the router block size)
+    max_pages_per_seq: int = 64   # static page-table width = max context/page_size
+
+    # batching
+    max_decode_slots: int = 8     # fixed decode batch width
+    prefill_buckets: tuple[int, ...] = field(default_factory=_default_buckets)
+
+    # sampling
+    max_top_k: int = 64           # static top-k width for top-p/top-k sampling
+
+    # prefix cache
+    enable_prefix_caching: bool = True
+
+    # model memory
+    cache_dtype: str = "bfloat16"
+
+    # identity on the control plane
+    worker_id: str = ""
+
+    @property
+    def max_context(self) -> int:
+        return self.max_pages_per_seq * self.page_size
+
+    def bucket_for(self, n_tokens: int) -> Optional[int]:
+        """Smallest prefill bucket holding n_tokens (buckets are padded
+        shapes; each distinct bucket is one XLA compilation)."""
+        for b in self.prefill_buckets:
+            if n_tokens <= b:
+                return b
+        return None

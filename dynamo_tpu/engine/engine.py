@@ -1,0 +1,543 @@
+"""TpuEngine: continuous batching over the paged-KV JAX model.
+
+Architecture (TPU-first redesign of what the reference delegates to vLLM —
+SURVEY.md §7 step 3):
+
+  - One fixed-width decode batch of ``max_decode_slots`` slots steps every
+    iteration; each slot is one in-flight request. Static shapes — exactly
+    one compiled decode program.
+  - Prefill runs per request at one of a few bucketed padded lengths (one
+    compiled program per bucket), writing prompt KV straight into pages,
+    reusing any cached prefix pages (chained-hash match).
+  - A host-side step loop (dedicated thread — JAX dispatch is async, the
+    loop only blocks on the sampled-token transfer) drives admission,
+    page growth, block commit/publish, stop conditions, and preemption.
+  - Sampling is fused on device; only sampled token ids cross to host.
+
+The engine implements the AsyncEngine contract: ``generate(request)`` yields
+LLMEngineOutput deltas; cancellation propagates via the iterator being
+dropped (reference engine.rs:124-140 AsyncEngineContext::stop_generating).
+"""
+from __future__ import annotations
+
+import asyncio
+import logging
+import queue as queue_mod
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, AsyncIterator, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dynamo_tpu.engine.cache import PageAllocator
+from dynamo_tpu.engine.config import EngineConfig
+from dynamo_tpu.engine import sampling
+from dynamo_tpu.kv_router.protocols import (
+    ForwardPassMetrics,
+    KvCacheEvent,
+    KvStats,
+    WorkerStats,
+)
+from dynamo_tpu.models import llama
+from dynamo_tpu.models.config import ModelConfig
+from dynamo_tpu.parallel.mesh import MeshConfig, make_mesh
+from dynamo_tpu.protocols.common import (
+    FinishReason,
+    LLMEngineOutput,
+    PreprocessedRequest,
+)
+from dynamo_tpu.tokens import TokenBlockSequence
+
+log = logging.getLogger(__name__)
+
+
+@dataclass
+class _Request:
+    req: PreprocessedRequest
+    seq: TokenBlockSequence
+    out: asyncio.Queue
+    loop: asyncio.AbstractEventLoop
+    pages: list[int] = field(default_factory=list)
+    matched_blocks: int = 0       # prefix-cache hit depth (blocks)
+    slot: int = -1
+    produced: int = 0
+    last_token: int = 0
+    cancelled: bool = False
+    prefill_done: bool = False
+    enqueue_time: float = field(default_factory=time.monotonic)
+    first_token_time: Optional[float] = None
+
+    @property
+    def prompt_len(self) -> int:
+        return len(self.req.token_ids)
+
+    def max_new_tokens(self, max_context: int) -> int:
+        mt = self.req.stop_conditions.max_tokens
+        cap = max_context - self.prompt_len
+        return min(mt, cap) if mt is not None else cap
+
+    def emit(self, item: LLMEngineOutput | Exception) -> None:
+        self.loop.call_soon_threadsafe(self.out.put_nowait, item)
+
+
+class TpuEngine:
+    """Continuous-batching paged-KV engine on a jax mesh."""
+
+    def __init__(
+        self,
+        model_config: ModelConfig,
+        engine_config: Optional[EngineConfig] = None,
+        *,
+        params: Any = None,
+        mesh: Optional[jax.sharding.Mesh] = None,
+        mesh_config: Optional[MeshConfig] = None,
+        rng_seed: int = 0,
+        on_kv_event: Optional[Callable[[KvCacheEvent], None]] = None,
+        on_metrics: Optional[Callable[[ForwardPassMetrics], None]] = None,
+    ):
+        self.config = model_config
+        self.ecfg = engine_config or EngineConfig()
+        self.mesh = mesh or make_mesh(mesh_config)
+        self.on_metrics = on_metrics
+
+        c, e = self.config, self.ecfg
+        cache_dtype = jnp.dtype(e.cache_dtype)
+        p_sh = llama.param_shardings(c, self.mesh)
+        if params is None:
+            params = llama.init_params(c, rng_seed)
+        self.params = jax.tree.map(lambda x, s: jax.device_put(x, s), params, p_sh)
+        self.cache = jax.tree.map(
+            lambda x, s: jax.device_put(x, s),
+            llama.init_cache(c, e.num_pages, e.page_size, cache_dtype),
+            llama.cache_shardings(c, self.mesh),
+        )
+        self.allocator = PageAllocator(
+            e.num_pages,
+            e.page_size,
+            worker_id=e.worker_id,
+            on_event=on_kv_event,
+            enable_prefix_caching=e.enable_prefix_caching,
+        )
+
+        B = e.max_decode_slots
+        self._slots: list[Optional[_Request]] = [None] * B
+        # host mirrors of decode-state device inputs
+        self._page_tables = np.zeros((B, e.max_pages_per_seq), np.int32)
+        self._ctx_lens = np.ones(B, np.int32)
+        self._tokens = np.zeros(B, np.int32)
+        # host mirrors of per-slot sampling params
+        self._samp = {
+            "temperature": np.zeros(B, np.float32),
+            "top_k": np.zeros(B, np.int32),
+            "top_p": np.ones(B, np.float32),
+            "frequency_penalty": np.zeros(B, np.float32),
+            "presence_penalty": np.zeros(B, np.float32),
+            "repetition_penalty": np.ones(B, np.float32),
+        }
+        self._samp_dirty = True
+        self._samp_dev: Optional[sampling.SamplingParams] = None
+        self._sampler_state = sampling.init_state(B, c.vocab_size, rng_seed)
+
+        self._intake: queue_mod.Queue = queue_mod.Queue()
+        self._waiting: list[_Request] = []
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._started = False
+        # stats
+        self.step_count = 0
+        self.tokens_generated = 0
+
+    # ------------------------------------------------------------------
+    # lifecycle
+
+    def start(self) -> None:
+        if self._started:
+            return
+        self._started = True
+        self._thread = threading.Thread(
+            target=self._run_loop, name="tpu-engine-loop", daemon=True
+        )
+        self._thread.start()
+
+    async def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            await asyncio.to_thread(self._thread.join, 10.0)
+
+    # ------------------------------------------------------------------
+    # AsyncEngine surface
+
+    async def generate(
+        self, request: PreprocessedRequest
+    ) -> AsyncIterator[LLMEngineOutput]:
+        """Stream engine outputs (token-id deltas) for one request."""
+        if not self._started:
+            self.start()
+        if len(request.token_ids) == 0:
+            raise ValueError("empty prompt")
+        if len(request.token_ids) >= self.ecfg.max_context:
+            raise ValueError(
+                f"prompt length {len(request.token_ids)} exceeds max context "
+                f"{self.ecfg.max_context}"
+            )
+        r = _Request(
+            req=request,
+            seq=TokenBlockSequence.from_tokens(
+                request.token_ids, self.ecfg.page_size, salt=request.model
+            ),
+            out=asyncio.Queue(),
+            loop=asyncio.get_running_loop(),
+        )
+        self._intake.put(r)
+        try:
+            while True:
+                item = await r.out.get()
+                if isinstance(item, Exception):
+                    raise item
+                yield item
+                if item.finished:
+                    return
+        finally:
+            r.cancelled = True
+
+    def metrics(self) -> ForwardPassMetrics:
+        a = self.allocator
+        return ForwardPassMetrics(
+            worker_id=self.ecfg.worker_id,
+            worker_stats=WorkerStats(
+                request_active_slots=sum(s is not None for s in self._slots),
+                request_total_slots=len(self._slots),
+                num_requests_waiting=len(self._waiting) + self._intake.qsize(),
+            ),
+            kv_stats=KvStats(
+                kv_active_blocks=a.active_pages,
+                kv_total_blocks=a.total_pages,
+                gpu_cache_usage_perc=a.usage(),
+                gpu_prefix_cache_hit_rate=a.hit_rate(),
+            ),
+        )
+
+    # ------------------------------------------------------------------
+    # step loop (engine thread)
+
+    def _run_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                did_work = self._step()
+            except Exception:  # noqa: BLE001 — engine loop must survive
+                log.exception("engine step failed")
+                self._fail_all(RuntimeError("engine step failed; see logs"))
+                did_work = False
+            if not did_work:
+                try:
+                    r = self._intake.get(timeout=0.02)
+                    self._waiting.append(r)
+                except queue_mod.Empty:
+                    pass
+
+    def _step(self) -> bool:
+        self._drain_intake()
+        self._admit()
+        active = [s for s in self._slots if s is not None]
+        if not active:
+            return False
+        self._reap_cancelled()
+        active = [s for s in self._slots if s is not None]
+        if not active:
+            return False
+        self._decode_once()
+        if self.on_metrics is not None:
+            self.on_metrics(self.metrics())
+        return True
+
+    def _drain_intake(self) -> None:
+        while True:
+            try:
+                self._waiting.append(self._intake.get_nowait())
+            except queue_mod.Empty:
+                return
+
+    def _reap_cancelled(self) -> None:
+        for i, r in enumerate(self._slots):
+            if r is not None and r.cancelled:
+                self._release(r)
+        self._waiting = [r for r in self._waiting if not r.cancelled]
+
+    # ---- admission / prefill ----
+
+    def _admit(self) -> None:
+        while self._waiting and None in self._slots:
+            r = self._waiting[0]
+            if r.cancelled:
+                self._waiting.pop(0)
+                continue
+            if not self._try_prefill(r):
+                return  # head-of-line blocks until pages free up
+            self._waiting.pop(0)
+
+    def _try_prefill(self, r: _Request) -> bool:
+        e = self.ecfg
+        ps = e.page_size
+        prompt = r.req.token_ids
+        bucket = e.bucket_for(max(len(prompt), 1))
+        if bucket is None:
+            r.emit(ValueError(f"prompt longer than max bucket {e.prefill_buckets[-1]}"))
+            return True  # consumed (failed)
+
+        # prefix-cache match over complete prompt blocks; never match the
+        # whole prompt (the last block must be recomputed to get logits)
+        hashes = r.seq.block_hashes()
+        matched_pages = self.allocator.match_prefix(
+            hashes[: max(0, (len(prompt) - 1) // ps)]
+        )
+        n_cached = len(matched_pages) * ps
+        n_total_pages = (len(prompt) + ps - 1) // ps
+        fresh = self.allocator.allocate(n_total_pages - len(matched_pages))
+        if fresh is None:
+            self.allocator.free(matched_pages)
+            return False
+        r.pages = matched_pages + fresh
+        r.matched_blocks = len(matched_pages)
+
+        # pad the uncached suffix to a bucket (rounded to a page multiple)
+        suffix = prompt[n_cached:]
+        pad_t = e.bucket_for(max(len(suffix), 1))
+        if pad_t is not None:
+            pad_t = ((pad_t + ps - 1) // ps) * ps
+        if pad_t is None or n_cached // ps + pad_t // ps > e.max_pages_per_seq:
+            self.allocator.free(r.pages)
+            r.pages = []
+            r.emit(ValueError("prompt does not fit page table"))
+            return True
+        toks = np.zeros(pad_t, np.int32)
+        toks[: len(suffix)] = suffix
+        table = np.zeros(e.max_pages_per_seq, np.int32)
+        table[: len(r.pages)] = r.pages
+
+        self.cache, logits = llama.prefill(
+            self.config,
+            self.params,
+            self.cache,
+            jnp.asarray(toks),
+            jnp.asarray(table),
+            jnp.int32(n_cached),
+            jnp.int32(len(prompt)),
+        )
+        # commit complete prompt blocks beyond the matched prefix
+        for blk in r.seq.blocks[r.matched_blocks:]:
+            self.allocator.commit(
+                r.pages[blk.position], blk.block_hash, blk.parent_hash
+            )
+
+        first = self._sample_host(r, np.asarray(logits))
+        r.first_token_time = time.monotonic()
+        stop_ids = set(r.req.stop_conditions.stop_token_ids or [])
+        if not r.req.stop_conditions.ignore_eos and first in stop_ids:
+            self.allocator.free(r.pages)
+            r.pages = []
+            r.emit(LLMEngineOutput(token_ids=[], finish_reason=FinishReason.EOS))
+            return True
+        self._emit_token(r, first)
+        if r.produced >= r.max_new_tokens(e.max_context):
+            self.allocator.free(r.pages)
+            r.pages = []
+            r.emit(LLMEngineOutput(token_ids=[], finish_reason=FinishReason.LENGTH))
+            return True
+        self._assign_slot(r, first, table)
+        return True
+
+    def _assign_slot(self, r: _Request, first_token: int, table: np.ndarray) -> None:
+        slot = self._slots.index(None)
+        r.slot = slot
+        r.prefill_done = True
+        r.last_token = first_token
+        self._slots[slot] = r
+        self._page_tables[slot] = table
+        # context includes the pending first token (position prompt_len)
+        self._ctx_lens[slot] = r.seq.total_tokens + 1
+        self._tokens[slot] = first_token
+        so = r.req.sampling_options
+        self._samp["temperature"][slot] = so.temperature or 0.0
+        self._samp["top_k"][slot] = so.top_k or 0
+        self._samp["top_p"][slot] = so.top_p if so.top_p is not None else 1.0
+        self._samp["frequency_penalty"][slot] = so.frequency_penalty or 0.0
+        self._samp["presence_penalty"][slot] = so.presence_penalty or 0.0
+        self._samp["repetition_penalty"][slot] = so.repetition_penalty or 1.0
+        self._samp_dirty = True
+        self._sampler_state = sampling.reset_slot(
+            self._sampler_state, slot, so.seed if so.seed is not None else slot + 1
+        )
+
+    def _sample_host(self, r: _Request, logits: np.ndarray) -> int:
+        """First token after prefill — sampled host-side (once per request)."""
+        so = r.req.sampling_options
+        t = so.temperature or 0.0
+        if t <= 0.0:
+            return int(np.argmax(logits))
+        x = logits.astype(np.float64) / t
+        if so.top_k:
+            kth = np.partition(x, -so.top_k)[-so.top_k]
+            x = np.where(x < kth, -np.inf, x)
+        p = np.exp(x - np.max(x))
+        p /= p.sum()
+        if so.top_p is not None and so.top_p < 1.0:
+            order = np.argsort(-p)
+            cum = np.cumsum(p[order])
+            keep = np.zeros_like(p, bool)
+            keep[order[: max(1, int(np.searchsorted(cum, so.top_p) + 1))]] = True
+            p = np.where(keep, p, 0.0)
+            p /= p.sum()
+        rng = np.random.RandomState(so.seed if so.seed is not None else None)
+        return int(rng.choice(len(p), p=p))
+
+    # ---- decode ----
+
+    def _decode_once(self) -> None:
+        e = self.ecfg
+        ps = e.page_size
+        # grow page tables: slots whose NEXT written position opens a page.
+        # _ctx_lens already includes the pending token; its position is
+        # ctx_len-1 and must have a page before the step writes its KV.
+        for slot, r in enumerate(self._slots):
+            if r is None:
+                continue
+            pos = int(self._ctx_lens[slot]) - 1
+            if pos // ps >= len(r.pages):
+                pages = None
+                while pages is None:
+                    pages = self.allocator.allocate(1)
+                    if pages is None:
+                        self._preempt_lowest()  # may preempt r itself
+                        if self._slots[slot] is None:
+                            break
+                if self._slots[slot] is None or pages is None:
+                    continue
+                r.pages.extend(pages)
+                self._page_tables[slot, len(r.pages) - 1] = pages[0]
+
+        active_idx = [i for i, s in enumerate(self._slots) if s is not None]
+        if not active_idx:
+            return
+
+        if self._samp_dirty:
+            self._samp_dev = sampling.SamplingParams(
+                temperature=jnp.asarray(self._samp["temperature"]),
+                top_k=jnp.asarray(self._samp["top_k"]),
+                top_p=jnp.asarray(self._samp["top_p"]),
+                frequency_penalty=jnp.asarray(self._samp["frequency_penalty"]),
+                presence_penalty=jnp.asarray(self._samp["presence_penalty"]),
+                repetition_penalty=jnp.asarray(self._samp["repetition_penalty"]),
+            )
+            self._samp_dirty = False
+
+        self.cache, logits = llama.decode_step(
+            self.config,
+            self.params,
+            self.cache,
+            jnp.asarray(self._tokens),
+            jnp.asarray(self._page_tables),
+            jnp.asarray(self._ctx_lens),
+        )
+        tokens_dev, self._sampler_state = sampling.sample_step(
+            logits.astype(jnp.float32),
+            self._sampler_state,
+            self._samp_dev,
+            self.ecfg.max_top_k,
+        )
+        tokens = np.asarray(tokens_dev)
+        self.step_count += 1
+
+        for slot in active_idx:
+            r = self._slots[slot]
+            if r is None:
+                continue
+            # the token just processed was r.last_token at position ctx-1;
+            # seal/commit any block it completed
+            new_blocks = r.seq.extend([r.last_token]) if r.prefill_done else []
+            for blk in new_blocks:
+                if blk.position < len(r.pages):
+                    self.allocator.commit(
+                        r.pages[blk.position], blk.block_hash, blk.parent_hash
+                    )
+            tok = int(tokens[slot])
+            self.tokens_generated += 1
+            self._finish_or_continue(r, slot, tok)
+
+    def _emit_token(self, r: _Request, tok: int) -> None:
+        r.produced += 1
+        r.emit(LLMEngineOutput(token_ids=[tok]))
+
+    def _finish_or_continue(self, r: _Request, slot: int, tok: int) -> None:
+        sc = r.req.stop_conditions
+        stop_ids = set(sc.stop_token_ids or [])
+        if not sc.ignore_eos and tok in stop_ids and (
+            sc.min_tokens is None or r.produced >= sc.min_tokens
+        ):
+            r.emit(LLMEngineOutput(token_ids=[], finish_reason=FinishReason.EOS))
+            self._release(r)
+            return
+        r.produced += 1
+        if r.produced >= r.max_new_tokens(self.ecfg.max_context):
+            r.emit(
+                LLMEngineOutput(token_ids=[tok], finish_reason=FinishReason.LENGTH)
+            )
+            self._release(r)
+            return
+        r.emit(LLMEngineOutput(token_ids=[tok]))
+        r.last_token = tok
+        self._ctx_lens[slot] += 1
+        self._tokens[slot] = tok
+
+    # ---- preemption / release ----
+
+    def _preempt_lowest(self) -> None:
+        """Preempt the most recently admitted request (LIFO keeps older
+        requests making progress — mirrors vLLM recompute preemption)."""
+        victims = [s for s in self._slots if s is not None]
+        if not victims:
+            return
+        victim = max(victims, key=lambda r: r.enqueue_time)
+        self._preempt(victim)
+
+    def _preempt(self, r: _Request) -> None:
+        slot = r.slot
+        self.allocator.free(r.pages)
+        r.pages = []
+        r.prefill_done = False
+        # Restart with everything processed so far plus the pending token as
+        # the new prompt; re-prefill recomputes (matching any still-cached
+        # prefix pages) and resumes sampling where we left off. Emitted
+        # tokens are never re-emitted (prefill emits the NEXT token).
+        r.req.token_ids = r.seq.tokens + [r.last_token]
+        r.seq = TokenBlockSequence.from_tokens(
+            r.req.token_ids, self.ecfg.page_size, salt=r.req.model
+        )
+        self._clear_slot(slot)
+        r.slot = -1
+        self._waiting.insert(0, r)
+        log.info("preempted request %s", r.req.request_id)
+
+    def _release(self, r: _Request) -> None:
+        self.allocator.free(r.pages)
+        r.pages = []
+        if r.slot >= 0:
+            self._clear_slot(r.slot)
+        r.slot = -1
+
+    def _clear_slot(self, slot: int) -> None:
+        self._slots[slot] = None
+        self._page_tables[slot] = 0
+        self._ctx_lens[slot] = 1
+        self._tokens[slot] = 0
+
+    def _fail_all(self, err: Exception) -> None:
+        for r in list(self._slots):
+            if r is not None:
+                r.emit(err)
+                self._release(r)
+        for r in self._waiting:
+            r.emit(err)
+        self._waiting = []

@@ -1,0 +1,118 @@
+"""On-device batched sampling: greedy / temperature / top-k / top-p plus
+frequency, presence, and repetition penalties.
+
+All slots sample in one fused jit alongside the decode step — logits never
+leave HBM (contrast: the reference's engines sample inside vLLM; SURVEY.md
+§7 "sampling on-device"). Static shapes: top-k truncates to the engine-wide
+``max_top_k`` lanes, per-slot effective k/p mask within them.
+
+State is per decode slot and lives on device:
+  - ``keys``: per-slot PRNG keys (split per step -> reproducible per-request
+    streams from a request seed);
+  - ``counts``: per-slot output-token histograms for the penalty terms.
+"""
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+class SamplerState(NamedTuple):
+    keys: jnp.ndarray    # [B, 2] uint32 per-slot PRNG keys
+    counts: jnp.ndarray  # [B, V] int32 output-token histogram
+
+
+class SamplingParams(NamedTuple):
+    """Per-slot sampling knobs as device arrays (set on slot assignment)."""
+
+    temperature: jnp.ndarray          # [B] f32; <=0 means greedy
+    top_k: jnp.ndarray                # [B] i32; 0/negative disables
+    top_p: jnp.ndarray                # [B] f32; 1.0 disables
+    frequency_penalty: jnp.ndarray    # [B] f32
+    presence_penalty: jnp.ndarray     # [B] f32
+    repetition_penalty: jnp.ndarray   # [B] f32; 1.0 disables
+
+
+def init_state(batch: int, vocab: int, seed: int = 0) -> SamplerState:
+    base = jax.random.PRNGKey(seed)
+    keys = jax.random.split(base, batch)
+    return SamplerState(
+        keys=jnp.asarray(keys, jnp.uint32),
+        counts=jnp.zeros((batch, vocab), jnp.int32),
+    )
+
+
+def default_params(batch: int) -> SamplingParams:
+    return SamplingParams(
+        temperature=jnp.zeros(batch, jnp.float32),
+        top_k=jnp.zeros(batch, jnp.int32),
+        top_p=jnp.ones(batch, jnp.float32),
+        frequency_penalty=jnp.zeros(batch, jnp.float32),
+        presence_penalty=jnp.zeros(batch, jnp.float32),
+        repetition_penalty=jnp.ones(batch, jnp.float32),
+    )
+
+
+def apply_penalties(
+    logits: jnp.ndarray, counts: jnp.ndarray, p: SamplingParams
+) -> jnp.ndarray:
+    """OpenAI-style frequency/presence penalties + HF repetition penalty."""
+    seen = (counts > 0)
+    logits = logits - p.frequency_penalty[:, None] * counts.astype(jnp.float32)
+    logits = logits - p.presence_penalty[:, None] * seen.astype(jnp.float32)
+    rep = p.repetition_penalty[:, None]
+    penalized = jnp.where(logits > 0, logits / rep, logits * rep)
+    logits = jnp.where(seen, penalized, logits)
+    return logits
+
+
+@functools.partial(jax.jit, static_argnums=(3,), donate_argnums=(1,))
+def sample_step(
+    logits: jnp.ndarray,      # [B, V] f32
+    state: SamplerState,
+    params: SamplingParams,
+    max_top_k: int,
+) -> tuple[jnp.ndarray, SamplerState]:
+    """Sample one token per slot; returns (tokens [B] i32, new state)."""
+    B, V = logits.shape
+    logits = apply_penalties(logits, state.counts, params)
+
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    temps = jnp.maximum(params.temperature, 1e-6)[:, None]
+    vals, idxs = jax.lax.top_k(logits, max_top_k)     # [B, K]
+    scaled = vals / temps
+    pos = jnp.arange(max_top_k)[None, :]
+    k_eff = jnp.where(params.top_k <= 0, max_top_k, params.top_k)
+    mask_k = pos < jnp.minimum(k_eff, max_top_k)[:, None]
+    probs = jax.nn.softmax(jnp.where(mask_k, scaled, NEG_INF), axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    # nucleus: keep lanes whose cumulative prob (exclusive) is < top_p
+    mask_p = (cum - probs) < params.top_p[:, None]
+    final = jnp.where(mask_k & mask_p, scaled, NEG_INF)
+
+    def row(key, logit_row):
+        new_key, sub = jax.random.split(jax.random.wrap_key_data(key, impl="threefry2x32"))
+        choice = jax.random.categorical(sub, logit_row)
+        return jax.random.key_data(new_key), choice
+
+    new_keys, choice = jax.vmap(row)(state.keys, final)
+    sampled = jnp.take_along_axis(idxs, choice[:, None], axis=-1)[:, 0].astype(jnp.int32)
+
+    tokens = jnp.where(params.temperature <= 0.0, greedy, sampled)
+    counts = state.counts.at[jnp.arange(B), tokens].add(1)
+    return tokens, SamplerState(keys=new_keys, counts=counts)
+
+
+def reset_slot(state: SamplerState, slot: int, seed: int) -> SamplerState:
+    """Host-side slot (re)initialization on request assignment."""
+    key = jax.random.key_data(jax.random.PRNGKey(seed))
+    return SamplerState(
+        keys=state.keys.at[slot].set(key),
+        counts=state.counts.at[slot].set(0),
+    )

@@ -1,0 +1,7 @@
+"""KV-cache-aware routing subsystem.
+
+Mirrors the reference's first-class kv_router (lib/llm/src/kv_router/,
+SURVEY.md §2.3): engines publish block stored/removed events; a global radix
+indexer maps block hashes to the workers that hold them; the scheduler scores
+workers by prefix overlap + predicted load and softmax-samples one.
+"""

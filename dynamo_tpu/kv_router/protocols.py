@@ -1,0 +1,88 @@
+"""KV event and metrics wire types shared by engines, router, and planner.
+
+Parity: reference kv_router/protocols.rs — KvCacheEvent{Stored(parent_hash,
+blocks[]), Removed(hashes), Cleared} (protocols.rs:133-154) and
+ForwardPassMetrics{WorkerStats, KvStats} (protocols.rs:43-66).
+"""
+from __future__ import annotations
+
+import enum
+from dataclasses import asdict, dataclass, field
+from typing import Any, Optional
+
+
+class KvEventKind(str, enum.Enum):
+    STORED = "stored"
+    REMOVED = "removed"
+    CLEARED = "cleared"
+
+
+@dataclass
+class StoredBlock:
+    block_hash: int
+    tokens_hash: Optional[int] = None  # hash of this block's tokens alone
+
+
+@dataclass
+class KvCacheEvent:
+    """One cache mutation at a worker, broadcast on the event plane."""
+
+    kind: KvEventKind
+    worker_id: str = ""
+    event_id: int = 0
+    # STORED: blocks share one parent chain starting at parent_hash
+    parent_hash: Optional[int] = None
+    blocks: list[StoredBlock] = field(default_factory=list)
+    # REMOVED: hashes evicted
+    removed_hashes: list[int] = field(default_factory=list)
+
+    def to_dict(self) -> dict[str, Any]:
+        d = asdict(self)
+        d["kind"] = self.kind.value
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "KvCacheEvent":
+        d = dict(d)
+        d["kind"] = KvEventKind(d["kind"])
+        d["blocks"] = [StoredBlock(**b) for b in d.get("blocks", [])]
+        return cls(**d)
+
+
+@dataclass
+class KvStats:
+    """Paged-cache occupancy at a worker (reference KvStats)."""
+
+    kv_active_blocks: int = 0
+    kv_total_blocks: int = 0
+    gpu_cache_usage_perc: float = 0.0
+    gpu_prefix_cache_hit_rate: float = 0.0
+
+
+@dataclass
+class WorkerStats:
+    """Batch occupancy at a worker (reference WorkerStats)."""
+
+    request_active_slots: int = 0
+    request_total_slots: int = 0
+    num_requests_waiting: int = 0
+
+
+@dataclass
+class ForwardPassMetrics:
+    """Per-forward-pass load metrics published by every worker and scraped
+    by the router's EndpointCollector (reference protocols.rs:43-59)."""
+
+    worker_id: str = ""
+    worker_stats: WorkerStats = field(default_factory=WorkerStats)
+    kv_stats: KvStats = field(default_factory=KvStats)
+
+    def to_dict(self) -> dict[str, Any]:
+        return asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "ForwardPassMetrics":
+        d = dict(d)
+        d["worker_stats"] = WorkerStats(**d.get("worker_stats") or {})
+        d["kv_stats"] = KvStats(**d.get("kv_stats") or {})
+        return cls(**d)

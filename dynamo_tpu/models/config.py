@@ -34,10 +34,6 @@ class ModelConfig:
     tie_word_embeddings: bool = False
     model_type: str = "llama"
     dtype: str = "bfloat16"
-    # MoE (wide-EP family; 0 experts == dense)
-    num_experts: int = 0
-    num_experts_per_tok: int = 0
-    moe_intermediate_size: int = 0
 
     @property
     def rope_scaling_dict(self) -> Optional[dict[str, Any]]:

@@ -5,9 +5,14 @@ Design notes (TPU-first):
     layer axis and the decoder runs as one ``lax.scan`` — one compiled layer
     body regardless of depth (compile time stays flat from 4 to 80 layers).
   - The KV cache is a paged pool per layer: ``[L, num_pages, page_size,
-    kv_heads, head_dim]``; requests address it through page tables. Page 0 is
-    a reserved scratch page — padding/inactive writes land there so real
-    pages are never corrupted by masked lanes.
+    kv_heads, head_dim]``; requests address it through page tables. Page 0
+    is a reserved scratch page: page-table entries BEYOND a request's
+    allocated pages point at it, so whole-page padding writes and inactive
+    decode slots never corrupt real pages. Padding tokens within a
+    request's own tail page DO write garbage KV into that page's tail slots
+    — they are never valid context (masked by seq_len/ctx_len, and decode
+    overwrites them in order), but attention kernels MUST keep the validity
+    mask and the prefix cache must only ever share complete pages.
   - Tensor parallelism is pure GSPMD: `param_shardings`/`cache_shardings`
     put head/hidden dims on the ``tp`` mesh axis; XLA inserts the ICI
     collectives. No hand-written comm (contrast: reference engines use NCCL
@@ -132,11 +137,33 @@ def _mlp(h, wg, wu, wd):
     return (jax.nn.silu(h @ wg) * (h @ wu)) @ wd
 
 
+def _layer_body(c: ModelConfig, lp, h, cos, sin, write_kv, attend):
+    """Shared decoder-layer body for prefill and decode.
+
+    `write_kv(k_pages, v_pages, k, v)` scatters new KV into the page pool;
+    `attend(q, k_pages, v_pages)` runs attention over it. `h` is [N, H]
+    (N = padded tokens for prefill, batch slots for decode).
+    """
+    N = h.shape[0]
+    x = rms_norm(h, lp["ln1"], c.rms_norm_eps)
+    q = (x @ lp["wq"]).reshape(N, c.num_heads, c.head_dim)
+    k = (x @ lp["wk"]).reshape(N, c.num_kv_heads, c.head_dim)
+    v = (x @ lp["wv"]).reshape(N, c.num_kv_heads, c.head_dim)
+    q = apply_rope(q, cos, sin)
+    k = apply_rope(k, cos, sin)
+    k_pages, v_pages = write_kv(k, v)
+    attn = attend(q, k_pages, v_pages)
+    h = h + attn.reshape(N, c.q_dim) @ lp["wo"]
+    x2 = rms_norm(h, lp["ln2"], c.rms_norm_eps)
+    h = h + _mlp(x2, lp["wg"], lp["wu"], lp["wd"])
+    return h, (k_pages, v_pages)
+
+
 def _logits(config: ModelConfig, params: Params, h: jnp.ndarray) -> jnp.ndarray:
     h = rms_norm(h, params["norm_f"], config.rms_norm_eps)
-    if config.tie_word_embeddings:
-        return h.astype(jnp.float32) @ params["embed"].T.astype(jnp.float32)
-    return h.astype(jnp.float32) @ params["lm_head"].astype(jnp.float32)
+    w = params["embed"].T if config.tie_word_embeddings else params["lm_head"]
+    # f32 accumulation without materializing an f32 copy of the [H, V] matrix
+    return jnp.matmul(h, w, preferred_element_type=jnp.float32)
 
 
 # ---------------------------------------------------------------------------
@@ -183,24 +210,18 @@ def prefill(
 
     def layer_fn(h, xs):
         (lp, k_pages, v_pages) = xs
-        x = rms_norm(h, lp["ln1"], c.rms_norm_eps)
-        q = (x @ lp["wq"]).reshape(T, c.num_heads, c.head_dim)
-        k = (x @ lp["wk"]).reshape(T, c.num_kv_heads, c.head_dim)
-        v = (x @ lp["wv"]).reshape(T, c.num_kv_heads, c.head_dim)
-        q = apply_rope(q, cos, sin)
-        k = apply_rope(k, cos, sin)
-        # write new KV into the page pool
-        k_pages = k_pages.at[write_idx].set(
-            k.reshape(n_new_pages, ps, c.num_kv_heads, c.head_dim)
-        )
-        v_pages = v_pages.at[write_idx].set(
-            v.reshape(n_new_pages, ps, c.num_kv_heads, c.head_dim)
-        )
-        attn = prefill_attention(q, k_pages, v_pages, page_table, q_start, seq_len)
-        h = h + attn.reshape(T, c.q_dim) @ lp["wo"]
-        x2 = rms_norm(h, lp["ln2"], c.rms_norm_eps)
-        h = h + _mlp(x2, lp["wg"], lp["wu"], lp["wd"])
-        return h, (k_pages, v_pages)
+
+        def write_kv(k, v):
+            shape = (n_new_pages, ps, c.num_kv_heads, c.head_dim)
+            return (
+                k_pages.at[write_idx].set(k.reshape(shape)),
+                v_pages.at[write_idx].set(v.reshape(shape)),
+            )
+
+        def attend(q, kp, vp):
+            return prefill_attention(q, kp, vp, page_table, q_start, seq_len)
+
+        return _layer_body(c, lp, h, cos, sin, write_kv, attend)
 
     h, (k_new, v_new) = jax.lax.scan(
         layer_fn, h, (params["layers"], cache["k"], cache["v"])
@@ -241,19 +262,17 @@ def decode_step(
 
     def layer_fn(h, xs):
         (lp, k_pages, v_pages) = xs
-        x = rms_norm(h, lp["ln1"], c.rms_norm_eps)
-        q = (x @ lp["wq"]).reshape(B, c.num_heads, c.head_dim)
-        k = (x @ lp["wk"]).reshape(B, c.num_kv_heads, c.head_dim)
-        v = (x @ lp["wv"]).reshape(B, c.num_kv_heads, c.head_dim)
-        q = apply_rope(q, cos, sin)
-        k = apply_rope(k, cos, sin)
-        k_pages = k_pages.at[page_idx, offset].set(k)
-        v_pages = v_pages.at[page_idx, offset].set(v)
-        attn = paged_decode_attention(q, k_pages, v_pages, page_tables, ctx_lens)
-        h = h + attn.reshape(B, c.q_dim) @ lp["wo"]
-        x2 = rms_norm(h, lp["ln2"], c.rms_norm_eps)
-        h = h + _mlp(x2, lp["wg"], lp["wu"], lp["wd"])
-        return h, (k_pages, v_pages)
+
+        def write_kv(k, v):
+            return (
+                k_pages.at[page_idx, offset].set(k),
+                v_pages.at[page_idx, offset].set(v),
+            )
+
+        def attend(q, kp, vp):
+            return paged_decode_attention(q, kp, vp, page_tables, ctx_lens)
+
+        return _layer_body(c, lp, h, cos, sin, write_kv, attend)
 
     h, (k_new, v_new) = jax.lax.scan(
         layer_fn, h, (params["layers"], cache["k"], cache["v"])
@@ -306,19 +325,34 @@ def params_from_state_dict(
     return params
 
 
-def load_hf_params(config: ModelConfig, model_dir: str, dtype=None) -> Params:
-    """Load llama safetensors weights from a local HF model directory."""
+def load_hf_params(
+    config: ModelConfig, model_dir: str, dtype=None, shardings: Params | None = None
+) -> Params:
+    """Load llama safetensors weights from a local HF model directory.
+
+    Tensors are read and stacked on the host CPU (never staged through an
+    accelerator); with `shardings` each stacked leaf is device_put straight
+    to its target sharding, so peak accelerator memory is one sharded copy.
+    """
     import glob
     import os
 
     from safetensors import safe_open
 
-    raw: dict[str, jnp.ndarray] = {}
     files = sorted(glob.glob(os.path.join(model_dir, "*.safetensors")))
     if not files:
         raise FileNotFoundError(f"no safetensors in {model_dir}")
-    for fp in files:
-        with safe_open(fp, framework="flax") as f:
-            for name in f.keys():
-                raw[name] = f.get_tensor(name)
-    return params_from_state_dict(config, raw, dtype)
+    cpu = jax.devices("cpu")[0]
+    with jax.default_device(cpu):
+        raw: dict[str, jnp.ndarray] = {}
+        for fp in files:
+            with safe_open(fp, framework="flax") as f:
+                for name in f.keys():
+                    raw[name] = f.get_tensor(name)
+        params = params_from_state_dict(config, raw, dtype)
+        del raw
+    if shardings is not None:
+        params = jax.tree.map(
+            lambda x, s: jax.device_put(x, s), params, shardings
+        )
+    return params

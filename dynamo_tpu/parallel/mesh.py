@@ -18,7 +18,7 @@ from dataclasses import dataclass
 
 import jax
 import numpy as np
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh
 
 AXIS_DATA = "dp"
 AXIS_SEQ = "sp"
@@ -61,11 +61,3 @@ def make_mesh(config: MeshConfig | None = None, devices=None) -> Mesh:
     shape = tuple(config.axis_sizes()[a] for a in AXIS_ORDER)
     dev = np.asarray(devices[:n]).reshape(shape)
     return Mesh(dev, AXIS_ORDER)
-
-
-def named(mesh: Mesh, *spec) -> NamedSharding:
-    return NamedSharding(mesh, P(*spec))
-
-
-def replicated(mesh: Mesh) -> NamedSharding:
-    return NamedSharding(mesh, P())

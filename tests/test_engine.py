@@ -1,0 +1,256 @@
+"""Engine tests: page allocator semantics + continuous-batching engine
+correctness on the tiny CPU model.
+
+The keystone equivalence test runs the full async engine greedily and checks
+its tokens equal a hand-driven prefill/decode loop on the raw model — any
+scheduler off-by-one (ctx lengths, page growth, commit timing) breaks it.
+"""
+import asyncio
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from dynamo_tpu.engine.cache import PageAllocator
+from dynamo_tpu.engine.config import EngineConfig
+from dynamo_tpu.engine.engine import TpuEngine
+from dynamo_tpu.kv_router.protocols import KvEventKind
+from dynamo_tpu.models import llama
+from dynamo_tpu.models.config import ModelConfig
+from dynamo_tpu.protocols.common import (
+    PreprocessedRequest,
+    SamplingOptions,
+    StopConditions,
+)
+from dynamo_tpu.tokens import compute_block_hashes
+
+PS = 16
+
+
+# ---------------------------------------------------------------------------
+# PageAllocator
+
+def test_allocator_alloc_free_reuse():
+    a = PageAllocator(num_pages=8, page_size=PS)
+    p = a.allocate(3)
+    assert p is not None and len(set(p)) == 3 and 0 not in p
+    assert a.active_pages == 3
+    a.free(p)
+    assert a.active_pages == 0
+    assert a.allocate(7) is not None
+    assert a.allocate(1) is None  # exhausted (7 real pages)
+
+
+def test_allocator_prefix_reuse_and_eviction():
+    events = []
+    a = PageAllocator(num_pages=6, page_size=PS, on_event=events.append)
+    hashes = compute_block_hashes(list(range(PS * 3)), PS)
+    pages = a.allocate(3)
+    parent = 0
+    for pg, h in zip(pages, hashes):
+        assert a.commit(pg, h, parent)
+        parent = h
+    assert [e.kind for e in events] == [KvEventKind.STORED] * 3
+    a.free(pages)
+    # all three parked in LRU, still matchable
+    m = a.match_prefix(hashes)
+    assert m == pages
+    a.free(m)
+    # allocation pressure evicts LRU-oldest first
+    p2 = a.allocate(5)
+    assert p2 is not None
+    removed = [e for e in events if e.kind == KvEventKind.REMOVED]
+    assert len(removed) == 3
+    assert removed[0].removed_hashes == [hashes[0]]
+    assert a.match_prefix(hashes) == []
+
+
+def test_allocator_refcounted_sharing():
+    a = PageAllocator(num_pages=6, page_size=PS)
+    hashes = compute_block_hashes(list(range(PS * 2)), PS)
+    pages = a.allocate(2)
+    a.commit(pages[0], hashes[0], 0)
+    a.commit(pages[1], hashes[1], hashes[0])
+    m1 = a.match_prefix(hashes)   # second ref
+    a.free(pages)                 # first user done; still referenced
+    assert a.available_pages == 3
+    a.free(m1)
+    assert a.available_pages == 5  # parked in LRU, available via eviction
+
+
+# ---------------------------------------------------------------------------
+# Engine
+
+@pytest.fixture(scope="module")
+def engine_setup():
+    cfg = ModelConfig.tiny(dtype="float32")
+    ecfg = EngineConfig(
+        num_pages=64,
+        page_size=PS,
+        max_pages_per_seq=8,
+        max_decode_slots=4,
+        prefill_buckets=(32, 64),
+        cache_dtype="float32",
+        worker_id="w0",
+    )
+    params = llama.init_params(cfg, 0)
+    return cfg, ecfg, params
+
+
+def make_engine(engine_setup, **kw):
+    cfg, ecfg, params = engine_setup
+    from dataclasses import replace
+
+    if kw:
+        ecfg = replace(ecfg, **kw)
+    from dynamo_tpu.parallel.mesh import MeshConfig
+
+    return TpuEngine(
+        cfg, ecfg, params=params, mesh_config=MeshConfig(tp=1)
+    )
+
+
+async def collect(engine, req):
+    toks, finish = [], None
+    async for out in engine.generate(req):
+        toks.extend(out.token_ids)
+        if out.finish_reason:
+            finish = out.finish_reason
+    return toks, finish
+
+
+def manual_greedy(cfg, params, ecfg, prompt, n_new):
+    """Hand-driven reference loop on the raw model."""
+    cache = llama.init_cache(cfg, ecfg.num_pages, ecfg.page_size, jnp.float32)
+    ps = ecfg.page_size
+    n_pages = (len(prompt) + ps - 1) // ps
+    table = np.zeros(ecfg.max_pages_per_seq, np.int32)
+    table[:n_pages] = np.arange(1, n_pages + 1)
+    pad = ((len(prompt) + 31) // 32) * 32
+    toks = np.zeros(pad, np.int32)
+    toks[: len(prompt)] = prompt
+    cache, logits = llama.prefill(
+        cfg, params, cache, jnp.asarray(toks), jnp.asarray(table),
+        jnp.int32(0), jnp.int32(len(prompt)),
+    )
+    out = [int(np.argmax(np.asarray(logits)))]
+    seq_len = len(prompt)
+    ptb = np.zeros((1, ecfg.max_pages_per_seq), np.int32)
+    for _ in range(n_new - 1):
+        seq_len += 1
+        pos = seq_len - 1
+        if pos // ps >= n_pages:
+            n_pages += 1
+            table[n_pages - 1] = n_pages
+        ptb[0] = table
+        cache, lg = llama.decode_step(
+            cfg, params, cache,
+            jnp.asarray([out[-1]], jnp.int32), jnp.asarray(ptb),
+            jnp.asarray([seq_len], jnp.int32),
+        )
+        out.append(int(np.argmax(np.asarray(lg)[0])))
+    return out
+
+
+async def test_engine_matches_manual_loop(engine_setup):
+    cfg, ecfg, params = engine_setup
+    eng = make_engine(engine_setup)
+    prompt = list(range(1, 25))  # 24 tokens: crosses a page boundary quickly
+    n_new = 20
+    req = PreprocessedRequest(
+        token_ids=list(prompt),
+        stop_conditions=StopConditions(max_tokens=n_new, ignore_eos=True),
+    )
+    toks, finish = await collect(eng, req)
+    ref = manual_greedy(cfg, params, ecfg, prompt, n_new)
+    assert toks == ref
+    assert finish is not None and finish.value == "length"
+    await eng.stop()
+
+
+async def test_engine_concurrent_requests_deterministic(engine_setup):
+    eng = make_engine(engine_setup)
+    prompts = [list(range(1 + i, 20 + i)) for i in range(6)]  # > slot count
+
+    async def one(p):
+        req = PreprocessedRequest(
+            token_ids=list(p),
+            stop_conditions=StopConditions(max_tokens=10, ignore_eos=True),
+        )
+        return (await collect(eng, req))[0]
+
+    batch = await asyncio.gather(*[one(p) for p in prompts])
+    solo = [await one(p) for p in prompts]
+    assert batch == solo  # batching must not change greedy results
+    await eng.stop()
+
+
+async def test_engine_prefix_cache_hit(engine_setup):
+    eng = make_engine(engine_setup)
+    prompt = list(range(1, 40))  # 39 tokens = 2 complete blocks + tail
+    req = lambda: PreprocessedRequest(  # noqa: E731
+        token_ids=list(prompt),
+        stop_conditions=StopConditions(max_tokens=4, ignore_eos=True),
+    )
+    t1, _ = await collect(eng, req())
+    hits_before = eng.allocator.hit_blocks
+    t2, _ = await collect(eng, req())
+    assert t1 == t2
+    assert eng.allocator.hit_blocks > hits_before  # 2 blocks re-matched
+    await eng.stop()
+
+
+async def test_engine_eos_stop(engine_setup):
+    eng = make_engine(engine_setup)
+    prompt = list(range(1, 20))
+    base = PreprocessedRequest(
+        token_ids=list(prompt),
+        stop_conditions=StopConditions(max_tokens=8, ignore_eos=True),
+    )
+    toks, _ = await collect(eng, base)
+    eos = toks[2]  # pretend the 3rd generated token is EOS
+    req = PreprocessedRequest(
+        token_ids=list(prompt),
+        stop_conditions=StopConditions(max_tokens=8, stop_token_ids=[eos]),
+    )
+    toks2, finish = await collect(eng, req)
+    assert toks2 == toks[:2]
+    assert finish.value == "eos"
+    await eng.stop()
+
+
+async def test_engine_preemption_under_pressure(engine_setup):
+    # 15 real pages, 4 slots x up to 8 pages each -> guaranteed pressure
+    eng = make_engine(engine_setup, num_pages=16)
+    prompts = [list(range(1 + 7 * i, 30 + 7 * i)) for i in range(4)]
+
+    async def one(p):
+        req = PreprocessedRequest(
+            token_ids=list(p),
+            stop_conditions=StopConditions(max_tokens=40, ignore_eos=True),
+        )
+        return (await collect(eng, req))[0]
+
+    outs = await asyncio.gather(*[one(p) for p in prompts])
+    assert all(len(o) == 40 for o in outs)
+    # preemption must preserve greedy determinism
+    solo = await one(prompts[0])
+    assert outs[0] == solo
+    await eng.stop()
+
+
+async def test_engine_sampling_seeded(engine_setup):
+    eng = make_engine(engine_setup)
+    req = lambda seed: PreprocessedRequest(  # noqa: E731
+        token_ids=list(range(1, 20)),
+        stop_conditions=StopConditions(max_tokens=8, ignore_eos=True),
+        sampling_options=SamplingOptions(temperature=0.8, top_k=20, seed=seed),
+    )
+    a, _ = await collect(eng, req(7))
+    b, _ = await collect(eng, req(7))
+    c, _ = await collect(eng, req(8))
+    assert a == b
+    assert len(a) == 8
+    assert a != c or True  # different seed usually differs; no hard guarantee
+    await eng.stop()
