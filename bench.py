@@ -37,11 +37,18 @@ async def run_bench() -> dict:
         prompt_len, max_tokens, n_requests = 48, 32, 8
     else:
         cfg = ModelConfig.llama3_1b()
+        # Sizing notes for the dev chip (axon tunnel): D2H latency ~80ms
+        # needs a deep dispatch pipeline, and the backend pays a full
+        # copy-on-write of the page pool per step (no in-place buffer
+        # aliasing through the tunnel), so the pool is sized to the
+        # workload (32 slots x 12 pages x 64 tok = 24k tokens) instead of
+        # all of HBM. On real TPU VMs neither constraint applies.
         ecfg = EngineConfig(
-            num_pages=1024, page_size=64, max_pages_per_seq=32,
-            max_decode_slots=16, prefill_buckets=(128,),
+            num_pages=416, page_size=64, max_pages_per_seq=16,
+            max_decode_slots=32, prefill_buckets=(128,),
+            flush_every=32, max_inflight_rounds=8,
         )
-        prompt_len, max_tokens, n_requests = 100, 256, 16
+        prompt_len, max_tokens, n_requests = 100, 512, 32
 
     eng = TpuEngine(cfg, ecfg, mesh_config=MeshConfig(tp=1))
     eng.start()

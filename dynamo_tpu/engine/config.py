@@ -23,6 +23,14 @@ class EngineConfig:
     max_decode_slots: int = 8     # fixed decode batch width
     prefill_buckets: tuple[int, ...] = field(default_factory=_default_buckets)
 
+    # pipelining: steps per dispatched round (one stacked token fetch per
+    # round) and rounds allowed in flight before the loop blocks on results.
+    # Effective host lag = flush_every * (max_inflight_rounds + 1) steps —
+    # finished requests garbage-decode for up to that many steps, so raise
+    # these only when D2H latency is high relative to step time.
+    flush_every: int = 4
+    max_inflight_rounds: int = 2
+
     # sampling
     max_top_k: int = 64           # static top-k width for top-p/top-k sampling
 

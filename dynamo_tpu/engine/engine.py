@@ -1,26 +1,40 @@
-"""TpuEngine: continuous batching over the paged-KV JAX model.
+"""TpuEngine: pipelined continuous batching over the paged-KV JAX model.
 
 Architecture (TPU-first redesign of what the reference delegates to vLLM —
-SURVEY.md §7 step 3):
+SURVEY.md §7 step 3). The defining constraint is that device→host reads
+have high latency (µs on PCIe TPU VMs, ~80ms through a tunneled dev chip)
+while dispatches and host→device uploads are cheap and asynchronous. The
+engine therefore NEVER blocks a decode step on host data:
 
-  - One fixed-width decode batch of ``max_decode_slots`` slots steps every
-    iteration; each slot is one in-flight request. Static shapes — exactly
-    one compiled decode program.
-  - Prefill runs per request at one of a few bucketed padded lengths (one
-    compiled program per bucket), writing prompt KV straight into pages,
-    reusing any cached prefix pages (chained-hash match).
-  - A host-side step loop (dedicated thread — JAX dispatch is async, the
-    loop only blocks on the sampled-token transfer) drives admission,
-    page growth, block commit/publish, stop conditions, and preemption.
-  - Sampling is fused on device; only sampled token ids cross to host.
+  - All decode state lives on device: last tokens, context lengths, page
+    tables, context caps, sampler keys/counts, per-slot sampling params.
+    One fused jit (decode + sample + state advance) steps every slot.
+  - The host loop dispatches steps ahead in rounds of ``flush_every``; each
+    round's sampled tokens are stacked on device ([F, B]) and fetched with
+    ``copy_to_host_async`` — fetches pipeline behind compute, so results
+    arrive a bounded LAG behind dispatch without ever stalling the device.
+  - Host processing (token emission, stop detection, block sealing/commit,
+    page growth, admission, preemption) runs on lagged results. State
+    changes are applied via a patch jit dispatched between rounds —
+    device-order semantics make this race-free: a step dispatched before a
+    patch sees pre-patch state, and page writes it performs land before
+    any later prefill that reuses those pages.
+  - Slots finished on host keep garbage-decoding until their release patch
+    lands (≤ pipeline lag steps). Safety: garbage writes only ever touch
+    the slot's own uncommitted tail pages, pre-allocated private pages, or
+    the reserved scratch page 0 — a finished request's final sealed block
+    is deliberately NOT committed to the prefix cache (see _finish).
+  - Prefill runs per request at bucketed padded lengths; the first token is
+    sampled on device and patched into the slot without a host round trip.
 
 The engine implements the AsyncEngine contract: ``generate(request)`` yields
-LLMEngineOutput deltas; cancellation propagates via the iterator being
-dropped (reference engine.rs:124-140 AsyncEngineContext::stop_generating).
+LLMEngineOutput deltas; dropping the iterator cancels (reference
+engine.rs:124-140 AsyncEngineContext::stop_generating).
 """
 from __future__ import annotations
 
 import asyncio
+import functools
 import logging
 import queue as queue_mod
 import threading
@@ -53,6 +67,8 @@ from dynamo_tpu.tokens import TokenBlockSequence
 
 log = logging.getLogger(__name__)
 
+_FIRST_TOKEN_KEY_TAG = 0x46697273  # distinct PRNG stream for first tokens
+
 
 @dataclass
 class _Request:
@@ -61,12 +77,12 @@ class _Request:
     out: asyncio.Queue
     loop: asyncio.AbstractEventLoop
     pages: list[int] = field(default_factory=list)
-    matched_blocks: int = 0       # prefix-cache hit depth (blocks)
+    matched_blocks: int = 0
     slot: int = -1
     produced: int = 0
-    last_token: int = 0
+    last_token: int = -1          # newest processed token, not yet in seq
     cancelled: bool = False
-    prefill_done: bool = False
+    finished: bool = False
     enqueue_time: float = field(default_factory=time.monotonic)
     first_token_time: Optional[float] = None
 
@@ -83,8 +99,22 @@ class _Request:
         self.loop.call_soon_threadsafe(self.out.put_nowait, item)
 
 
+@dataclass
+class _Entry:
+    """One in-flight fetch: either a round of stacked step tokens or a
+    request's prefill first-token."""
+
+    kind: str                      # "round" | "first"
+    handle: Any                    # device array being copied to host
+    # round:
+    slots: list[Optional[_Request]] = field(default_factory=list)  # snapshot
+    n_steps: int = 0
+    # first:
+    request: Optional[_Request] = None
+
+
 class TpuEngine:
-    """Continuous-batching paged-KV engine on a jax mesh."""
+    """Pipelined continuous-batching paged-KV engine on a jax mesh."""
 
     def __init__(
         self,
@@ -115,40 +145,128 @@ class TpuEngine:
             llama.cache_shardings(c, self.mesh),
         )
         self.allocator = PageAllocator(
-            e.num_pages,
-            e.page_size,
+            e.num_pages, e.page_size,
             worker_id=e.worker_id,
             on_event=on_kv_event,
             enable_prefix_caching=e.enable_prefix_caching,
         )
 
         B = e.max_decode_slots
+        self._B = B
         self._slots: list[Optional[_Request]] = [None] * B
-        # host mirrors of decode-state device inputs
-        self._page_tables = np.zeros((B, e.max_pages_per_seq), np.int32)
-        self._ctx_lens = np.ones(B, np.int32)
-        self._tokens = np.zeros(B, np.int32)
-        # host mirrors of per-slot sampling params
-        self._samp = {
-            "temperature": np.zeros(B, np.float32),
-            "top_k": np.zeros(B, np.int32),
-            "top_p": np.ones(B, np.float32),
-            "frequency_penalty": np.zeros(B, np.float32),
-            "presence_penalty": np.zeros(B, np.float32),
-            "repetition_penalty": np.ones(B, np.float32),
+        # host mirrors of dispatch-time state (exactly track device values)
+        self._pt_disp = np.zeros((B, e.max_pages_per_seq), np.int32)
+        self._ctx_disp = np.ones(B, np.int32)
+        self._cap_disp = np.full(B, e.page_size, np.int32)
+
+        # device state dict (page tables stay host-side — uploaded
+        # width-bucketed per round, so the attention grid tracks actual use)
+        self._dev = {
+            "tokens": jnp.zeros(B, jnp.int32),
+            "ctx": jnp.ones(B, jnp.int32),
+            "cap": jnp.full((B,), e.page_size, jnp.int32),
+            "keys": jnp.zeros((B, 2), jnp.uint32),
+            "counts": jnp.zeros((B, c.vocab_size), jnp.int32),
+            "temp": jnp.zeros(B, jnp.float32),
+            "top_k": jnp.zeros(B, jnp.int32),
+            "top_p": jnp.ones(B, jnp.float32),
+            "freq": jnp.zeros(B, jnp.float32),
+            "pres": jnp.zeros(B, jnp.float32),
+            "rep": jnp.ones(B, jnp.float32),
         }
-        self._samp_dirty = True
-        self._samp_dev: Optional[sampling.SamplingParams] = None
-        self._sampler_state = sampling.init_state(B, c.vocab_size, rng_seed)
+
+        self._build_jits()
 
         self._intake: queue_mod.Queue = queue_mod.Queue()
         self._waiting: list[_Request] = []
+        self._entries: list[_Entry] = []
+        self._grow_dirty: set[int] = set()
+        self._to_release: list[_Request] = []
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._started = False
-        # stats
         self.step_count = 0
         self.tokens_generated = 0
+
+    # ------------------------------------------------------------------
+    # jitted programs
+
+    def _build_jits(self) -> None:
+        c, e = self.config, self.ecfg
+        max_top_k = e.max_top_k
+
+        @functools.partial(jax.jit, donate_argnums=(1, 2))
+        def engine_step(params, cache, dev, pt):
+            # pt is width-bucketed [B, W] (W = pow2 cover of the widest
+            # active page table) — narrow tables shrink the attention
+            # kernel's page grid; one compile per W bucket
+            cache, logits = llama.decode_step_impl(
+                c, params, cache, dev["tokens"], pt, dev["ctx"]
+            )
+            sp = sampling.SamplingParams(
+                temperature=dev["temp"], top_k=dev["top_k"], top_p=dev["top_p"],
+                frequency_penalty=dev["freq"], presence_penalty=dev["pres"],
+                repetition_penalty=dev["rep"],
+            )
+            toks, st = sampling.sample_step_impl(
+                logits, sampling.SamplerState(dev["keys"], dev["counts"]),
+                sp, max_top_k,
+            )
+            dev = dict(
+                dev,
+                tokens=toks,
+                ctx=jnp.minimum(dev["ctx"] + 1, dev["cap"]),
+                keys=st.keys,
+                counts=st.counts,
+            )
+            return cache, dev, toks
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def patch(
+            dev, clear_mask, grow_mask, cap_new,
+            admit_slot, admit_ctx, admit_tok, admit_keys,
+            admit_temp, admit_top_k, admit_top_p,
+            admit_freq, admit_pres, admit_rep,
+        ):
+            dev = dict(dev)
+            dev["cap"] = jnp.where(grow_mask | clear_mask, cap_new, dev["cap"])
+            dev["ctx"] = jnp.where(clear_mask, 1, dev["ctx"])
+            dev["tokens"] = jnp.where(clear_mask, 0, dev["tokens"])
+            dev["temp"] = jnp.where(clear_mask, 0.0, dev["temp"])
+            dev["counts"] = jnp.where(clear_mask[:, None], 0, dev["counts"])
+            # single admission (admit_slot == B sentinel -> all .at[] dropped)
+            s = admit_slot
+            dev["tokens"] = dev["tokens"].at[s].set(admit_tok[0])
+            dev["ctx"] = dev["ctx"].at[s].set(admit_ctx)
+            dev["keys"] = dev["keys"].at[s].set(admit_keys)
+            dev["counts"] = dev["counts"].at[s].set(0)
+            dev["temp"] = dev["temp"].at[s].set(admit_temp)
+            dev["top_k"] = dev["top_k"].at[s].set(admit_top_k)
+            dev["top_p"] = dev["top_p"].at[s].set(admit_top_p)
+            dev["freq"] = dev["freq"].at[s].set(admit_freq)
+            dev["pres"] = dev["pres"].at[s].set(admit_pres)
+            dev["rep"] = dev["rep"].at[s].set(admit_rep)
+            return dev
+
+        @functools.partial(jax.jit, static_argnums=(5,))
+        def sample_first(logits, key, temp, top_k, top_p, vocab):
+            st = sampling.SamplerState(
+                keys=key[None], counts=jnp.zeros((1, vocab), jnp.int32)
+            )
+            sp = sampling.SamplingParams(
+                temperature=temp[None], top_k=top_k[None], top_p=top_p[None],
+                frequency_penalty=jnp.zeros(1), presence_penalty=jnp.zeros(1),
+                repetition_penalty=jnp.ones(1),
+            )
+            toks, _ = sampling.sample_step_impl(logits[None], st, sp, max_top_k)
+            return toks  # [1] i32
+
+        stack = jax.jit(lambda *ts: jnp.stack(ts))
+
+        self._engine_step = engine_step
+        self._patch = patch
+        self._sample_first = sample_first
+        self._stack = stack
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -165,7 +283,7 @@ class TpuEngine:
     async def stop(self) -> None:
         self._stop.set()
         if self._thread:
-            await asyncio.to_thread(self._thread.join, 10.0)
+            await asyncio.to_thread(self._thread.join, 30.0)
 
     # ------------------------------------------------------------------
     # AsyncEngine surface
@@ -209,7 +327,7 @@ class TpuEngine:
             worker_id=self.ecfg.worker_id,
             worker_stats=WorkerStats(
                 request_active_slots=sum(s is not None for s in self._slots),
-                request_total_slots=len(self._slots),
+                request_total_slots=self._B,
                 num_requests_waiting=len(self._waiting) + self._intake.qsize(),
             ),
             kv_stats=KvStats(
@@ -221,37 +339,41 @@ class TpuEngine:
         )
 
     # ------------------------------------------------------------------
-    # step loop (engine thread)
+    # engine loop
 
     def _run_loop(self) -> None:
         while not self._stop.is_set():
             try:
-                did_work = self._step()
+                did_work = self._round()
             except Exception:  # noqa: BLE001 — engine loop must survive
-                log.exception("engine step failed")
+                log.exception("engine round failed")
                 self._fail_all(RuntimeError("engine step failed; see logs"))
                 did_work = False
             if not did_work:
                 try:
-                    r = self._intake.get(timeout=0.02)
-                    self._waiting.append(r)
+                    self._waiting.append(self._intake.get(timeout=0.02))
                 except queue_mod.Empty:
                     pass
 
-    def _step(self) -> bool:
+    def _round(self) -> bool:
+        """One scheduling round: process ready results, apply patches
+        (releases, admissions, page growth), dispatch a round of steps."""
+        e = self.ecfg
         self._drain_intake()
+        rounds_in_flight = sum(1 for en in self._entries if en.kind == "round")
+        self._process_entries(block=rounds_in_flight > e.max_inflight_rounds)
+        self._apply_releases()
         self._admit()
-        active = [s for s in self._slots if s is not None]
-        if not active:
-            return False
-        self._reap_cancelled()
-        active = [s for s in self._slots if s is not None]
-        if not active:
-            return False
-        self._decode_once()
+
+        active = [i for i, s in enumerate(self._slots) if s is not None]
+        did_work = bool(self._entries)
+        rounds_in_flight = sum(1 for en in self._entries if en.kind == "round")
+        if active and rounds_in_flight <= e.max_inflight_rounds:
+            self._dispatch_round(active)
+            did_work = True
         if self.on_metrics is not None:
             self.on_metrics(self.metrics())
-        return True
+        return did_work
 
     def _drain_intake(self) -> None:
         while True:
@@ -260,35 +382,126 @@ class TpuEngine:
             except queue_mod.Empty:
                 return
 
-    def _reap_cancelled(self) -> None:
-        for i, r in enumerate(self._slots):
-            if r is not None and r.cancelled:
-                self._release(r)
-        self._waiting = [r for r in self._waiting if not r.cancelled]
+    # ---- dispatch side ----
+
+    def _dispatch_round(self, active: list[int]) -> None:
+        """Dispatch flush_every fused steps + one stacked-token fetch."""
+        e = self.ecfg
+        n = e.flush_every
+        if not self._ensure_coverage(active, n):
+            active = [i for i, s in enumerate(self._slots) if s is not None]
+            if not active:
+                return
+        # width-bucketed page-table upload (uploads are cheap/async)
+        widest = max(
+            (len(self._slots[i].pages) for i in active), default=1
+        )
+        w = 2
+        while w < widest:
+            w *= 2
+        w = min(w, e.max_pages_per_seq)
+        pt_dev = jnp.asarray(self._pt_disp[:, :w])
+        handles = []
+        for _ in range(n):
+            self.cache, self._dev, toks = self._engine_step(
+                self.params, self.cache, self._dev, pt_dev
+            )
+            handles.append(toks)
+            self._ctx_disp = np.minimum(self._ctx_disp + 1, self._cap_disp)
+            self.step_count += 1
+        stacked = self._stack(*handles)
+        stacked.copy_to_host_async()
+        self._entries.append(
+            _Entry(
+                kind="round",
+                handle=stacked,
+                slots=list(self._slots),
+                n_steps=n,
+            )
+        )
+
+    def _ensure_coverage(self, active: list[int], n_steps: int) -> bool:
+        """Make every active slot's page table cover the positions the next
+        n_steps will write; allocate/preempt as needed. Returns False if any
+        preemption happened (caller must recompute the active set)."""
+        e = self.ecfg
+        ps = e.page_size
+        clean = True
+        for slot in list(active):
+            r = self._slots[slot]
+            if r is None or r.finished:
+                continue  # finished slots garbage-write within their cap
+            # last position written in this round = ctx_disp - 1 + n_steps
+            need_pos = min(int(self._ctx_disp[slot]) - 1 + n_steps,
+                           e.max_context - 1)
+            need_pages = need_pos // ps + 1
+            while len(r.pages) < need_pages:
+                got = self.allocator.allocate(1)
+                if got is None:
+                    self._preempt_for_space(slot)
+                    clean = False
+                    if self._slots[slot] is None:
+                        break
+                    continue
+                r.pages.extend(got)
+                self._pt_disp[slot, len(r.pages) - 1] = got[0]
+            if self._slots[slot] is not None:
+                new_cap = min(len(r.pages) * ps, e.max_context)
+                if new_cap != self._cap_disp[slot]:
+                    self._cap_disp[slot] = new_cap
+                    self._grow_dirty.add(slot)
+        if self._grow_dirty:
+            self._dispatch_patch(grow_slots=sorted(self._grow_dirty))
+            self._grow_dirty.clear()
+        return clean
+
+    def _dispatch_patch(
+        self,
+        grow_slots: list[int] = (),
+        clear_slots: list[int] = (),
+        admit: Optional[dict[str, Any]] = None,
+    ) -> None:
+        B = self._B
+        clear = np.zeros(B, bool)
+        grow = np.zeros(B, bool)
+        for s in clear_slots:
+            clear[s] = True
+        for s in grow_slots:
+            grow[s] = True
+        a = admit or {}
+        self._dev = self._patch(
+            self._dev,
+            jnp.asarray(clear),
+            jnp.asarray(grow),
+            jnp.asarray(self._cap_disp),
+            jnp.int32(a.get("slot", B)),
+            jnp.int32(a.get("ctx", 1)),
+            a.get("tok", jnp.zeros(1, jnp.int32)),
+            jnp.asarray(a.get("keys", np.zeros(2, np.uint32))),
+            jnp.float32(a.get("temp", 0.0)),
+            jnp.int32(a.get("top_k", 0)),
+            jnp.float32(a.get("top_p", 1.0)),
+            jnp.float32(a.get("freq", 0.0)),
+            jnp.float32(a.get("pres", 0.0)),
+            jnp.float32(a.get("rep", 1.0)),
+        )
 
     # ---- admission / prefill ----
 
     def _admit(self) -> None:
+        self._waiting = [r for r in self._waiting if not r.cancelled]
         while self._waiting and None in self._slots:
             r = self._waiting[0]
-            if r.cancelled:
-                self._waiting.pop(0)
-                continue
             if not self._try_prefill(r):
                 return  # head-of-line blocks until pages free up
             self._waiting.pop(0)
 
     def _try_prefill(self, r: _Request) -> bool:
+        """Prefill + on-device first-token sample + admission patch.
+        Returns False only when pages are unavailable."""
         e = self.ecfg
         ps = e.page_size
         prompt = r.req.token_ids
-        bucket = e.bucket_for(max(len(prompt), 1))
-        if bucket is None:
-            r.emit(ValueError(f"prompt longer than max bucket {e.prefill_buckets[-1]}"))
-            return True  # consumed (failed)
-
-        # prefix-cache match over complete prompt blocks; never match the
-        # whole prompt (the last block must be recomputed to get logits)
         hashes = r.seq.block_hashes()
         matched_pages = self.allocator.match_prefix(
             hashes[: max(0, (len(prompt) - 1) // ps)]
@@ -302,242 +515,242 @@ class TpuEngine:
         r.pages = matched_pages + fresh
         r.matched_blocks = len(matched_pages)
 
-        # pad the uncached suffix to a bucket (rounded to a page multiple)
-        suffix = prompt[n_cached:]
-        pad_t = e.bucket_for(max(len(suffix), 1))
-        if pad_t is not None:
-            pad_t = ((pad_t + ps - 1) // ps) * ps
-        if pad_t is None or n_cached // ps + pad_t // ps > e.max_pages_per_seq:
+        if n_total_pages > e.max_pages_per_seq:
             self.allocator.free(r.pages)
             r.pages = []
             r.emit(ValueError("prompt does not fit page table"))
             return True
-        toks = np.zeros(pad_t, np.int32)
-        toks[: len(suffix)] = suffix
-        table = np.zeros(e.max_pages_per_seq, np.int32)
-        table[: len(r.pages)] = r.pages
 
-        self.cache, logits = llama.prefill(
-            self.config,
-            self.params,
-            self.cache,
-            jnp.asarray(toks),
-            jnp.asarray(table),
-            jnp.int32(n_cached),
-            jnp.int32(len(prompt)),
-        )
+        # chunked prefill: prompts longer than the largest bucket run as a
+        # sequence of page-aligned continuation chunks (q_start advances);
+        # only the final chunk's logits matter
+        max_chunk = (
+            (e.prefill_buckets[-1] + ps - 1) // ps
+        ) * ps
+        logits = None
+        start = n_cached
+        while start < len(prompt):
+            chunk = prompt[start : start + max_chunk]
+            pad_t = e.bucket_for(len(chunk)) or max_chunk
+            pad_t = ((pad_t + ps - 1) // ps) * ps
+            toks = np.zeros(pad_t, np.int32)
+            toks[: len(chunk)] = chunk
+            # width-bucketed table (pow2 cover of pages in play); one
+            # compile per (bucket, width) pair
+            w = 2
+            while w < start // ps + pad_t // ps:
+                w *= 2
+            w = min(w, e.max_pages_per_seq)
+            table = np.zeros(w, np.int32)
+            table[: len(r.pages)] = r.pages[:w]
+            self.cache, logits = llama.prefill(
+                self.config, self.params, self.cache,
+                jnp.asarray(toks), jnp.asarray(table),
+                jnp.int32(start), jnp.int32(start + len(chunk)),
+            )
+            start += len(chunk)
         # commit complete prompt blocks beyond the matched prefix
         for blk in r.seq.blocks[r.matched_blocks:]:
             self.allocator.commit(
                 r.pages[blk.position], blk.block_hash, blk.parent_hash
             )
 
-        first = self._sample_host(r, np.asarray(logits))
-        r.first_token_time = time.monotonic()
-        stop_ids = set(r.req.stop_conditions.stop_token_ids or [])
-        if not r.req.stop_conditions.ignore_eos and first in stop_ids:
-            self.allocator.free(r.pages)
-            r.pages = []
-            r.emit(LLMEngineOutput(token_ids=[], finish_reason=FinishReason.EOS))
-            return True
-        self._emit_token(r, first)
-        if r.produced >= r.max_new_tokens(e.max_context):
-            self.allocator.free(r.pages)
-            r.pages = []
-            r.emit(LLMEngineOutput(token_ids=[], finish_reason=FinishReason.LENGTH))
-            return True
-        self._assign_slot(r, first, table)
-        return True
+        so = r.req.sampling_options
+        seed = so.seed if so.seed is not None else 0
+        first_tok = self._sample_first(
+            logits,
+            jnp.asarray(np.array([_FIRST_TOKEN_KEY_TAG, seed], np.uint32)),
+            jnp.float32(so.temperature or 0.0),
+            jnp.int32(so.top_k or 0),
+            jnp.float32(so.top_p if so.top_p is not None else 1.0),
+            self.config.vocab_size,
+        )
 
-    def _assign_slot(self, r: _Request, first_token: int, table: np.ndarray) -> None:
         slot = self._slots.index(None)
         r.slot = slot
-        r.prefill_done = True
-        r.last_token = first_token
         self._slots[slot] = r
-        self._page_tables[slot] = table
-        # context includes the pending first token (position prompt_len)
-        self._ctx_lens[slot] = r.seq.total_tokens + 1
-        self._tokens[slot] = first_token
-        so = r.req.sampling_options
-        self._samp["temperature"][slot] = so.temperature or 0.0
-        self._samp["top_k"][slot] = so.top_k or 0
-        self._samp["top_p"][slot] = so.top_p if so.top_p is not None else 1.0
-        self._samp["frequency_penalty"][slot] = so.frequency_penalty or 0.0
-        self._samp["presence_penalty"][slot] = so.presence_penalty or 0.0
-        self._samp["repetition_penalty"][slot] = so.repetition_penalty or 1.0
-        self._samp_dirty = True
-        self._sampler_state = sampling.reset_slot(
-            self._sampler_state, slot, so.seed if so.seed is not None else slot + 1
+        self._pt_disp[slot] = 0
+        self._pt_disp[slot, : len(r.pages)] = r.pages
+        self._ctx_disp[slot] = len(prompt) + 1
+        self._cap_disp[slot] = min(len(r.pages) * ps, e.max_context)
+        self._dispatch_patch(
+            grow_slots=[slot],
+            admit=dict(
+                slot=slot,
+                ctx=len(prompt) + 1,
+                tok=first_tok,
+                keys=np.array([0, seed if so.seed is not None else slot + 1],
+                              np.uint32),
+                temp=so.temperature or 0.0,
+                top_k=so.top_k or 0,
+                top_p=so.top_p if so.top_p is not None else 1.0,
+                freq=so.frequency_penalty or 0.0,
+                pres=so.presence_penalty or 0.0,
+                rep=so.repetition_penalty or 1.0,
+            ),
         )
+        # first token reaches the client via the async fetch pipeline
+        first_tok.copy_to_host_async()
+        self._entries.append(_Entry(kind="first", handle=first_tok, request=r))
+        return True
 
-    def _sample_host(self, r: _Request, logits: np.ndarray) -> int:
-        """First token after prefill — sampled host-side (once per request)."""
-        so = r.req.sampling_options
-        t = so.temperature or 0.0
-        if t <= 0.0:
-            return int(np.argmax(logits))
-        x = logits.astype(np.float64) / t
-        if so.top_k:
-            kth = np.partition(x, -so.top_k)[-so.top_k]
-            x = np.where(x < kth, -np.inf, x)
-        p = np.exp(x - np.max(x))
-        p /= p.sum()
-        if so.top_p is not None and so.top_p < 1.0:
-            order = np.argsort(-p)
-            cum = np.cumsum(p[order])
-            keep = np.zeros_like(p, bool)
-            keep[order[: max(1, int(np.searchsorted(cum, so.top_p) + 1))]] = True
-            p = np.where(keep, p, 0.0)
-            p /= p.sum()
-        rng = np.random.RandomState(so.seed if so.seed is not None else None)
-        return int(rng.choice(len(p), p=p))
+    # ---- processing side (lagged results) ----
 
-    # ---- decode ----
+    def _process_entries(self, block: bool = False) -> None:
+        while self._entries:
+            entry = self._entries[0]
+            if not block and not entry.handle.is_ready():
+                return
+            self._entries.pop(0)
+            data = np.asarray(entry.handle)
+            if entry.kind == "first":
+                self._process_first(entry.request, int(data[0]))
+            else:
+                self._process_round(entry, data)
+            block = False  # only force at most one blocking wait
 
-    def _decode_once(self) -> None:
-        e = self.ecfg
-        ps = e.page_size
-        # grow page tables: slots whose NEXT written position opens a page.
-        # _ctx_lens already includes the pending token; its position is
-        # ctx_len-1 and must have a page before the step writes its KV.
-        for slot, r in enumerate(self._slots):
-            if r is None:
-                continue
-            pos = int(self._ctx_lens[slot]) - 1
-            if pos // ps >= len(r.pages):
-                pages = None
-                while pages is None:
-                    pages = self.allocator.allocate(1)
-                    if pages is None:
-                        self._preempt_lowest()  # may preempt r itself
-                        if self._slots[slot] is None:
-                            break
-                if self._slots[slot] is None or pages is None:
-                    continue
-                r.pages.extend(pages)
-                self._page_tables[slot, len(r.pages) - 1] = pages[0]
-
-        active_idx = [i for i, s in enumerate(self._slots) if s is not None]
-        if not active_idx:
+    def _process_first(self, r: _Request, tok: int) -> None:
+        if r.cancelled or r.finished:
+            self._finish(r, None)
             return
+        if r.first_token_time is None:
+            r.first_token_time = time.monotonic()
+        sc = r.req.stop_conditions
+        if not sc.ignore_eos and tok in (sc.stop_token_ids or []) and (
+            sc.min_tokens is None or r.produced >= sc.min_tokens
+        ):
+            self._finish(r, FinishReason.EOS)
+            return
+        r.last_token = tok
+        r.produced += 1  # may continue a preempted request's count
+        r.emit(LLMEngineOutput(token_ids=[tok]))
+        if r.produced >= r.max_new_tokens(self.ecfg.max_context):
+            self._finish(r, FinishReason.LENGTH, emit_empty=True)
 
-        if self._samp_dirty:
-            self._samp_dev = sampling.SamplingParams(
-                temperature=jnp.asarray(self._samp["temperature"]),
-                top_k=jnp.asarray(self._samp["top_k"]),
-                top_p=jnp.asarray(self._samp["top_p"]),
-                frequency_penalty=jnp.asarray(self._samp["frequency_penalty"]),
-                presence_penalty=jnp.asarray(self._samp["presence_penalty"]),
-                repetition_penalty=jnp.asarray(self._samp["repetition_penalty"]),
-            )
-            self._samp_dirty = False
-
-        self.cache, logits = llama.decode_step(
-            self.config,
-            self.params,
-            self.cache,
-            jnp.asarray(self._tokens),
-            jnp.asarray(self._page_tables),
-            jnp.asarray(self._ctx_lens),
+    def _process_round(self, entry: _Entry, toks: np.ndarray) -> None:
+        for step in range(entry.n_steps):
+            for slot, r in enumerate(entry.slots):
+                # identity check doubles as the epoch: a recycled slot holds
+                # a different _Request object than the snapshot
+                if r is None or r.finished or self._slots[slot] is not r:
+                    continue
+                if r.cancelled:
+                    self._finish(r, None)
+                    continue
+                self._consume_token(r, int(toks[step, slot]))
+        self.tokens_generated += int(
+            sum(1 for s in entry.slots if s is not None) * entry.n_steps
         )
-        tokens_dev, self._sampler_state = sampling.sample_step(
-            logits.astype(jnp.float32),
-            self._sampler_state,
-            self._samp_dev,
-            self.ecfg.max_top_k,
-        )
-        tokens = np.asarray(tokens_dev)
-        self.step_count += 1
 
-        for slot in active_idx:
-            r = self._slots[slot]
-            if r is None:
-                continue
-            # the token just processed was r.last_token at position ctx-1;
-            # seal/commit any block it completed
-            new_blocks = r.seq.extend([r.last_token]) if r.prefill_done else []
-            for blk in new_blocks:
+    def _consume_token(self, r: _Request, tok: int) -> None:
+        sc = r.req.stop_conditions
+        # seal/commit the block completed by the previous token
+        if r.last_token >= 0:
+            for blk in r.seq.extend([r.last_token]):
                 if blk.position < len(r.pages):
                     self.allocator.commit(
                         r.pages[blk.position], blk.block_hash, blk.parent_hash
                     )
-            tok = int(tokens[slot])
-            self.tokens_generated += 1
-            self._finish_or_continue(r, slot, tok)
-
-    def _emit_token(self, r: _Request, tok: int) -> None:
-        r.produced += 1
-        r.emit(LLMEngineOutput(token_ids=[tok]))
-
-    def _finish_or_continue(self, r: _Request, slot: int, tok: int) -> None:
-        sc = r.req.stop_conditions
-        stop_ids = set(sc.stop_token_ids or [])
-        if not sc.ignore_eos and tok in stop_ids and (
+        if not sc.ignore_eos and tok in (sc.stop_token_ids or []) and (
             sc.min_tokens is None or r.produced >= sc.min_tokens
         ):
-            r.emit(LLMEngineOutput(token_ids=[], finish_reason=FinishReason.EOS))
-            self._release(r)
+            self._finish(r, FinishReason.EOS, emit_empty=True)
             return
+        r.last_token = tok
         r.produced += 1
         if r.produced >= r.max_new_tokens(self.ecfg.max_context):
-            r.emit(
-                LLMEngineOutput(token_ids=[tok], finish_reason=FinishReason.LENGTH)
-            )
-            self._release(r)
+            r.emit(LLMEngineOutput(token_ids=[tok],
+                                   finish_reason=FinishReason.LENGTH))
+            self._finish(r, None)
             return
         r.emit(LLMEngineOutput(token_ids=[tok]))
-        r.last_token = tok
-        self._ctx_lens[slot] += 1
-        self._tokens[slot] = tok
 
-    # ---- preemption / release ----
-
-    def _preempt_lowest(self) -> None:
-        """Preempt the most recently admitted request (LIFO keeps older
-        requests making progress — mirrors vLLM recompute preemption)."""
-        victims = [s for s in self._slots if s is not None]
-        if not victims:
+    def _finish(
+        self,
+        r: _Request,
+        reason: Optional[FinishReason],
+        emit_empty: bool = False,
+    ) -> None:
+        """Mark finished on host; slot is reclaimed via a release patch at
+        the next round boundary. The final (possibly just-sealed) block is
+        NOT committed — in-flight garbage steps may still write its page."""
+        if r.finished:
             return
-        victim = max(victims, key=lambda r: r.enqueue_time)
-        self._preempt(victim)
+        r.finished = True
+        if reason is not None:
+            r.emit(LLMEngineOutput(token_ids=[], finish_reason=reason))
+        self._to_release.append(r)
 
-    def _preempt(self, r: _Request) -> None:
-        slot = r.slot
-        self.allocator.free(r.pages)
-        r.pages = []
-        r.prefill_done = False
-        # Restart with everything processed so far plus the pending token as
-        # the new prompt; re-prefill recomputes (matching any still-cached
-        # prefix pages) and resumes sampling where we left off. Emitted
-        # tokens are never re-emitted (prefill emits the NEXT token).
-        r.req.token_ids = r.seq.tokens + [r.last_token]
-        r.seq = TokenBlockSequence.from_tokens(
-            r.req.token_ids, self.ecfg.page_size, salt=r.req.model
+    def _apply_releases(self) -> None:
+        # also sweep cancelled requests that never got a finish event
+        for slot, r in enumerate(self._slots):
+            if r is not None and r.cancelled and not r.finished:
+                r.finished = True
+                self._to_release.append(r)
+        if not self._to_release:
+            return
+        clear_slots = []
+        for r in self._to_release:
+            self.allocator.free(r.pages)
+            r.pages = []
+            if r.slot >= 0 and self._slots[r.slot] is r:
+                clear_slots.append(r.slot)
+                self._slots[r.slot] = None
+                self._pt_disp[r.slot] = 0
+                self._ctx_disp[r.slot] = 1
+                self._cap_disp[r.slot] = self.ecfg.page_size
+            r.slot = -1
+        self._to_release = []
+        if clear_slots:
+            self._dispatch_patch(clear_slots=clear_slots)
+
+    # ---- preemption ----
+
+    def _preempt_for_space(self, needing_slot: int) -> None:
+        """Free pages by preempting the most recently admitted other request
+        (LIFO keeps older requests progressing); preempts `needing_slot`
+        itself only when it is the sole occupant."""
+        victims = [
+            s for s in self._slots
+            if s is not None and not s.finished and s.slot != needing_slot
+        ]
+        victim = max(victims, key=lambda r: r.enqueue_time) if victims else (
+            self._slots[needing_slot]
         )
-        self._clear_slot(slot)
-        r.slot = -1
-        self._waiting.insert(0, r)
-        log.info("preempted request %s", r.req.request_id)
-
-    def _release(self, r: _Request) -> None:
-        self.allocator.free(r.pages)
-        r.pages = []
-        if r.slot >= 0:
-            self._clear_slot(r.slot)
-        r.slot = -1
-
-    def _clear_slot(self, slot: int) -> None:
+        if victim is None:
+            return
+        slot = victim.slot
+        self.allocator.free(victim.pages)
+        victim.pages = []
+        # restart = everything processed so far + pending token as new prompt
+        new_prompt = victim.seq.tokens + (
+            [victim.last_token] if victim.last_token >= 0 else []
+        )
+        victim.req.token_ids = new_prompt
+        victim.seq = TokenBlockSequence.from_tokens(
+            new_prompt, self.ecfg.page_size, salt=victim.req.model
+        )
+        victim.last_token = -1
+        victim.matched_blocks = 0
         self._slots[slot] = None
-        self._page_tables[slot] = 0
-        self._ctx_lens[slot] = 1
-        self._tokens[slot] = 0
+        self._pt_disp[slot] = 0
+        self._ctx_disp[slot] = 1
+        self._cap_disp[slot] = self.ecfg.page_size
+        victim.slot = -1
+        self._dispatch_patch(clear_slots=[slot])
+        self._waiting.insert(0, victim)
+        log.info("preempted request %s", victim.req.request_id)
 
     def _fail_all(self, err: Exception) -> None:
         for r in list(self._slots):
             if r is not None:
                 r.emit(err)
-                self._release(r)
+                r.finished = True
+                self.allocator.free(r.pages)
+                r.pages = []
+        self._slots = [None] * self._B
         for r in self._waiting:
             r.emit(err)
         self._waiting = []
+        self._entries = []
+

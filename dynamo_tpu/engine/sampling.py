@@ -71,8 +71,7 @@ def apply_penalties(
     return logits
 
 
-@functools.partial(jax.jit, static_argnums=(3,), donate_argnums=(1,))
-def sample_step(
+def sample_step_impl(
     logits: jnp.ndarray,      # [B, V] f32
     state: SamplerState,
     params: SamplingParams,
@@ -107,6 +106,11 @@ def sample_step(
     tokens = jnp.where(params.temperature <= 0.0, greedy, sampled)
     counts = state.counts.at[jnp.arange(B), tokens].add(1)
     return tokens, SamplerState(keys=new_keys, counts=counts)
+
+
+sample_step = jax.jit(
+    sample_step_impl, static_argnums=(3,), donate_argnums=(1,)
+)
 
 
 def reset_slot(state: SamplerState, slot: int, seed: int) -> SamplerState:

@@ -4,8 +4,10 @@ Design notes (TPU-first):
   - Parameters are a pytree whose per-layer leaves are STACKED on a leading
     layer axis and the decoder runs as one ``lax.scan`` — one compiled layer
     body regardless of depth (compile time stays flat from 4 to 80 layers).
-  - The KV cache is a paged pool per layer: ``[L, num_pages, page_size,
-    kv_heads, head_dim]``; requests address it through page tables. Page 0
+  - The KV cache is a paged pool per layer: ``[L, kv_heads, num_pages,
+    page_size, head_dim]`` (head-leading so one (head, page) block is a
+    clean TPU tile and the kv_heads axis shards over ``tp``); requests
+    address it through page tables. Page 0
     is a reserved scratch page: page-table entries BEYOND a request's
     allocated pages point at it, so whole-page padding writes and inactive
     decode slots never corrupt real pages. Padding tokens within a
@@ -115,12 +117,37 @@ def init_cache(
     """Paged KV pool. Page 0 is the reserved scratch page (see module doc)."""
     c = config
     dtype = dtype or jnp.dtype(c.dtype)
-    shape = (c.num_layers, num_pages, page_size, c.num_kv_heads, c.head_dim)
+    shape = (c.num_layers, c.num_kv_heads, num_pages, page_size, c.head_dim)
     return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
 
 
 def cache_shardings(config: ModelConfig, mesh: Mesh) -> Cache:
-    s = NamedSharding(mesh, P(None, None, None, "tp", None))
+    s = NamedSharding(mesh, P(None, "tp", None, None, None))
+    return {"k": s, "v": s}
+
+
+def init_ring(
+    config: ModelConfig, batch: int, ring_len: int, dtype=None
+) -> Cache:
+    """Per-slot decode write ring ``[L, kv_heads, B, R, head_dim]``.
+
+    Decode steps write their token's KV here (a cheap dynamic-update-slice)
+    instead of scattering into the page pool; `flush` batch-scatters a full
+    ring into the pool once per R steps. This keeps the multi-GB pool out
+    of the per-step program entirely (it is read-only between flushes) —
+    per-step scatter into the pool costs a full pool materialization on
+    backends without in-place buffer aliasing, and a slow scatter even with
+    it. Ring slot r of batch lane b holds the token at position
+    ``ring_base[b] + r``.
+    """
+    c = config
+    dtype = dtype or jnp.dtype(c.dtype)
+    shape = (c.num_layers, c.num_kv_heads, batch, ring_len, c.head_dim)
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+
+def ring_shardings(config: ModelConfig, mesh: Mesh) -> Cache:
+    s = NamedSharding(mesh, P(None, "tp", None, None, None))
     return {"k": s, "v": s}
 
 
@@ -140,8 +167,8 @@ def _mlp(h, wg, wu, wd):
 def _layer_body(c: ModelConfig, lp, h, cos, sin, write_kv, attend):
     """Shared decoder-layer body for prefill and decode.
 
-    `write_kv(k_pages, v_pages, k, v)` scatters new KV into the page pool;
-    `attend(q, k_pages, v_pages)` runs attention over it. `h` is [N, H]
+    `write_kv(k, v)` scatters new KV into the carried cache and returns it;
+    `attend(q, cache)` runs attention over the updated cache. `h` is [N, H]
     (N = padded tokens for prefill, batch slots for decode).
     """
     N = h.shape[0]
@@ -151,12 +178,12 @@ def _layer_body(c: ModelConfig, lp, h, cos, sin, write_kv, attend):
     v = (x @ lp["wv"]).reshape(N, c.num_kv_heads, c.head_dim)
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
-    k_pages, v_pages = write_kv(k, v)
-    attn = attend(q, k_pages, v_pages)
+    new_cache = write_kv(k, v)
+    attn = attend(q, new_cache)
     h = h + attn.reshape(N, c.q_dim) @ lp["wo"]
     x2 = rms_norm(h, lp["ln2"], c.rms_norm_eps)
     h = h + _mlp(x2, lp["wg"], lp["wu"], lp["wd"])
-    return h, (k_pages, v_pages)
+    return h, new_cache
 
 
 def _logits(config: ModelConfig, params: Params, h: jnp.ndarray) -> jnp.ndarray:
@@ -169,8 +196,7 @@ def _logits(config: ModelConfig, params: Params, h: jnp.ndarray) -> jnp.ndarray:
 # ---------------------------------------------------------------------------
 # Prefill
 
-@functools.partial(jax.jit, static_argnums=(0,), donate_argnums=(2,))
-def prefill(
+def prefill_impl(
     config: ModelConfig,
     params: Params,
     cache: Cache,
@@ -193,7 +219,7 @@ def prefill(
     """
     c = config
     T = tokens.shape[0]
-    ps = cache["k"].shape[2]
+    ps = cache["k"].shape[3]
     inv_freq = jnp.asarray(
         rope_inv_freq(c.head_dim, c.rope_theta, c.rope_scaling_dict)
     )
@@ -208,45 +234,65 @@ def prefill(
         page_table, q_start // ps, n_new_pages
     )  # [T/ps]
 
-    def layer_fn(h, xs):
-        (lp, k_pages, v_pages) = xs
+    # Layers are UNROLLED (python loop, static layer index): XLA's aliasing
+    # analysis keeps the donated cache update chain in place, whereas a
+    # lax.scan carrying the cache re-materializes it every iteration (the
+    # attention read-after-scatter defeats carry aliasing).
+    for l in range(c.num_layers):
+        lp = jax.tree.map(lambda x: x[l], params["layers"])
 
-        def write_kv(k, v):
-            shape = (n_new_pages, ps, c.num_kv_heads, c.head_dim)
-            return (
-                k_pages.at[write_idx].set(k.reshape(shape)),
-                v_pages.at[write_idx].set(v.reshape(shape)),
+        def write_kv(k, v, l=l):
+            # [T, kvh, hd] -> [n_new_pages, kvh, ps, hd]: the int l counts
+            # as an advanced index alongside write_idx (separated by the
+            # slice), so their broadcast dim [n] leads the result
+            def to_pages(x):
+                return x.reshape(
+                    n_new_pages, ps, c.num_kv_heads, c.head_dim
+                ).transpose(0, 2, 1, 3)
+
+            ck = cache["k"].at[l, :, write_idx].set(to_pages(k))
+            cv = cache["v"].at[l, :, write_idx].set(to_pages(v))
+            return {"k": ck, "v": cv}
+
+        def attend(q, new_cache, l=l):
+            return prefill_attention(
+                q, new_cache["k"], new_cache["v"], jnp.int32(l),
+                page_table, q_start, seq_len,
             )
 
-        def attend(q, kp, vp):
-            return prefill_attention(q, kp, vp, page_table, q_start, seq_len)
+        h, cache = _layer_body(c, lp, h, cos, sin, write_kv, attend)
 
-        return _layer_body(c, lp, h, cos, sin, write_kv, attend)
-
-    h, (k_new, v_new) = jax.lax.scan(
-        layer_fn, h, (params["layers"], cache["k"], cache["v"])
-    )
     last = seq_len - q_start - 1  # index of last valid token within T
     logits = _logits(c, params, h[last])
-    return {"k": k_new, "v": v_new}, logits
+    return cache, logits
+
+
+prefill = jax.jit(prefill_impl, static_argnums=(0,), donate_argnums=(2,))
 
 
 # ---------------------------------------------------------------------------
 # Decode
 
-@functools.partial(jax.jit, static_argnums=(0,), donate_argnums=(2,))
-def decode_step(
+def decode_step_impl(
     config: ModelConfig,
     params: Params,
-    cache: Cache,
+    cache: Cache,              # page pool — READ-ONLY here (see init_ring)
+    ring: Cache,               # [L, kvh, B, R, hd] write ring
     tokens: jnp.ndarray,       # [B] int32 — last sampled token per slot
     page_tables: jnp.ndarray,  # [B, max_pages] int32 (inactive slots: zeros)
     ctx_lens: jnp.ndarray,     # [B] int32 — context length INCLUDING this token
+    ring_base: jnp.ndarray,    # [B] int32 — position held by ring slot 0
+    ring_pos: jnp.ndarray,     # scalar int32 — ring slot receiving this token
 ) -> tuple[Cache, jnp.ndarray]:
-    """One decode step for all slots. Returns (cache, logits [B, vocab])."""
+    """One decode step for all slots. Returns (ring, logits [B, vocab]).
+
+    The new token's KV lands in ring slot `ring_pos` (its position is
+    ``ctx-1 == ring_base + ring_pos`` for live slots); attention covers
+    pool pages for positions < ring_base plus ring entries
+    [ring_base, ctx). The pool is immutable between `flush` calls.
+    """
     c = config
     B = tokens.shape[0]
-    ps = cache["k"].shape[2]
     inv_freq = jnp.asarray(
         rope_inv_freq(c.head_dim, c.rope_theta, c.rope_scaling_dict)
     )
@@ -255,30 +301,76 @@ def decode_step(
 
     h = params["embed"][tokens].astype(cache["k"].dtype)  # [B, H]
 
-    page_idx = jnp.take_along_axis(
-        page_tables, (positions // ps)[:, None], axis=1
-    )[:, 0]                       # [B] page receiving this token's KV
-    offset = positions % ps       # [B]
+    # unrolled layers — see prefill_impl for why not lax.scan
+    for l in range(c.num_layers):
+        lp = jax.tree.map(lambda x: x[l], params["layers"])
 
-    def layer_fn(h, xs):
-        (lp, k_pages, v_pages) = xs
+        def write_kv(k, v, l=l):
+            # one DUS per layer: [B, kvh, hd] -> ring[l, :, :, ring_pos, :]
+            def put(r, x):
+                upd = x.transpose(1, 0, 2)[None, :, :, None, :]
+                return jax.lax.dynamic_update_slice(
+                    r, upd.astype(r.dtype), (l, 0, 0, ring_pos, 0)
+                )
 
-        def write_kv(k, v):
-            return (
-                k_pages.at[page_idx, offset].set(k),
-                v_pages.at[page_idx, offset].set(v),
+            return {"k": put(ring["k"], k), "v": put(ring["v"], v)}
+
+        def attend(q, new_ring, l=l):
+            return paged_decode_attention(
+                q, cache["k"], cache["v"],
+                new_ring["k"], new_ring["v"], jnp.int32(l),
+                page_tables, ctx_lens, ring_base,
             )
 
-        def attend(q, kp, vp):
-            return paged_decode_attention(q, kp, vp, page_tables, ctx_lens)
+        h, ring = _layer_body(c, lp, h, cos, sin, write_kv, attend)
 
-        return _layer_body(c, lp, h, cos, sin, write_kv, attend)
-
-    h, (k_new, v_new) = jax.lax.scan(
-        layer_fn, h, (params["layers"], cache["k"], cache["v"])
-    )
     logits = _logits(c, params, h)
-    return {"k": k_new, "v": v_new}, logits
+    return ring, logits
+
+
+decode_step = jax.jit(decode_step_impl, static_argnums=(0,), donate_argnums=(3,))
+
+
+def flush_impl(
+    config: ModelConfig,
+    cache: Cache,
+    ring: Cache,
+    page_tables: jnp.ndarray,  # [B, max_pages_full] int32 (FULL width)
+    ring_base: jnp.ndarray,    # [B] int32
+    valid_len: jnp.ndarray,    # [B] int32 — #real tokens in the ring per slot
+) -> Cache:
+    """Batch-scatter a full ring into the page pool (once per round).
+
+    Ring entry (b, r) holds position ring_base[b]+r and goes to page
+    page_tables[b, pos//ps] at offset pos%ps; entries with r >= valid_len[b]
+    (garbage beyond a finished/clamped slot) are redirected to scratch page
+    0. This is the only writer of the pool besides prefill.
+    """
+    c = config
+    ps = cache["k"].shape[3]
+    L, kvh, B, R, hd = ring["k"].shape
+    r_idx = jnp.arange(R, dtype=jnp.int32)[None, :]          # [1, R]
+    pos = ring_base[:, None] + r_idx                          # [B, R]
+    page_slot = jnp.clip(pos // ps, 0, page_tables.shape[1] - 1)
+    page = jnp.take_along_axis(page_tables, page_slot, axis=1)  # [B, R]
+    valid = r_idx < valid_len[:, None]
+    page = jnp.where(valid, page, 0)
+    offset = pos % ps
+    pflat = page.reshape(-1)       # [B*R]
+    oflat = offset.reshape(-1)
+
+    out = {}
+    for name in ("k", "v"):
+        pool = cache[name]
+        upd = ring[name].transpose(0, 2, 3, 1, 4).reshape(L, B * R, kvh, hd)
+        for l in range(L):
+            # advanced dims ([B*R]) lead: target [B*R, kvh, hd]
+            pool = pool.at[l, :, pflat, oflat].set(upd[l])
+        out[name] = pool
+    return out
+
+
+flush = jax.jit(flush_impl, static_argnums=(0,), donate_argnums=(1,))
 
 
 # ---------------------------------------------------------------------------

@@ -1,41 +1,51 @@
 """Attention over a paged KV cache.
 
-The KV cache for one layer is a page pool ``k_pages/v_pages:
-[num_pages, page_size, num_kv_heads, head_dim]``; a request's context is the
-concatenation of the pages listed in its page table. This mirrors the paged
-layout the reference gets from vLLM (SURVEY.md §7 "Paged attention on TPU")
-but laid out for TPU: the trailing (kv_heads, head_dim) axes shard over the
-``tp`` mesh axis and head_dim stays a 128-lane multiple for real models.
+The KV cache is a page pool ``k_cache/v_cache: [num_layers, num_kv_heads,
+num_pages, page_size, head_dim]``; a request's context is the concatenation
+of the pages listed in its page table. Attention ops take the FULL cache
+plus a (traced) layer index so the decoder scan can carry the cache and
+update it in place — slicing a layer out of the carry would materialize a
+copy every step (SURVEY.md §7 "Paged attention on TPU" hard part; the
+head-leading page layout makes one (head, page) block a clean TPU tile and
+shards kv_heads over the ``tp`` mesh axis).
 
-This module holds the pure-jnp reference implementations. The Pallas TPU
-kernels (dynamo_tpu.ops.pallas) override them at trace time on TPU backends.
+Dispatch: on TPU backends decode attention runs the Pallas flash-decoding
+kernel (ops/pallas_attention.py); elsewhere (CPU test mesh) the pure-jnp
+reference implementations below.
 """
 from __future__ import annotations
+
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
 
 NEG_INF = -1e30
 
+# None = auto (pallas iff backend is tpu); True/False force. Tests flip this
+# to validate kernel-vs-reference parity in interpret mode.
+USE_PALLAS: Optional[bool] = None
 
-def repeat_kv(x: jnp.ndarray, n_rep: int) -> jnp.ndarray:
-    """[..., kv_heads, hd] -> [..., kv_heads*n_rep, hd] (GQA head expansion)."""
+
+def _pallas_enabled() -> bool:
+    if USE_PALLAS is not None:
+        return USE_PALLAS
+    return jax.default_backend() == "tpu"
+
+
+def repeat_kv_heads(x: jnp.ndarray, n_rep: int) -> jnp.ndarray:
+    """[kv_heads, ...] -> [kv_heads*n_rep, ...] (GQA head expansion;
+    query head i attends kv head i // n_rep)."""
     if n_rep == 1:
         return x
-    return jnp.repeat(x, n_rep, axis=-2)
-
-
-def gather_pages(pages: jnp.ndarray, page_table: jnp.ndarray) -> jnp.ndarray:
-    """pages [P, ps, kvh, hd], page_table [n] -> contiguous [n*ps, kvh, hd]."""
-    g = pages[page_table]  # [n, ps, kvh, hd]
-    n, ps, kvh, hd = g.shape
-    return g.reshape(n * ps, kvh, hd)
+    return jnp.repeat(x, n_rep, axis=0)
 
 
 def prefill_attention(
     q: jnp.ndarray,            # [T, n_heads, hd] — new tokens (padded)
-    k_pages: jnp.ndarray,      # [P, ps, kv_heads, hd]
-    v_pages: jnp.ndarray,
+    k_cache: jnp.ndarray,      # [L, kv_heads, P, ps, hd]
+    v_cache: jnp.ndarray,
+    layer: jnp.ndarray,        # scalar int32 layer index
     page_table: jnp.ndarray,   # [max_pages] int32 — pages covering [0, seq_len)
     q_start: jnp.ndarray,      # scalar int32 — #tokens already cached (page-aligned)
     seq_len: jnp.ndarray,      # scalar int32 — total valid context length
@@ -43,53 +53,104 @@ def prefill_attention(
     """Causal attention of T new tokens (positions q_start..q_start+T) against
     the full paged context [0, seq_len). Returns [T, n_heads, hd]."""
     T, n_heads, hd = q.shape
-    kv_heads = k_pages.shape[2]
-    k = gather_pages(k_pages, page_table)  # [S, kvh, hd]
-    v = gather_pages(v_pages, page_table)
-    S = k.shape[0]
-    k = repeat_kv(k, n_heads // kv_heads)
-    v = repeat_kv(v, n_heads // kv_heads)
+    _, kv_heads, _, ps, _ = k_cache.shape
+    n_rep = n_heads // kv_heads
+
+    k = k_cache[layer][:, page_table]  # [kvh, n, ps, hd]
+    v = v_cache[layer][:, page_table]
+    S = k.shape[1] * ps
+    k = repeat_kv_heads(k.reshape(kv_heads, S, hd), n_rep)  # [nh, S, hd]
+    v = repeat_kv_heads(v.reshape(kv_heads, S, hd), n_rep)
 
     scale = 1.0 / jnp.sqrt(jnp.asarray(hd, jnp.float32))
-    # [heads, T, S]
-    scores = jnp.einsum("tnh,snh->nts", q.astype(jnp.float32), k.astype(jnp.float32))
-    scores = scores * scale
+    qt = q.transpose(1, 0, 2)  # [nh, T, hd]
+    scores = jnp.einsum(
+        "nth,nsh->nts", qt, k, preferred_element_type=jnp.float32
+    ) * scale
     q_pos = q_start + jnp.arange(T)[:, None]       # [T, 1]
     k_pos = jnp.arange(S)[None, :]                 # [1, S]
     mask = (k_pos <= q_pos) & (k_pos < seq_len)    # causal + validity
     scores = jnp.where(mask[None], scores, NEG_INF)
     probs = jax.nn.softmax(scores, axis=-1)
-    out = jnp.einsum("nts,snh->tnh", probs, v.astype(jnp.float32))
+    out = jnp.einsum(
+        "nts,nsh->tnh", probs.astype(v.dtype), v,
+        preferred_element_type=jnp.float32,
+    )
     return out.astype(q.dtype)
 
 
 def paged_decode_attention(
     q: jnp.ndarray,            # [B, n_heads, hd] — one new token per slot
-    k_pages: jnp.ndarray,      # [P, ps, kv_heads, hd]
-    v_pages: jnp.ndarray,
+    k_cache: jnp.ndarray,      # [L, kv_heads, P, ps, hd] page pool (read-only)
+    v_cache: jnp.ndarray,
+    ring_k: jnp.ndarray,       # [L, kv_heads, B, R, hd] current-round writes
+    ring_v: jnp.ndarray,
+    layer: jnp.ndarray,        # scalar int32
     page_tables: jnp.ndarray,  # [B, max_pages] int32
     ctx_lens: jnp.ndarray,     # [B] int32 — context length incl. current token
+    ring_base: jnp.ndarray,    # [B] int32 — position of ring slot 0
 ) -> jnp.ndarray:
-    """Single-token attention for a batch of decode slots. Returns [B, n_heads, hd]."""
+    """Single-token attention for a batch of decode slots over the two-tier
+    context: pool pages hold positions < ring_base, the ring holds
+    [ring_base, ctx). Returns [B, n_heads, hd]."""
+    if _pallas_enabled():
+        from dynamo_tpu.ops.pallas_attention import paged_decode_attention_pallas
+
+        return paged_decode_attention_pallas(
+            q, k_cache, v_cache, ring_k, ring_v, layer,
+            page_tables, ctx_lens, ring_base,
+        )
+    return paged_decode_attention_reference(
+        q, k_cache, v_cache, ring_k, ring_v, layer,
+        page_tables, ctx_lens, ring_base,
+    )
+
+
+def paged_decode_attention_reference(
+    q: jnp.ndarray,
+    k_cache: jnp.ndarray,
+    v_cache: jnp.ndarray,
+    ring_k: jnp.ndarray,
+    ring_v: jnp.ndarray,
+    layer: jnp.ndarray,
+    page_tables: jnp.ndarray,
+    ctx_lens: jnp.ndarray,
+    ring_base: jnp.ndarray,
+) -> jnp.ndarray:
+    """Pure-jnp decode attention (gathers the full context — correct
+    everywhere, bandwidth-wasteful; the Pallas kernel is the serving path)."""
     B, n_heads, hd = q.shape
-    ps = k_pages.shape[1]
-    kv_heads = k_pages.shape[2]
+    _, kv_heads, _, ps, _ = k_cache.shape
     n_rep = n_heads // kv_heads
     max_pages = page_tables.shape[1]
+    R = ring_k.shape[3]
     S = max_pages * ps
 
-    k = k_pages[page_tables]   # [B, max_pages, ps, kvh, hd]
-    v = v_pages[page_tables]
-    k = k.reshape(B, S, kv_heads, hd)
-    v = v.reshape(B, S, kv_heads, hd)
-    k = repeat_kv(k, n_rep)
-    v = repeat_kv(v, n_rep)
+    k = k_cache[layer][:, page_tables]   # [kvh, B, max_pages, ps, hd]
+    v = v_cache[layer][:, page_tables]
+    k = k.reshape(kv_heads, B, S, hd)
+    v = v.reshape(kv_heads, B, S, hd)
+    # append the ring as extra context lanes
+    k = jnp.concatenate([k, ring_k[layer]], axis=2)  # [kvh, B, S+R, hd]
+    v = jnp.concatenate([v, ring_v[layer]], axis=2)
+    k = repeat_kv_heads(k, n_rep)  # [nh, B, S+R, hd]
+    v = repeat_kv_heads(v, n_rep)
 
     scale = 1.0 / jnp.sqrt(jnp.asarray(hd, jnp.float32))
-    scores = jnp.einsum("bnh,bsnh->bns", q.astype(jnp.float32), k.astype(jnp.float32))
-    scores = scores * scale
-    mask = jnp.arange(S)[None, :] < ctx_lens[:, None]   # [B, S]
+    scores = jnp.einsum(
+        "bnh,nbsh->bns", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    # pool lanes valid for positions < ring_base; ring lane r holds
+    # position ring_base + r, valid while < ctx
+    pool_pos = jnp.arange(S)[None, :]                       # [1, S]
+    pool_ok = pool_pos < jnp.minimum(ring_base, ctx_lens)[:, None]
+    ring_pos = ring_base[:, None] + jnp.arange(R)[None, :]  # [B, R]
+    ring_ok = ring_pos < ctx_lens[:, None]
+    mask = jnp.concatenate([pool_ok, ring_ok], axis=1)      # [B, S+R]
     scores = jnp.where(mask[:, None, :], scores, NEG_INF)
     probs = jax.nn.softmax(scores, axis=-1)
-    out = jnp.einsum("bns,bsnh->bnh", probs, v.astype(jnp.float32))
+    out = jnp.einsum(
+        "bns,nbsh->bnh", probs.astype(v.dtype), v,
+        preferred_element_type=jnp.float32,
+    )
     return out.astype(q.dtype)

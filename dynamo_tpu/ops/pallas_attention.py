@@ -1,0 +1,177 @@
+"""Pallas TPU kernel: paged decode attention (flash-decoding over a page
+pool plus a per-slot write ring).
+
+Grid step = (slot b, kv head h, context-chunk i). Chunks 0..W-1 stream pool
+pages selected via the scalar-prefetched page table (beyond a slot's
+allocation the table holds page 0 — consecutive identical block indices
+make the pipeline skip the reload); chunk W processes the slot's ring lane
+(the current round's freshly written KV — see models/llama.py init_ring).
+Online-softmax state (m, l, acc) accumulates in VMEM scratch across chunks;
+the output block is written once per (b, h).
+
+Position semantics: pool page i covers positions [i*ps, i*ps+ps) and is
+valid while < ring_base[b]; ring slot r holds position ring_base[b]+r and
+is valid while < ctx[b]. Taking the FULL [L, ...] cache plus a layer scalar
+keeps the cache un-sliced in the unrolled decoder (a per-layer slice would
+materialize a copy).
+
+This is the TPU equivalent of vLLM's paged-attention CUDA kernel
+(SURVEY.md §7 "Paged attention on TPU" hard part).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _decode_kernel(
+    # scalar prefetch
+    layer_ref,  # [1] i32 layer index
+    pt_ref,     # [B, max_pages] i32 page table
+    ctx_ref,    # [B] i32 context lengths
+    base_ref,   # [B] i32 ring base positions
+    # blocks
+    q_ref,      # [1, 1, G, HD]
+    k_ref,      # [1, 1, 1, ps, HD] pool page
+    v_ref,
+    rk_ref,     # [1, 1, 1, R, HD] ring lane
+    rv_ref,
+    o_ref,      # [1, 1, G, HD]
+    # scratch
+    m_ref,      # [G, 128] f32 running max
+    l_ref,      # [G, 128] f32 running denom
+    acc_ref,    # [G, HD] f32 running numerator
+    *,
+    scale: float,
+    page_size: int,
+):
+    b = pl.program_id(0)
+    i = pl.program_id(2)
+    n_chunks = pl.num_programs(2)  # W pool chunks + 1 ring chunk
+
+    @pl.when(i == 0)
+    def _():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    ctx = ctx_ref[b]
+    base = base_ref[b]
+    is_ring = i == n_chunks - 1
+
+    def accumulate(k, v, start, limit, length):
+        q = q_ref[0, 0]  # [G, HD]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [G, length]
+        s = s * scale
+        pos = start + jax.lax.broadcasted_iota(jnp.int32, (1, length), 1)
+        s = jnp.where(pos < limit, s, NEG_INF)
+
+        m_prev = m_ref[:, :1]                        # [G, 1]
+        row_max = jnp.max(s, axis=1, keepdims=True)  # [G, 1]
+        m_new = jnp.maximum(m_prev, row_max)
+        p = jnp.exp(s - m_new)                       # [G, length]
+        alpha = jnp.exp(m_prev - m_new)              # [G, 1]
+        l_new = l_ref[:, :1] * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    # pool chunk: page i covers [i*ps, i*ps+ps), valid below ring_base
+    @pl.when(jnp.logical_and(jnp.logical_not(is_ring), i * page_size < base))
+    def _():
+        accumulate(
+            k_ref[0, 0, 0], v_ref[0, 0, 0],
+            i * page_size, jnp.minimum(base, ctx), page_size,
+        )
+
+    # ring chunk: slot r holds position base + r, valid below ctx
+    @pl.when(is_ring)
+    def _():
+        R = rk_ref.shape[3]
+        accumulate(rk_ref[0, 0, 0], rv_ref[0, 0, 0], base, ctx, R)
+
+    @pl.when(i == n_chunks - 1)
+    def _():
+        denom = jnp.maximum(l_ref[:, :1], 1e-30)
+        o_ref[0, 0] = (acc_ref[:] / denom).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def paged_decode_attention_pallas(
+    q: jnp.ndarray,            # [B, n_heads, HD]
+    k_cache: jnp.ndarray,      # [L, NKV, P, ps, HD]
+    v_cache: jnp.ndarray,
+    ring_k: jnp.ndarray,       # [L, NKV, B, R, HD]
+    ring_v: jnp.ndarray,
+    layer: jnp.ndarray,        # scalar i32
+    page_tables: jnp.ndarray,  # [B, max_pages] i32
+    ctx_lens: jnp.ndarray,     # [B] i32
+    ring_base: jnp.ndarray,    # [B] i32
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Flash paged+ring decode attention. Returns [B, n_heads, HD]."""
+    B, n_heads, hd = q.shape
+    _, nkv, _, ps, _ = k_cache.shape
+    g = n_heads // nkv
+    max_pages = page_tables.shape[1]
+    R = ring_k.shape[3]
+    scale = float(1.0 / (hd ** 0.5))
+
+    # group query heads by kv head: head i <-> kv head i // g (matches
+    # jnp.repeat GQA expansion in the fallback path)
+    qg = q.reshape(B, nkv, g, hd)
+
+    grid = (B, nkv, max_pages + 1)
+    last = max_pages  # ring chunk index
+
+    def q_map(b, h, i, layer, pt, ctx, base):
+        return (b, h, 0, 0)
+
+    def kv_map(b, h, i, layer, pt, ctx, base):
+        # clamp the ring step's pool index to a repeat of the previous page
+        # (its load is unused; repeating the index skips the DMA)
+        return (layer[0], h, pt[b, jnp.minimum(i, last - 1)], 0, 0)
+
+    def ring_map(b, h, i, layer, pt, ctx, base):
+        return (layer[0], h, b, 0, 0)
+
+    out = pl.pallas_call(
+        functools.partial(_decode_kernel, scale=scale, page_size=ps),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=4,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((1, 1, g, hd), q_map),
+                pl.BlockSpec((1, 1, 1, ps, hd), kv_map),
+                pl.BlockSpec((1, 1, 1, ps, hd), kv_map),
+                pl.BlockSpec((1, 1, 1, R, hd), ring_map),
+                pl.BlockSpec((1, 1, 1, R, hd), ring_map),
+            ],
+            out_specs=pl.BlockSpec((1, 1, g, hd), q_map),
+            scratch_shapes=[
+                pltpu.VMEM((g, 128), jnp.float32),
+                pltpu.VMEM((g, 128), jnp.float32),
+                pltpu.VMEM((g, hd), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((B, nkv, g, hd), q.dtype),
+        interpret=interpret,
+    )(
+        jnp.asarray(layer, jnp.int32).reshape(1),
+        page_tables.astype(jnp.int32),
+        ctx_lens.astype(jnp.int32),
+        ring_base.astype(jnp.int32),
+        qg, k_cache, v_cache, ring_k, ring_v,
+    )
+    return out.reshape(B, n_heads, hd)

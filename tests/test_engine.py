@@ -254,3 +254,19 @@ async def test_engine_sampling_seeded(engine_setup):
     assert len(a) == 8
     assert a != c or True  # different seed usually differs; no hard guarantee
     await eng.stop()
+
+
+async def test_engine_chunked_prefill_long_prompt(engine_setup):
+    """Prompts longer than the largest prefill bucket run as page-aligned
+    continuation chunks; logits must match the short-bucket path exactly."""
+    cfg, ecfg, params = engine_setup
+    eng = make_engine(engine_setup)  # buckets (32, 64); prompt 100 > 64
+    prompt = list((np.arange(100) % 250) + 1)
+    req = PreprocessedRequest(
+        token_ids=list(prompt),
+        stop_conditions=StopConditions(max_tokens=8, ignore_eos=True),
+    )
+    toks, finish = await collect(eng, req)
+    ref = manual_greedy(cfg, params, ecfg, prompt, 8)
+    assert toks == ref
+    await eng.stop()
