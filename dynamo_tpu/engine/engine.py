@@ -144,6 +144,14 @@ class TpuEngine:
             llama.init_cache(c, e.num_pages, e.page_size, cache_dtype),
             llama.cache_shardings(c, self.mesh),
         )
+        # decode write ring: one lane per slot, flush_every entries deep —
+        # decode steps write here; llama.flush scatters a full ring into the
+        # page pool once per round (see models/llama.py init_ring)
+        self.ring = jax.tree.map(
+            lambda x, s: jax.device_put(x, s),
+            llama.init_ring(c, e.max_decode_slots, e.flush_every, cache_dtype),
+            llama.ring_shardings(c, self.mesh),
+        )
         self.allocator = PageAllocator(
             e.num_pages, e.page_size,
             worker_id=e.worker_id,
@@ -195,13 +203,17 @@ class TpuEngine:
         c, e = self.config, self.ecfg
         max_top_k = e.max_top_k
 
-        @functools.partial(jax.jit, donate_argnums=(1, 2))
-        def engine_step(params, cache, dev, pt):
+        @functools.partial(jax.jit, donate_argnums=(2, 3))
+        def engine_step(params, cache, ring, dev, pt, ring_base, ring_pos):
             # pt is width-bucketed [B, W] (W = pow2 cover of the widest
             # active page table) — narrow tables shrink the attention
-            # kernel's page grid; one compile per W bucket
-            cache, logits = llama.decode_step_impl(
-                c, params, cache, dev["tokens"], pt, dev["ctx"]
+            # kernel's page grid; one compile per W bucket. The page pool
+            # (cache) is read-only here: the new token's KV lands in ring
+            # slot ring_pos; llama.flush commits the ring to the pool at
+            # the round boundary.
+            ring, logits = llama.decode_step_impl(
+                c, params, cache, ring, dev["tokens"], pt, dev["ctx"],
+                ring_base, ring_pos,
             )
             sp = sampling.SamplingParams(
                 temperature=dev["temp"], top_k=dev["top_k"], top_p=dev["top_p"],
@@ -219,7 +231,7 @@ class TpuEngine:
                 keys=st.keys,
                 counts=st.counts,
             )
-            return cache, dev, toks
+            return ring, dev, toks
 
         @functools.partial(jax.jit, donate_argnums=(0,))
         def patch(
@@ -401,14 +413,26 @@ class TpuEngine:
             w *= 2
         w = min(w, e.max_pages_per_seq)
         pt_dev = jnp.asarray(self._pt_disp[:, :w])
+        # ring slot 0 holds the position decoded by this round's first step
+        ring_base_np = np.maximum(self._ctx_disp - 1, 0)
+        ring_base = jnp.asarray(ring_base_np)
         handles = []
-        for _ in range(n):
-            self.cache, self._dev, toks = self._engine_step(
-                self.params, self.cache, self._dev, pt_dev
+        for s in range(n):
+            self.ring, self._dev, toks = self._engine_step(
+                self.params, self.cache, self.ring, self._dev, pt_dev,
+                ring_base, jnp.int32(s),
             )
             handles.append(toks)
             self._ctx_disp = np.minimum(self._ctx_disp + 1, self._cap_disp)
             self.step_count += 1
+        # round boundary: batch-scatter the ring into the page pool. Ring
+        # entries past a slot's context cap repeat the clamped position —
+        # only the first cap-ring_base entries are real.
+        valid = np.minimum(n, self._cap_disp - ring_base_np).astype(np.int32)
+        self.cache = llama.flush(
+            self.config, self.cache, self.ring, pt_dev, ring_base,
+            jnp.asarray(valid),
+        )
         stacked = self._stack(*handles)
         stacked.copy_to_host_async()
         self._entries.append(

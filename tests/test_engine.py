@@ -137,6 +137,7 @@ def manual_greedy(cfg, params, ecfg, prompt, n_new):
     out = [int(np.argmax(np.asarray(logits)))]
     seq_len = len(prompt)
     ptb = np.zeros((1, ecfg.max_pages_per_seq), np.int32)
+    ring = llama.init_ring(cfg, 1, 1, dtype=jnp.float32)  # 1-step rounds
     for _ in range(n_new - 1):
         seq_len += 1
         pos = seq_len - 1
@@ -144,10 +145,15 @@ def manual_greedy(cfg, params, ecfg, prompt, n_new):
             n_pages += 1
             table[n_pages - 1] = n_pages
         ptb[0] = table
-        cache, lg = llama.decode_step(
-            cfg, params, cache,
+        ring_base = jnp.asarray([pos], jnp.int32)
+        ring, lg = llama.decode_step(
+            cfg, params, cache, ring,
             jnp.asarray([out[-1]], jnp.int32), jnp.asarray(ptb),
-            jnp.asarray([seq_len], jnp.int32),
+            jnp.asarray([seq_len], jnp.int32), ring_base, jnp.int32(0),
+        )
+        cache = llama.flush(
+            cfg, cache, ring, jnp.asarray(ptb), ring_base,
+            jnp.asarray([1], jnp.int32),
         )
         out.append(int(np.argmax(np.asarray(lg)[0])))
     return out

@@ -93,23 +93,34 @@ def test_decode_matches_hf(pair):
         jnp.asarray(pt), jnp.int32(0), jnp.int32(len(prompt)),
     )
 
-    # decode 6 tokens greedily with B=2 slots; slot 1 inactive
-    B = 2
+    # decode 6 tokens greedily with B=2 slots; slot 1 inactive. Rounds of
+    # R=2 ring steps followed by a flush — exercises the two-tier decode
+    # (ring attention within a round, pool after flush).
+    B, R = 2, 2
     page_tables = np.zeros((B, MAX_PAGES), np.int32)
     page_tables[0] = pt
+    ptd = jnp.asarray(page_tables)
+    ring = llama.init_ring(cfg, B, R, dtype=jnp.float32)
     seq = list(prompt)
     tok = int(np.argmax(np.asarray(logits)))
-    for _ in range(6):
-        seq.append(tok)
-        tokens = jnp.asarray([tok, 0], jnp.int32)
-        ctx = jnp.asarray([len(seq), 1], jnp.int32)
-        cache, logits = llama.decode_step(
-            cfg, params, cache, tokens, jnp.asarray(page_tables), ctx
+    for round_start in range(0, 6, R):
+        ring_base = jnp.asarray([len(seq), 0], jnp.int32)  # pos of ring slot 0
+        for s in range(R):
+            seq.append(tok)
+            tokens = jnp.asarray([tok, 0], jnp.int32)
+            ctx = jnp.asarray([len(seq), 1], jnp.int32)
+            ring, logits = llama.decode_step(
+                cfg, params, cache, ring, tokens, ptd, ctx,
+                ring_base, jnp.int32(s),
+            )
+            ref = hf_logits(model, seq)[-1]
+            got = np.asarray(logits)[0]
+            np.testing.assert_allclose(got, ref, rtol=2e-3, atol=2e-3)
+            tok = int(np.argmax(got))
+        cache = llama.flush(
+            cfg, cache, ring, ptd, ring_base,
+            jnp.asarray([R, 0], jnp.int32),
         )
-        ref = hf_logits(model, seq)[-1]
-        got = np.asarray(logits)[0]
-        np.testing.assert_allclose(got, ref, rtol=2e-3, atol=2e-3)
-        tok = int(np.argmax(got))
 
 
 def test_prefix_continuation_matches_hf(pair):

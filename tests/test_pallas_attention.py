@@ -2,7 +2,7 @@
 
 The same kernel binary runs on real TPU; interpret mode validates the
 kernel's math — online softmax accumulation, page-table indirection, layer
-indexing, GQA head grouping, context masking — against
+indexing, GQA head grouping, two-tier pool+ring masking — against
 paged_decode_attention_reference.
 """
 import numpy as np
@@ -15,51 +15,92 @@ from dynamo_tpu.ops.pallas_attention import paged_decode_attention_pallas
 
 
 @pytest.mark.parametrize(
-    "B,nh,nkv,hd,ps,max_pages",
+    "B,nh,nkv,hd,ps,max_pages,R",
     [
-        (4, 8, 2, 64, 16, 4),    # GQA g=4
-        (2, 4, 4, 32, 8, 3),     # MHA g=1
-        (3, 16, 8, 128, 8, 2),   # llama-8B-like head geometry
+        (4, 8, 2, 64, 16, 4, 4),    # GQA g=4
+        (2, 4, 4, 32, 8, 3, 2),     # MHA g=1
+        (3, 16, 8, 128, 8, 2, 8),   # llama-8B-like head geometry
     ],
 )
-def test_kernel_matches_reference(B, nh, nkv, hd, ps, max_pages):
+def test_kernel_matches_reference(B, nh, nkv, hd, ps, max_pages, R):
     rng = np.random.RandomState(0)
     L = 3
     P = max_pages * B + 1
     q = jnp.asarray(rng.randn(B, nh, hd), jnp.float32)
     k_cache = jnp.asarray(rng.randn(L, nkv, P, ps, hd), jnp.float32)
     v_cache = jnp.asarray(rng.randn(L, nkv, P, ps, hd), jnp.float32)
-    # each slot gets its own pages; ragged context lengths incl. unaligned
+    ring_k = jnp.asarray(rng.randn(L, nkv, B, R, hd), jnp.float32)
+    ring_v = jnp.asarray(rng.randn(L, nkv, B, R, hd), jnp.float32)
+    # each slot gets its own pages; ragged context lengths incl. unaligned.
+    # The last 1..R positions live in the ring (ring_base = ctx - r_live).
     page_tables = np.zeros((B, max_pages), np.int32)
     ctx = np.zeros(B, np.int32)
+    base = np.zeros(B, np.int32)
     for b in range(B):
         n = rng.randint(1, max_pages + 1)
         page_tables[b, :n] = rng.choice(np.arange(1, P), size=n, replace=False)
         ctx[b] = rng.randint(1, n * ps + 1)
+        base[b] = ctx[b] - rng.randint(1, min(R, ctx[b]) + 1)
     pt = jnp.asarray(page_tables)
     cl = jnp.asarray(ctx)
+    rb = jnp.asarray(base)
 
     for layer in (0, L - 1):
         li = jnp.int32(layer)
-        ref = paged_decode_attention_reference(q, k_cache, v_cache, li, pt, cl)
+        ref = paged_decode_attention_reference(
+            q, k_cache, v_cache, ring_k, ring_v, li, pt, cl, rb
+        )
         got = paged_decode_attention_pallas(
-            q, k_cache, v_cache, li, pt, cl, interpret=True
+            q, k_cache, v_cache, ring_k, ring_v, li, pt, cl, rb,
+            interpret=True,
         )
         np.testing.assert_allclose(
             np.asarray(got), np.asarray(ref), rtol=2e-5, atol=2e-5
         )
 
 
+def test_kernel_ring_only_context():
+    """ctx entirely inside the ring (ring_base=0): pool pages contribute
+    nothing; first decode steps after an empty-prefix admission hit this."""
+    rng = np.random.RandomState(2)
+    B, nh, nkv, hd, ps, R = 2, 4, 2, 32, 8, 4
+    q = jnp.asarray(rng.randn(B, nh, hd), jnp.float32)
+    k_cache = jnp.asarray(rng.randn(2, nkv, 5, ps, hd), jnp.float32)
+    v_cache = jnp.asarray(rng.randn(2, nkv, 5, ps, hd), jnp.float32)
+    ring_k = jnp.asarray(rng.randn(2, nkv, B, R, hd), jnp.float32)
+    ring_v = jnp.asarray(rng.randn(2, nkv, B, R, hd), jnp.float32)
+    pt = jnp.asarray(np.zeros((B, 3), np.int32))
+    cl = jnp.asarray(np.array([2, R], np.int32))
+    rb = jnp.asarray(np.zeros(B, np.int32))
+    li = jnp.int32(0)
+    ref = paged_decode_attention_reference(
+        q, k_cache, v_cache, ring_k, ring_v, li, pt, cl, rb
+    )
+    got = paged_decode_attention_pallas(
+        q, k_cache, v_cache, ring_k, ring_v, li, pt, cl, rb, interpret=True
+    )
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+
 def test_kernel_inactive_slot_all_zero_table():
-    """Inactive decode slots: table all page-0, ctx=1 — must not NaN."""
+    """Inactive decode slots: table all page-0, ctx=1, ring_base=0 — must
+    not NaN (exactly one valid ring entry)."""
     rng = np.random.RandomState(1)
-    q = jnp.asarray(rng.randn(2, 4, 32), jnp.float32)
+    B, R = 2, 4
+    q = jnp.asarray(rng.randn(B, 4, 32), jnp.float32)
     k_cache = jnp.asarray(rng.randn(2, 2, 5, 8, 32), jnp.float32)
     v_cache = jnp.asarray(rng.randn(2, 2, 5, 8, 32), jnp.float32)
-    pt = jnp.asarray(np.zeros((2, 3), np.int32))
+    ring_k = jnp.asarray(rng.randn(2, 2, B, R, 32), jnp.float32)
+    ring_v = jnp.asarray(rng.randn(2, 2, B, R, 32), jnp.float32)
+    pt = jnp.asarray(np.zeros((B, 3), np.int32))
     cl = jnp.asarray(np.array([1, 1], np.int32))
+    rb = jnp.asarray(np.zeros(B, np.int32))
     li = jnp.int32(1)
-    got = paged_decode_attention_pallas(q, k_cache, v_cache, li, pt, cl, interpret=True)
-    ref = paged_decode_attention_reference(q, k_cache, v_cache, li, pt, cl)
+    got = paged_decode_attention_pallas(
+        q, k_cache, v_cache, ring_k, ring_v, li, pt, cl, rb, interpret=True
+    )
+    ref = paged_decode_attention_reference(
+        q, k_cache, v_cache, ring_k, ring_v, li, pt, cl, rb
+    )
     assert np.isfinite(np.asarray(got)).all()
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=2e-5, atol=2e-5)
