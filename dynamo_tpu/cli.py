@@ -1,7 +1,8 @@
-"""dynamo-tpu CLI entrypoint (``dynamo-tpu run in=<input> out=<engine>``).
+"""dynamo-tpu CLI (``python -m dynamo_tpu.cli run in=<input> out=<engine>``).
 
-Mirrors the reference's launcher surface (launch/dynamo-run/src/main.rs);
-subcommands are filled in as the corresponding subsystems land.
+Mirrors the reference's launcher surface (launch/dynamo-run/src/main.rs).
+Subcommands:
+  run   serve a graph: in=<http|text|stdin|batch:FILE> out=<echo|mocker|tpu>
 """
 from __future__ import annotations
 
@@ -10,13 +11,16 @@ import sys
 
 def main(argv: list[str] | None = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
-    try:
-        from dynamo_tpu.launch.run import run_cli  # deferred: pulls in jax
-    except ImportError as e:
-        print(f"dynamo-tpu: launcher not available ({e})", file=sys.stderr)
-        return 2
+    if not argv or argv[0] in ("-h", "--help"):
+        print(__doc__)
+        return 0
+    cmd, rest = argv[0], argv[1:]
+    if cmd == "run":
+        from dynamo_tpu.launch.run import run_cli
 
-    return run_cli(argv)
+        return run_cli(rest)
+    print(f"dynamo-tpu: unknown subcommand {cmd!r}", file=sys.stderr)
+    return 2
 
 
 if __name__ == "__main__":

@@ -427,11 +427,12 @@ class TpuEngine:
             self.step_count += 1
         # round boundary: batch-scatter the ring into the page pool. Ring
         # entries past a slot's context cap repeat the clamped position —
-        # only the first cap-ring_base entries are real.
+        # only the first cap-ring_base entries are real. flush takes the
+        # FULL-width table (its contract): one compile, no width clipping.
         valid = np.minimum(n, self._cap_disp - ring_base_np).astype(np.int32)
         self.cache = llama.flush(
-            self.config, self.cache, self.ring, pt_dev, ring_base,
-            jnp.asarray(valid),
+            self.config, self.cache, self.ring, jnp.asarray(self._pt_disp),
+            ring_base, jnp.asarray(valid),
         )
         stacked = self._stack(*handles)
         stacked.copy_to_host_async()

@@ -1,0 +1,10 @@
+"""OpenAI-compatible HTTP frontend (reference lib/llm/src/http/service).
+
+`HttpService` serves /v1/chat/completions, /v1/completions, /v1/models,
+/health and Prometheus /metrics over the model chains registered in a
+`ModelManager` (preprocessor -> engine -> backend).
+"""
+from dynamo_tpu.frontend.model_manager import ModelChain, ModelManager
+from dynamo_tpu.frontend.service import HttpService
+
+__all__ = ["HttpService", "ModelManager", "ModelChain"]

@@ -1,0 +1,384 @@
+"""OpenAI HTTP service on aiohttp (reference lib/llm/src/http/service:
+service_v2.rs:50 HttpService, openai.rs:133,287 handlers, metrics.rs:104).
+
+Endpoints:
+  POST /v1/chat/completions   (streamed SSE or aggregated JSON)
+  POST /v1/completions
+  GET  /v1/models
+  GET  /health, /live
+  GET  /metrics               (Prometheus)
+  POST /clear_kv_blocks       (reference clear_kv_blocks.rs)
+
+Streaming honours client disconnect: closing the HTTP connection closes the
+response generator, which cancels the engine request (the engine's
+drop-to-cancel contract — reference AsyncEngineContext::stop_generating).
+"""
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from typing import Any, AsyncIterator, Optional
+
+from aiohttp import web
+from prometheus_client import (
+    CollectorRegistry,
+    Counter,
+    Gauge,
+    Histogram,
+    generate_latest,
+    CONTENT_TYPE_LATEST,
+)
+from pydantic import ValidationError
+
+from dynamo_tpu.frontend.model_manager import ModelManager, ModelNotFound
+from dynamo_tpu.protocols.common import FinishReason, LLMEngineOutput
+from dynamo_tpu.protocols.openai import (
+    ChatCompletionRequest,
+    CompletionRequest,
+    DeltaGenerator,
+    chat_completion_response,
+    completion_response,
+    make_id,
+    model_list_response,
+)
+from dynamo_tpu.protocols.sse import encode_done, encode_event
+
+log = logging.getLogger(__name__)
+
+
+class ServiceMetrics:
+    """Frontend Prometheus metrics (reference metrics.rs
+    nv_llm_http_service_{requests_total,inflight_requests,request_duration_seconds})."""
+
+    def __init__(self, registry: Optional[CollectorRegistry] = None):
+        self.registry = registry or CollectorRegistry()
+        self.requests_total = Counter(
+            "dynamo_http_service_requests_total",
+            "HTTP requests by model/endpoint/status",
+            ["model", "endpoint", "status"],
+            registry=self.registry,
+        )
+        self.inflight = Gauge(
+            "dynamo_http_service_inflight_requests",
+            "In-flight requests",
+            ["model"],
+            registry=self.registry,
+        )
+        self.duration = Histogram(
+            "dynamo_http_service_request_duration_seconds",
+            "Request duration",
+            ["model"],
+            registry=self.registry,
+        )
+
+    def render(self) -> bytes:
+        return generate_latest(self.registry)
+
+
+def _error(status: int, message: str, err_type: str = "invalid_request_error") -> web.Response:
+    return web.json_response(
+        {"error": {"message": message, "type": err_type, "code": status}},
+        status=status,
+    )
+
+
+class HttpService:
+    """The OpenAI-compatible frontend over a ModelManager."""
+
+    def __init__(
+        self,
+        manager: Optional[ModelManager] = None,
+        *,
+        host: str = "0.0.0.0",
+        port: int = 8080,
+    ):
+        self.manager = manager or ModelManager()
+        self.host = host
+        self.port = port
+        self.metrics = ServiceMetrics()
+        self.app = web.Application()
+        self.app.add_routes(
+            [
+                web.post("/v1/chat/completions", self.handle_chat),
+                web.post("/v1/completions", self.handle_completion),
+                web.get("/v1/models", self.handle_models),
+                web.get("/health", self.handle_health),
+                web.get("/live", self.handle_health),
+                web.get("/metrics", self.handle_metrics),
+                web.post("/clear_kv_blocks", self.handle_clear_kv),
+            ]
+        )
+        self._runner: Optional[web.AppRunner] = None
+        self._start_time = time.monotonic()
+
+    # ------------------------------------------------------------------
+    # lifecycle
+
+    async def start(self) -> None:
+        self._runner = web.AppRunner(self.app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.host, self.port)
+        await site.start()
+        log.info("http service listening on %s:%d", self.host, self.port)
+
+    async def stop(self) -> None:
+        if self._runner is not None:
+            await self._runner.cleanup()
+            self._runner = None
+
+    # ------------------------------------------------------------------
+    # handlers
+
+    async def handle_health(self, request: web.Request) -> web.Response:
+        return web.json_response(
+            {
+                "status": "healthy",
+                "uptime_s": round(time.monotonic() - self._start_time, 3),
+                "models": self.manager.list_models(),
+            }
+        )
+
+    async def handle_models(self, request: web.Request) -> web.Response:
+        return web.json_response(model_list_response(self.manager.list_models()))
+
+    async def handle_metrics(self, request: web.Request) -> web.Response:
+        return web.Response(
+            body=self.metrics.render(), content_type=CONTENT_TYPE_LATEST.split(";")[0]
+        )
+
+    async def handle_clear_kv(self, request: web.Request) -> web.Response:
+        cleared = []
+        for name in self.manager.list_models():
+            engine = self.manager.get(name).engine
+            reset = getattr(engine, "clear_kv_blocks", None)
+            if reset is not None:
+                res = reset()
+                if asyncio.iscoroutine(res):
+                    await res
+                cleared.append(name)
+        return web.json_response({"cleared": cleared})
+
+    async def handle_chat(self, request: web.Request) -> web.StreamResponse:
+        return await self._handle_openai(request, chat=True)
+
+    async def handle_completion(self, request: web.Request) -> web.StreamResponse:
+        return await self._handle_openai(request, chat=False)
+
+    # ------------------------------------------------------------------
+    # core request path
+
+    async def _handle_openai(
+        self, request: web.Request, *, chat: bool
+    ) -> web.StreamResponse:
+        endpoint = "chat_completions" if chat else "completions"
+        model = ""
+        status = "500"
+        t0 = time.monotonic()
+        try:
+            try:
+                body = await request.json()
+            except Exception:
+                status = "400"
+                return _error(400, "invalid JSON body")
+            try:
+                req = (ChatCompletionRequest if chat else CompletionRequest)(**body)
+            except ValidationError as e:
+                status = "400"
+                return _error(400, e.errors()[0].get("msg", "invalid request"))
+            model = req.model
+            try:
+                chain = self.manager.get(req.model, chat=chat, completion=not chat)
+            except ModelNotFound:
+                status = "404"
+                return _error(404, f"model '{req.model}' not found", "not_found_error")
+            try:
+                pre = chain.preprocess(req)
+            except ValueError as e:
+                status = "400"
+                return _error(400, str(e))
+
+            self.metrics.inflight.labels(model).inc()
+            try:
+                if req.stream:
+                    resp = await self._stream_response(request, req, chain, pre, chat)
+                else:
+                    resp = await self._unary_response(req, chain, pre, chat)
+                status = str(resp.status)
+                return resp
+            finally:
+                self.metrics.inflight.labels(model).dec()
+        except asyncio.CancelledError:
+            status = "499"
+            raise
+        except Exception:
+            log.exception("%s handler failed", endpoint)
+            return _error(500, "internal error", "internal_server_error")
+        finally:
+            self.metrics.requests_total.labels(model, endpoint, status).inc()
+            self.metrics.duration.labels(model).observe(time.monotonic() - t0)
+
+    def _fanout(self, req, chain, pre) -> list[AsyncIterator[LLMEngineOutput]]:
+        """n>1: run n independent engine streams (distinct seeds per choice,
+        like the reference's engines do for best-of/n sampling)."""
+        n = max(1, req.n)
+        streams = []
+        for i in range(n):
+            p = pre if n == 1 else _with_choice_seed(pre, i)
+            streams.append(chain.generate(p))
+        return streams
+
+    async def _unary_response(
+        self, req, chain, pre, chat: bool
+    ) -> web.Response:
+        streams = self._fanout(req, chain, pre)
+        texts = [""] * len(streams)
+        tokens = [0] * len(streams)
+        finishes: list[FinishReason] = [FinishReason.EOS] * len(streams)
+
+        async def drain(i: int) -> None:
+            try:
+                async for out in streams[i]:
+                    if out.text:
+                        texts[i] += out.text
+                    tokens[i] += len(out.token_ids)
+                    if out.finish_reason is not None:
+                        finishes[i] = out.finish_reason
+            finally:
+                close = getattr(streams[i], "aclose", None)
+                if close is not None:
+                    await close()
+
+        results = await asyncio.gather(
+            *[drain(i) for i in range(len(streams))], return_exceptions=True
+        )
+        for r in results:
+            if isinstance(r, BaseException):
+                raise r
+        if chat:
+            choices = [
+                {
+                    "index": i,
+                    "message": {"role": "assistant", "content": texts[i]},
+                    "finish_reason": finishes[i].to_openai(),
+                }
+                for i in range(len(streams))
+            ]
+            body = chat_completion_response(
+                rid=make_id("chatcmpl"),
+                model=req.model,
+                choices=choices,
+                prompt_tokens=len(pre.token_ids),
+                completion_tokens=sum(tokens),
+            )
+        else:
+            choices = [
+                {
+                    "index": i,
+                    "text": texts[i],
+                    "finish_reason": finishes[i].to_openai(),
+                    "logprobs": None,
+                }
+                for i in range(len(streams))
+            ]
+            body = completion_response(
+                rid=make_id("cmpl"),
+                model=req.model,
+                choices=choices,
+                prompt_tokens=len(pre.token_ids),
+                completion_tokens=sum(tokens),
+            )
+        return web.json_response(body)
+
+    async def _stream_response(
+        self, request: web.Request, req, chain, pre, chat: bool
+    ) -> web.StreamResponse:
+        resp = web.StreamResponse(
+            status=200,
+            headers={
+                "Content-Type": "text/event-stream",
+                "Cache-Control": "no-cache",
+                "Connection": "keep-alive",
+            },
+        )
+        await resp.prepare(request)
+        gen = DeltaGenerator(req.model, chat=chat, n=max(1, req.n))
+        streams = self._fanout(req, chain, pre)
+        completion_tokens = 0
+        queue: asyncio.Queue = asyncio.Queue()
+        DONE = object()
+
+        async def pump(i: int) -> None:
+            try:
+                async for out in streams[i]:
+                    await queue.put((i, out))
+            except Exception as e:  # surfaced in-band per choice
+                await queue.put((i, e))
+            finally:
+                await queue.put((i, DONE))
+
+        tasks = [asyncio.create_task(pump(i)) for i in range(len(streams))]
+        live = len(streams)
+        try:
+            while live:
+                i, item = await queue.get()
+                if item is DONE:
+                    live -= 1
+                    continue
+                if isinstance(item, Exception):
+                    # the failed pump's DONE sentinel still arrives and
+                    # decrements `live`; just surface the error in-band
+                    log.warning("engine stream %d failed: %s", i, item)
+                    await resp.write(
+                        encode_event({"error": {"message": str(item)}})
+                    )
+                    continue
+                completion_tokens += len(item.token_ids)
+                if item.text:
+                    await resp.write(
+                        encode_event(gen.text_chunk(item.text, index=i))
+                    )
+                if item.finish_reason is not None:
+                    await resp.write(
+                        encode_event(gen.finish_chunk(item.finish_reason, index=i))
+                    )
+            if req.stream_options and req.stream_options.include_usage:
+                await resp.write(
+                    encode_event(
+                        gen.usage_chunk(len(pre.token_ids), completion_tokens)
+                    )
+                )
+            await resp.write(encode_done())
+        except (ConnectionResetError, asyncio.CancelledError):
+            log.info("client disconnected mid-stream")
+            raise
+        finally:
+            for t in tasks:
+                t.cancel()
+            for s in streams:
+                close = getattr(s, "aclose", None)
+                if close is not None:
+                    try:
+                        await close()
+                    except Exception:  # noqa: BLE001
+                        pass
+        await resp.write_eof()
+        return resp
+
+
+def _with_choice_seed(pre, i: int):
+    """Give choice i>0 a distinct sampling seed so n choices differ."""
+    import copy
+
+    if i == 0:
+        return pre
+    p = copy.copy(pre)
+    p.sampling_options = copy.copy(pre.sampling_options)
+    if p.sampling_options.seed is not None:
+        p.sampling_options.seed = p.sampling_options.seed + i
+    else:
+        p.sampling_options.seed = 0x5EED ^ (i * 0x9E3779B9)
+    import uuid
+
+    p.request_id = uuid.uuid4().hex
+    return p

@@ -1,0 +1,2 @@
+"""Launcher: builds and runs serving graphs from CLI flags (reference
+launch/dynamo-run)."""

@@ -1,0 +1,211 @@
+"""`dynamo-tpu run in=<input> out=<engine>` launcher.
+
+Mirrors the reference `dynamo-run` CLI (launch/dynamo-run/src/lib.rs:94-165,
+flags.rs): pick an input plane (http | text | stdin | batch:<file>) and an
+engine (echo | mocker | tpu), wire the chain, run it.
+
+Inputs (reference entrypoint/input.rs:29-45):
+  in=http        OpenAI HTTP frontend on --http-port
+  in=text        one-shot prompt from --prompt (or interactive REPL)
+  in=stdin       read prompts line-by-line from stdin
+  in=batch:FILE  JSONL of {"prompt": ...}; writes completions JSONL to stdout
+
+Engines:
+  out=echo       deterministic token echo (tests/smoke)
+  out=mocker     simulated paged-KV engine (CPU, timing-faithful)
+  out=tpu        the JAX TPU engine (requires --model-path or canned config)
+"""
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import sys
+from typing import Any, Optional
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="dynamo-tpu run",
+        description="Run a dynamo-tpu serving graph",
+    )
+    p.add_argument("io", nargs="*", help="in=<http|text|stdin|batch:FILE> out=<echo|mocker|tpu>")
+    p.add_argument("--model-path", help="local HF model dir (config/tokenizer/safetensors)")
+    p.add_argument("--model-name", default=None, help="served model name")
+    p.add_argument("--model-config", default=None,
+                   help="canned config (tiny|llama3_1b|llama3_8b|llama3_70b) for random-weight serving")
+    p.add_argument("--http-host", default="0.0.0.0")
+    p.add_argument("--http-port", type=int, default=8080)
+    p.add_argument("--prompt", default=None, help="prompt for in=text")
+    p.add_argument("--max-tokens", type=int, default=64)
+    p.add_argument("--tensor-parallel-size", type=int, default=1)
+    p.add_argument("--num-pages", type=int, default=512)
+    p.add_argument("--page-size", type=int, default=64)
+    p.add_argument("--max-decode-slots", type=int, default=8)
+    p.add_argument("--cache-dtype", default="bfloat16")
+    return p
+
+
+def _parse_io(io: list[str]) -> tuple[str, str]:
+    inp, out = "http", "echo"
+    for item in io:
+        if item.startswith("in="):
+            inp = item[3:]
+        elif item.startswith("out="):
+            out = item[4:]
+        else:
+            raise SystemExit(f"unrecognized arg {item!r} (expected in=/out=)")
+    return inp, out
+
+
+def build_chain(args) -> "Any":
+    """Construct the ModelChain for the selected engine."""
+    from dynamo_tpu.backend import Backend
+    from dynamo_tpu.frontend.model_manager import ModelChain
+    from dynamo_tpu.preprocessor import OpenAIPreprocessor, PromptFormatter
+    from dynamo_tpu.tokenizer import HfTokenizer, make_test_tokenizer
+
+    inp, out = _parse_io(args.io)
+
+    if args.model_path:
+        tok = HfTokenizer.from_dir(args.model_path)
+        fmt = PromptFormatter.from_dir(args.model_path)
+        name = args.model_name or os.path.basename(args.model_path.rstrip("/"))
+    else:
+        tok = make_test_tokenizer()
+        fmt = PromptFormatter()
+        name = args.model_name or "echo"
+
+    if out == "echo":
+        from dynamo_tpu.engines import EchoEngine
+
+        engine: Any = EchoEngine()
+    elif out == "mocker":
+        from dynamo_tpu.mocker import MockerArgs, MockerEngine
+
+        engine = MockerEngine(MockerArgs())
+    elif out == "tpu":
+        from dynamo_tpu.engine.config import EngineConfig
+        from dynamo_tpu.engine.engine import TpuEngine
+        from dynamo_tpu.models.config import ModelConfig
+        from dynamo_tpu.parallel.mesh import MeshConfig
+
+        if args.model_path:
+            cfg = ModelConfig.from_pretrained(args.model_path)
+        elif args.model_config:
+            cfg = getattr(ModelConfig, args.model_config)()
+        else:
+            raise SystemExit("out=tpu needs --model-path or --model-config")
+        ecfg = EngineConfig(
+            num_pages=args.num_pages,
+            page_size=args.page_size,
+            max_decode_slots=args.max_decode_slots,
+            cache_dtype=args.cache_dtype,
+        )
+        params = None
+        if args.model_path:
+            from dynamo_tpu.models import llama
+
+            params = llama.load_hf_params(cfg, args.model_path)
+        engine = TpuEngine(
+            cfg, ecfg, params=params,
+            mesh_config=MeshConfig(tp=args.tensor_parallel_size),
+        )
+    else:
+        raise SystemExit(f"unknown engine out={out!r}")
+
+    pre = OpenAIPreprocessor(tokenizer=tok, formatter=fmt, model_name=name)
+    return inp, ModelChain(
+        name=name, preprocessor=pre, engine=engine, backend=Backend(tok)
+    )
+
+
+async def _serve_http(args, chain) -> None:
+    from dynamo_tpu.frontend import HttpService, ModelManager
+
+    manager = ModelManager()
+    manager.register(chain)
+    svc = HttpService(manager, host=args.http_host, port=args.http_port)
+    await svc.start()
+    print(f"serving {chain.name!r} on http://{args.http_host}:{args.http_port}")
+    try:
+        while True:
+            await asyncio.sleep(3600)
+    finally:
+        await svc.stop()
+
+
+async def _one_prompt(chain, prompt: str, max_tokens: int) -> str:
+    from dynamo_tpu.protocols.openai import ChatCompletionRequest
+
+    req = ChatCompletionRequest(
+        model=chain.name,
+        messages=[{"role": "user", "content": prompt}],
+        max_tokens=max_tokens,
+    )
+    pre = chain.preprocess(req)
+    parts = []
+    async for out in chain.generate(pre):
+        if out.text:
+            parts.append(out.text)
+    return "".join(parts)
+
+
+async def _serve_text(args, chain) -> None:
+    if args.prompt is not None:
+        print(await _one_prompt(chain, args.prompt, args.max_tokens))
+        return
+    # interactive REPL
+    while True:
+        try:
+            line = await asyncio.to_thread(input, "> ")
+        except EOFError:
+            return
+        if line.strip():
+            print(await _one_prompt(chain, line, args.max_tokens))
+
+
+async def _serve_stdin(args, chain) -> None:
+    for line in sys.stdin:
+        if line.strip():
+            print(await _one_prompt(chain, line.strip(), args.max_tokens))
+
+
+async def _serve_batch(args, chain, path: str) -> None:
+    with open(path) as f:
+        recs = [json.loads(line) for line in f if line.strip()]
+    # submit concurrently so the continuous-batching engine actually batches
+    sem = asyncio.Semaphore(64)
+
+    async def one(rec):
+        async with sem:
+            return await _one_prompt(
+                chain, rec.get("prompt", ""), rec.get("max_tokens", args.max_tokens)
+            )
+
+    texts = await asyncio.gather(*[one(r) for r in recs])
+    for rec, text in zip(recs, texts):
+        print(json.dumps({"prompt": rec.get("prompt", ""), "text": text}))
+
+
+def run_cli(argv: list[str]) -> int:
+    args = build_parser().parse_args(argv)
+    inp, chain = build_chain(args)
+    engine_start = getattr(chain.engine, "start", None)
+    if engine_start is not None:
+        engine_start()
+    try:
+        if inp == "http":
+            asyncio.run(_serve_http(args, chain))
+        elif inp == "text":
+            asyncio.run(_serve_text(args, chain))
+        elif inp == "stdin":
+            asyncio.run(_serve_stdin(args, chain))
+        elif inp.startswith("batch:"):
+            asyncio.run(_serve_batch(args, chain, inp[len("batch:"):]))
+        else:
+            raise SystemExit(f"unknown input in={inp!r}")
+    except KeyboardInterrupt:
+        pass
+    return 0

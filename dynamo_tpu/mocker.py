@@ -1,0 +1,344 @@
+"""Mocker: a deterministic fake engine with simulated paged-KV and timing.
+
+The reference calls this the keystone of its CPU test strategy
+(lib/llm/src/mocker/engine.rs:60 MockVllmEngine, mocker/kv_manager.rs,
+mocker/scheduler.rs:197, MockEngineArgs mocker/protocols.rs:72-94): a fake
+engine that behaves like the real one — continuous batching, paged-KV
+allocation with prefix reuse and LRU eviction, preemption under pressure,
+per-step timing scaled by ``speedup_ratio`` — while publishing REAL
+KvCacheEvents and ForwardPassMetrics. It lets the router, disagg path,
+planner, frontend, and fault-injection tests run on CPU with no JAX model.
+
+This implementation reuses the engine's actual host-side state machinery:
+`PageAllocator` (same events, same LRU/refcount semantics) and
+`TokenBlockSequence` (same chained xxh3 block hashes the KV router indexes),
+so mocker-driven router tests validate real hash parity.
+
+Generated tokens are deterministic: step i of a request yields
+``prompt[(i + len(prompt)) % len(prompt)]`` — stable across runs and
+schedulings, like the reference's deterministic mock outputs.
+"""
+from __future__ import annotations
+
+import asyncio
+import time
+from dataclasses import dataclass, field
+from typing import AsyncIterator, Callable, Optional
+
+from dynamo_tpu.engine.cache import PageAllocator
+from dynamo_tpu.kv_router.protocols import (
+    ForwardPassMetrics,
+    KvCacheEvent,
+    KvStats,
+    WorkerStats,
+)
+from dynamo_tpu.protocols.common import (
+    FinishReason,
+    LLMEngineOutput,
+    PreprocessedRequest,
+)
+from dynamo_tpu.tokens import TokenBlockSequence
+
+
+@dataclass
+class MockerArgs:
+    """Knobs of the simulated engine (reference MockEngineArgs
+    mocker/protocols.rs:72-94: num_gpu_blocks, block_size, speedup_ratio,
+    max_num_seqs, watermark...)."""
+
+    num_pages: int = 128
+    page_size: int = 16
+    max_decode_slots: int = 8
+    max_pages_per_seq: int = 64
+    # simulated timing (wall-clock sleeps, divided by speedup_ratio)
+    prefill_time_per_token_s: float = 0.00005
+    decode_time_per_step_s: float = 0.002
+    speedup_ratio: float = 1.0
+    enable_prefix_caching: bool = True
+    worker_id: str = "mocker"
+
+
+@dataclass
+class _MockRequest:
+    req: PreprocessedRequest
+    seq: TokenBlockSequence
+    out: asyncio.Queue
+    orig_prompt: list[int] = field(default_factory=list)  # pre-preemption
+    pages: list[int] = field(default_factory=list)
+    produced: int = 0
+    last_token: int = -1
+    cancelled: bool = False
+    prefilling: bool = False
+    enqueue_time: float = field(default_factory=time.monotonic)
+
+    @property
+    def prompt(self) -> list[int]:
+        return self.req.token_ids
+
+
+class MockerEngine:
+    """AsyncEngine-contract fake engine; single asyncio loop, no threads."""
+
+    def __init__(
+        self,
+        args: Optional[MockerArgs] = None,
+        *,
+        on_kv_event: Optional[Callable[[KvCacheEvent], None]] = None,
+        on_metrics: Optional[Callable[[ForwardPassMetrics], None]] = None,
+    ):
+        self.args = args or MockerArgs()
+        self.on_metrics = on_metrics
+        self.allocator = PageAllocator(
+            self.args.num_pages,
+            self.args.page_size,
+            worker_id=self.args.worker_id,
+            on_event=on_kv_event,
+            enable_prefix_caching=self.args.enable_prefix_caching,
+        )
+        self._waiting: list[_MockRequest] = []
+        self._active: list[_MockRequest] = []
+        self._task: Optional[asyncio.Task] = None
+        self._wake = asyncio.Event()
+        self.step_count = 0
+        self.tokens_generated = 0
+        self.preemptions = 0
+
+    # ------------------------------------------------------------------
+    # lifecycle
+
+    def start(self) -> None:
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            return  # no loop yet; generate() starts the task lazily
+        if self._task is None or self._task.done():
+            self._task = loop.create_task(self._run())
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+
+    def clear_kv_blocks(self) -> int:
+        return self.allocator.clear()
+
+    # ------------------------------------------------------------------
+    # AsyncEngine surface
+
+    async def generate(
+        self, request: PreprocessedRequest
+    ) -> AsyncIterator[LLMEngineOutput]:
+        if self._task is None or self._task.done():
+            self.start()
+        if not request.token_ids:
+            raise ValueError("empty prompt")
+        r = _MockRequest(
+            req=request,
+            seq=TokenBlockSequence.from_tokens(
+                request.token_ids, self.args.page_size, salt=request.model
+            ),
+            out=asyncio.Queue(),
+            orig_prompt=list(request.token_ids),
+        )
+        self._waiting.append(r)
+        self._wake.set()
+        try:
+            while True:
+                item = await r.out.get()
+                if isinstance(item, Exception):
+                    raise item
+                yield item
+                if item.finished:
+                    return
+        finally:
+            r.cancelled = True
+            self._wake.set()
+
+    def metrics(self) -> ForwardPassMetrics:
+        a = self.allocator
+        return ForwardPassMetrics(
+            worker_id=self.args.worker_id,
+            worker_stats=WorkerStats(
+                request_active_slots=len(self._active),
+                request_total_slots=self.args.max_decode_slots,
+                num_requests_waiting=len(self._waiting),
+            ),
+            kv_stats=KvStats(
+                kv_active_blocks=a.active_pages,
+                kv_total_blocks=a.total_pages,
+                gpu_cache_usage_perc=a.usage(),
+                gpu_prefix_cache_hit_rate=a.hit_rate(),
+            ),
+        )
+
+    # ------------------------------------------------------------------
+    # simulated engine loop
+
+    async def _run(self) -> None:
+        a = self.args
+        while True:
+            self._sweep_cancelled()
+            self._admit()
+            if not self._active:
+                self._wake.clear()
+                if not self._waiting:
+                    await self._wake.wait()
+                else:
+                    # waiting but unadmittable (page pressure): idle-tick
+                    await asyncio.sleep(
+                        a.decode_time_per_step_s / a.speedup_ratio
+                    )
+                continue
+            # one simulated decode step for the whole batch
+            await asyncio.sleep(a.decode_time_per_step_s / a.speedup_ratio)
+            self.step_count += 1
+            for r in list(self._active):
+                self._decode_one(r)
+            if self.on_metrics is not None:
+                self.on_metrics(self.metrics())
+
+    def _sweep_cancelled(self) -> None:
+        for r in list(self._active):
+            if r.cancelled:
+                self._release(r)
+        self._waiting = [r for r in self._waiting if not r.cancelled]
+
+    def _admit(self) -> None:
+        a = self.args
+        while self._waiting and len(self._active) < a.max_decode_slots:
+            r = self._waiting[0]
+            ps = a.page_size
+            hashes = r.seq.block_hashes()
+            matched = self.allocator.match_prefix(
+                hashes[: max(0, (len(r.prompt) - 1) // ps)]
+            )
+            n_pages = (len(r.prompt) + ps - 1) // ps
+            if n_pages > min(self.allocator.total_pages, a.max_pages_per_seq):
+                # can never fit: fail instead of blocking the queue forever
+                self._waiting.pop(0)
+                r.out.put_nowait(ValueError("prompt does not fit page table"))
+                continue
+            fresh = self.allocator.allocate(n_pages - len(matched))
+            if fresh is None:
+                self.allocator.free(matched)
+                return  # head-of-line blocks until space frees
+            r.pages = matched + fresh
+            r.prefilling = True
+            self._waiting.pop(0)
+            self._active.append(r)
+            # simulated prefill cost for the non-cached suffix
+            n_uncached = len(r.prompt) - len(matched) * ps
+            delay = n_uncached * a.prefill_time_per_token_s / a.speedup_ratio
+            # commit complete prompt blocks (prefix-shareable immediately)
+            for blk in r.seq.blocks[len(matched):]:
+                if blk.position < len(r.pages):
+                    self.allocator.commit(
+                        r.pages[blk.position], blk.block_hash, blk.parent_hash
+                    )
+            asyncio.get_running_loop().create_task(
+                self._emit_first(r, delay)
+            )
+
+    async def _emit_first(self, r: _MockRequest, delay: float) -> None:
+        if delay > 0:
+            await asyncio.sleep(delay)
+        r.prefilling = False
+        if r.cancelled or r not in self._active:
+            return  # preempted mid-prefill; readmission re-schedules
+        self._emit_token(r, self._next_token(r))
+
+    def _next_token(self, r: _MockRequest) -> int:
+        # derived from the ORIGINAL prompt + absolute step index, so the
+        # stream is identical regardless of preemption/restart scheduling
+        p = r.orig_prompt
+        return p[(r.produced + len(p)) % len(p)]
+
+    def _decode_one(self, r: _MockRequest) -> None:
+        a = self.args
+        if r not in self._active:
+            return  # preempted/released earlier in this same round
+        if r.prefilling or r.produced == 0:
+            return  # still in simulated prefill
+        # seal/commit the block completed by the previous emitted token;
+        # clear last_token afterwards so a preemption between sealing and
+        # the next emission doesn't re-append it to the restart prompt
+        if r.last_token >= 0:
+            for blk in r.seq.extend([r.last_token]):
+                if blk.position < len(r.pages):
+                    self.allocator.commit(
+                        r.pages[blk.position], blk.block_hash, blk.parent_hash
+                    )
+            r.last_token = -1
+        # grow the page table for the next position
+        total = len(r.prompt) + r.produced
+        need_pages = total // a.page_size + 1
+        while len(r.pages) < min(need_pages, a.max_pages_per_seq):
+            got = self.allocator.allocate(1)
+            if got is None:
+                if not self._try_preempt(exclude=r):
+                    self._preempt(r)
+                    return
+                continue
+            r.pages.extend(got)
+        self._emit_token(r, self._next_token(r))
+
+    def _emit_token(self, r: _MockRequest, tok: int) -> None:
+        sc = r.req.stop_conditions
+        r.produced += 1
+        self.tokens_generated += 1
+        hit_eos = (
+            not sc.ignore_eos
+            and tok in (sc.stop_token_ids or [])
+            and (sc.min_tokens is None or r.produced >= sc.min_tokens)
+        )
+        if hit_eos:
+            r.out.put_nowait(
+                LLMEngineOutput(token_ids=[], finish_reason=FinishReason.EOS)
+            )
+            self._release(r)
+            return
+        r.last_token = tok
+        if sc.max_tokens is not None and r.produced >= sc.max_tokens:
+            r.out.put_nowait(
+                LLMEngineOutput(
+                    token_ids=[tok], finish_reason=FinishReason.LENGTH
+                )
+            )
+            self._release(r)
+            return
+        r.out.put_nowait(LLMEngineOutput(token_ids=[tok]))
+
+    def _release(self, r: _MockRequest) -> None:
+        self.allocator.free(r.pages)
+        r.pages = []
+        if r in self._active:
+            self._active.remove(r)
+
+    def _try_preempt(self, exclude: Optional[_MockRequest] = None) -> bool:
+        """Preempt the most recently admitted active request (LIFO, like the
+        engine and the reference mocker's eviction of the youngest)."""
+        victims = [r for r in self._active if r is not exclude and r.produced > 0]
+        if not victims:
+            return False
+        self._preempt(max(victims, key=lambda r: r.enqueue_time))
+        return True
+
+    def _preempt(self, victim: _MockRequest) -> None:
+        self.preemptions += 1
+        self.allocator.free(victim.pages)
+        victim.pages = []
+        new_prompt = victim.seq.tokens + (
+            [victim.last_token] if victim.last_token >= 0 else []
+        )
+        victim.req.token_ids = new_prompt
+        victim.seq = TokenBlockSequence.from_tokens(
+            new_prompt, self.args.page_size, salt=victim.req.model
+        )
+        victim.last_token = -1
+        if victim in self._active:
+            self._active.remove(victim)
+        self._waiting.insert(0, victim)

@@ -148,7 +148,11 @@ def make_test_tokenizer(vocab_words: Optional[list[str]] = None):
 
         def decode(self, ids, skip_special_tokens: bool = True) -> str:
             specials = {0, 1, 2} if skip_special_tokens else set()
-            return " ".join(self._inv[i] for i in ids if i not in specials)
+            # ids beyond the vocab (e.g. sampled from a larger model head)
+            # decode to <unk> rather than raising
+            return " ".join(
+                self._inv.get(i, "<unk>") for i in ids if i not in specials
+            )
 
         @property
         def vocab_size(self) -> int:

@@ -1,0 +1,262 @@
+"""HTTP frontend e2e tests (reference lib/llm/tests/http-service.rs:472).
+
+Drives the full chain — HTTP -> preprocessor -> engine -> backend -> SSE —
+against the echo engine (deterministic) and the real TpuEngine on the tiny
+CPU model.
+"""
+import json
+
+import numpy as np
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from dynamo_tpu.backend import Backend
+from dynamo_tpu.engines import EchoEngine
+from dynamo_tpu.frontend import HttpService, ModelChain, ModelManager
+from dynamo_tpu.preprocessor import OpenAIPreprocessor, PromptFormatter
+from dynamo_tpu.protocols.sse import SseDecoder
+from dynamo_tpu.tokenizer import make_test_tokenizer
+
+WORDS = [f"w{i}" for i in range(50)] + ["hello", "world", "STOP"]
+
+
+def make_echo_service() -> HttpService:
+    tok = make_test_tokenizer(WORDS)
+    fmt = PromptFormatter(
+        template="{% for m in messages %}{{ m.content }} {% endfor %}"
+    )
+    chain = ModelChain(
+        name="echo",
+        preprocessor=OpenAIPreprocessor(tokenizer=tok, formatter=fmt, model_name="echo"),
+        engine=EchoEngine(delay_s=0.0),
+        backend=Backend(tok),
+    )
+    manager = ModelManager()
+    manager.register(chain)
+    return HttpService(manager)
+
+
+async def with_client(svc):
+    client = TestClient(TestServer(svc.app))
+    await client.start_server()
+    return client
+
+
+async def sse_events(resp):
+    dec = SseDecoder()
+    events = []
+    async for chunk in resp.content.iter_any():
+        events.extend(dec.feed(chunk))
+    return events
+
+
+async def test_models_endpoint():
+    client = await with_client(make_echo_service())
+    r = await client.get("/v1/models")
+    assert r.status == 200
+    body = await r.json()
+    assert [m["id"] for m in body["data"]] == ["echo"]
+    await client.close()
+
+
+async def test_chat_completion_unary():
+    client = await with_client(make_echo_service())
+    r = await client.post(
+        "/v1/chat/completions",
+        json={
+            "model": "echo",
+            "messages": [{"role": "user", "content": "hello world"}],
+            "max_tokens": 2,
+        },
+    )
+    assert r.status == 200
+    body = await r.json()
+    assert body["object"] == "chat.completion"
+    # echo engine returns the prompt tokens back: "hello world"
+    assert body["choices"][0]["message"]["content"].strip() == "hello world"
+    assert body["choices"][0]["finish_reason"] == "length"
+    assert body["usage"]["completion_tokens"] == 2
+    await client.close()
+
+
+async def test_chat_completion_streaming():
+    client = await with_client(make_echo_service())
+    r = await client.post(
+        "/v1/chat/completions",
+        json={
+            "model": "echo",
+            "messages": [{"role": "user", "content": "hello world"}],
+            "max_tokens": 4,
+            "stream": True,
+            "stream_options": {"include_usage": True},
+        },
+    )
+    assert r.status == 200
+    assert r.headers["Content-Type"].startswith("text/event-stream")
+    events = await sse_events(r)
+    assert events[-1].is_done
+    chunks = [e.json() for e in events[:-1]]
+    text = "".join(
+        c["choices"][0]["delta"].get("content", "")
+        for c in chunks
+        if c.get("choices")
+    )
+    assert text.strip() == "hello world hello world"
+    finishes = [
+        c["choices"][0]["finish_reason"] for c in chunks if c.get("choices")
+    ]
+    assert finishes[-1] == "length"
+    usage = [c["usage"] for c in chunks if c.get("usage")]
+    assert usage and usage[0]["completion_tokens"] == 4
+    await client.close()
+
+
+async def test_completions_endpoint():
+    client = await with_client(make_echo_service())
+    r = await client.post(
+        "/v1/completions",
+        json={"model": "echo", "prompt": "hello world", "max_tokens": 2},
+    )
+    assert r.status == 200
+    body = await r.json()
+    assert body["object"] == "text_completion"
+    assert body["choices"][0]["text"].strip() == "hello world"
+    await client.close()
+
+
+async def test_stop_strings_enforced():
+    client = await with_client(make_echo_service())
+    r = await client.post(
+        "/v1/chat/completions",
+        json={
+            "model": "echo",
+            "messages": [{"role": "user", "content": "hello STOP world"}],
+            "max_tokens": 8,
+            "stop": ["STOP"],
+        },
+    )
+    body = await r.json()
+    # echo replays "hello STOP world ..." -> cut before STOP
+    assert body["choices"][0]["message"]["content"].strip() == "hello"
+    assert body["choices"][0]["finish_reason"] == "stop"
+    await client.close()
+
+
+async def test_unknown_model_404_and_bad_request_400():
+    client = await with_client(make_echo_service())
+    r = await client.post(
+        "/v1/chat/completions",
+        json={"model": "nope", "messages": [{"role": "user", "content": "x"}]},
+    )
+    assert r.status == 404
+    r = await client.post(
+        "/v1/chat/completions", json={"model": "echo", "messages": []}
+    )
+    assert r.status == 400
+    r = await client.post("/v1/chat/completions", data=b"{not json")
+    assert r.status == 400
+    await client.close()
+
+
+async def test_n_choices():
+    client = await with_client(make_echo_service())
+    r = await client.post(
+        "/v1/chat/completions",
+        json={
+            "model": "echo",
+            "messages": [{"role": "user", "content": "hello"}],
+            "max_tokens": 1,
+            "n": 2,
+        },
+    )
+    body = await r.json()
+    assert [c["index"] for c in body["choices"]] == [0, 1]
+    await client.close()
+
+
+async def test_metrics_and_health():
+    svc = make_echo_service()
+    client = await with_client(svc)
+    await client.post(
+        "/v1/chat/completions",
+        json={
+            "model": "echo",
+            "messages": [{"role": "user", "content": "hello"}],
+            "max_tokens": 1,
+        },
+    )
+    r = await client.get("/metrics")
+    text = await r.text()
+    assert 'dynamo_http_service_requests_total{' in text
+    assert 'model="echo"' in text
+    r = await client.get("/health")
+    body = await r.json()
+    assert body["status"] == "healthy" and body["models"] == ["echo"]
+    await client.close()
+
+
+# ---------------------------------------------------------------------------
+# e2e against the real engine on the tiny CPU model
+
+
+@pytest.fixture(scope="module")
+def tpu_service():
+    from dynamo_tpu.engine.config import EngineConfig
+    from dynamo_tpu.engine.engine import TpuEngine
+    from dynamo_tpu.models.config import ModelConfig
+    from dynamo_tpu.parallel.mesh import MeshConfig
+
+    # vocab larger than test tokenizer's so all token ids are valid
+    cfg = ModelConfig.tiny(dtype="float32")
+    ecfg = EngineConfig(
+        num_pages=64, page_size=16, max_pages_per_seq=8,
+        max_decode_slots=4, prefill_buckets=(32, 64), cache_dtype="float32",
+    )
+    engine = TpuEngine(cfg, ecfg, mesh_config=MeshConfig(tp=1))
+    tok = make_test_tokenizer(WORDS)
+    chain = ModelChain(
+        name="tiny",
+        preprocessor=OpenAIPreprocessor(tokenizer=tok, model_name="tiny"),
+        engine=engine,
+        backend=Backend(tok),
+    )
+    manager = ModelManager()
+    manager.register(chain)
+    # the manager/engine are loop-independent; each test builds a fresh
+    # HttpService (aiohttp Applications bind to one event loop)
+    yield manager
+
+
+async def test_tpu_engine_chat_stream_e2e(tpu_service):
+    client = await with_client(HttpService(tpu_service))
+    r = await client.post(
+        "/v1/chat/completions",
+        json={
+            "model": "tiny",
+            "messages": [{"role": "user", "content": "hello world w1 w2"}],
+            "max_tokens": 6,
+            "stream": True,
+        },
+    )
+    assert r.status == 200
+    events = await sse_events(r)
+    assert events[-1].is_done
+    chunks = [e.json() for e in events[:-1]]
+    finishes = [
+        c["choices"][0]["finish_reason"] for c in chunks if c.get("choices")
+    ]
+    assert finishes[-1] in ("stop", "length")
+    await client.close()
+
+
+async def test_tpu_engine_unary_deterministic(tpu_service):
+    client = await with_client(HttpService(tpu_service))
+    body = {
+        "model": "tiny",
+        "messages": [{"role": "user", "content": "hello world"}],
+        "max_tokens": 5,
+    }
+    r1 = await (await client.post("/v1/chat/completions", json=body)).json()
+    r2 = await (await client.post("/v1/chat/completions", json=body)).json()
+    assert r1["choices"][0]["message"]["content"] == r2["choices"][0]["message"]["content"]
+    await client.close()
