@@ -76,6 +76,9 @@ class _Request:
     seq: TokenBlockSequence
     out: asyncio.Queue
     loop: asyncio.AbstractEventLoop
+    # current (possibly restart-extended) prompt — kept separate from
+    # req.token_ids so preemption never mutates the caller's request object
+    tokens: list[int] = field(default_factory=list)
     pages: list[int] = field(default_factory=list)
     matched_blocks: int = 0
     slot: int = -1
@@ -88,7 +91,7 @@ class _Request:
 
     @property
     def prompt_len(self) -> int:
-        return len(self.req.token_ids)
+        return len(self.tokens)
 
     def max_new_tokens(self, max_context: int) -> int:
         mt = self.req.stop_conditions.max_tokens
@@ -320,6 +323,7 @@ class TpuEngine:
             ),
             out=asyncio.Queue(),
             loop=asyncio.get_running_loop(),
+            tokens=list(request.token_ids),
         )
         self._intake.put(r)
         try:
@@ -526,7 +530,7 @@ class TpuEngine:
         Returns False only when pages are unavailable."""
         e = self.ecfg
         ps = e.page_size
-        prompt = r.req.token_ids
+        prompt = r.tokens
         hashes = r.seq.block_hashes()
         matched_pages = self.allocator.match_prefix(
             hashes[: max(0, (len(prompt) - 1) // ps)]
@@ -751,7 +755,7 @@ class TpuEngine:
         new_prompt = victim.seq.tokens + (
             [victim.last_token] if victim.last_token >= 0 else []
         )
-        victim.req.token_ids = new_prompt
+        victim.tokens = new_prompt
         victim.seq = TokenBlockSequence.from_tokens(
             new_prompt, self.ecfg.page_size, salt=victim.req.model
         )

@@ -349,8 +349,13 @@ class HttpService:
                     )
                 )
             await resp.write(encode_done())
-        except (ConnectionResetError, asyncio.CancelledError):
+        except ConnectionResetError:
+            # routine client disconnect: not an error; the prepared
+            # StreamResponse is all we can return
             log.info("client disconnected mid-stream")
+            return resp
+        except asyncio.CancelledError:
+            log.info("request cancelled mid-stream")
             raise
         finally:
             for t in tasks:

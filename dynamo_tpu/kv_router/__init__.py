@@ -1,7 +1,42 @@
 """KV-cache-aware routing subsystem.
 
 Mirrors the reference's first-class kv_router (lib/llm/src/kv_router/,
-SURVEY.md §2.3): engines publish block stored/removed events; a global radix
-indexer maps block hashes to the workers that hold them; the scheduler scores
-workers by prefix overlap + predicted load and softmax-samples one.
+SURVEY.md §2.3): engines publish block stored/removed events
+(protocols.py); the global indexer maps chained block hashes to the workers
+holding them (indexer.py); per-worker active-sequence tracking predicts
+load (sequence.py); the scheduler scores workers by
+``overlap_weight * prefill_blocks + potential_active_blocks`` and
+softmax-samples one (scheduler.py); KvPushRouter routes and streams
+(router.py); MetricsAggregator collects worker load (metrics_aggregator.py).
 """
+from dynamo_tpu.kv_router.indexer import ApproxKvIndexer, KvIndexer, OverlapScores
+from dynamo_tpu.kv_router.metrics_aggregator import MetricsAggregator, ProcessedEndpoints
+from dynamo_tpu.kv_router.protocols import (
+    ForwardPassMetrics,
+    KvCacheEvent,
+    KvEventKind,
+    KvStats,
+    StoredBlock,
+    WorkerStats,
+)
+from dynamo_tpu.kv_router.router import KvPushRouter, KvRouter
+from dynamo_tpu.kv_router.scheduler import (
+    DefaultWorkerSelector,
+    KvRouterConfig,
+    KvScheduler,
+    KVHitRateEvent,
+    SchedulingRequest,
+    softmax_sample,
+)
+from dynamo_tpu.kv_router.sequence import ActiveSequences, ActiveSequencesMultiWorker
+
+__all__ = [
+    "ApproxKvIndexer", "KvIndexer", "OverlapScores",
+    "MetricsAggregator", "ProcessedEndpoints",
+    "ForwardPassMetrics", "KvCacheEvent", "KvEventKind", "KvStats",
+    "StoredBlock", "WorkerStats",
+    "KvPushRouter", "KvRouter",
+    "DefaultWorkerSelector", "KvRouterConfig", "KvScheduler",
+    "KVHitRateEvent", "SchedulingRequest", "softmax_sample",
+    "ActiveSequences", "ActiveSequencesMultiWorker",
+]

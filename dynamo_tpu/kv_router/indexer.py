@@ -1,0 +1,158 @@
+"""Global KV-cache index: which worker holds which blocks.
+
+Parity: reference kv_router/indexer.rs — RadixTree (:187), KvIndexer (:518),
+OverlapScores (:410), and ApproxKvIndexer (approx.rs:157).
+
+The reference builds a radix tree of (parent, local-block-hash) nodes. Our
+block hashes are CHAINED (dynamo_tpu.tokens: each hash commits to the whole
+prefix), so a flat ``hash -> workers`` map walks exactly like the radix
+tree: following a request's chained-hash list in order IS the root-to-leaf
+path, and a worker holding chain hash h_i necessarily stored it with the
+full prefix chain. Same scoring semantics, O(1) per level, no tree
+maintenance.
+"""
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+from dynamo_tpu.kv_router.protocols import KvCacheEvent, KvEventKind
+
+WorkerId = str
+
+
+@dataclass
+class OverlapScores:
+    """Per-worker count of matched prefix blocks (indexer.rs OverlapScores)."""
+
+    scores: dict[WorkerId, int] = field(default_factory=dict)
+    # access frequency of each matched block along the walk (0s omitted)
+    frequencies: list[int] = field(default_factory=list)
+
+    def update(self, workers: set[WorkerId]) -> None:
+        for w in workers:
+            self.scores[w] = self.scores.get(w, 0) + 1
+
+
+class KvIndexer:
+    """Consumes KvCacheEvents from all workers, answers find_matches.
+
+    Single-threaded by design (the reference runs it on one tokio worker and
+    talks to it via channels; in asyncio everything already serializes on
+    the event loop).
+    """
+
+    def __init__(self, block_size: int, expiration_s: Optional[float] = None):
+        self.block_size = block_size
+        self.expiration_s = expiration_s
+        self._workers: dict[int, set[WorkerId]] = {}       # hash -> workers
+        self._by_worker: dict[WorkerId, set[int]] = {}     # worker -> hashes
+        self._inserted: dict[int, float] = {}              # hash -> store time
+        self._freq: dict[int, int] = {}                    # hash -> access count
+        self.events_applied = 0
+
+    # ---- event plane ----
+
+    def apply_event(self, event: KvCacheEvent) -> None:
+        """reference indexer.rs:283 apply_event."""
+        w = event.worker_id
+        self.events_applied += 1
+        if event.kind == KvEventKind.STORED:
+            now = time.monotonic()
+            for blk in event.blocks:
+                self._workers.setdefault(blk.block_hash, set()).add(w)
+                self._by_worker.setdefault(w, set()).add(blk.block_hash)
+                self._inserted[blk.block_hash] = now  # (re)store refreshes TTL
+        elif event.kind == KvEventKind.REMOVED:
+            for h in event.removed_hashes:
+                self._remove(w, h)
+        elif event.kind == KvEventKind.CLEARED:
+            self.remove_worker(w)
+
+    def remove_worker(self, worker_id: WorkerId) -> None:
+        """Worker left (lease expired) — drop all its blocks
+        (indexer.rs remove_worker)."""
+        for h in self._by_worker.pop(worker_id, set()):
+            ws = self._workers.get(h)
+            if ws is not None:
+                ws.discard(worker_id)
+                if not ws:
+                    del self._workers[h]
+                    self._inserted.pop(h, None)
+                    self._freq.pop(h, None)
+
+    def _remove(self, worker_id: WorkerId, h: int) -> None:
+        ws = self._workers.get(h)
+        if ws is not None:
+            ws.discard(worker_id)
+            if not ws:
+                del self._workers[h]
+                self._inserted.pop(h, None)
+                self._freq.pop(h, None)
+        hs = self._by_worker.get(worker_id)
+        if hs is not None:
+            hs.discard(h)
+
+    # ---- query plane ----
+
+    def find_matches(
+        self, block_hashes: list[int], early_exit: bool = False
+    ) -> OverlapScores:
+        """Walk the chained hashes; stop at the first block no worker holds
+        (indexer.rs:239). `early_exit` stops at the first score found."""
+        scores = OverlapScores()
+        now = time.monotonic()
+        for h in block_hashes:
+            ws = self._workers.get(h)
+            if not ws:
+                break
+            if self.expiration_s is not None:
+                # TTL from STORE time (reference approx.rs TimerManager) —
+                # queries do NOT refresh it, else stale entries never expire
+                t = self._inserted.get(h, now)
+                if now - t > self.expiration_s:
+                    for w in list(ws):
+                        self._remove(w, h)
+                    break
+            freq = self._freq.get(h, 0)
+            self._freq[h] = freq + 1
+            if freq:
+                scores.frequencies.append(freq)
+            scores.update(ws)
+            if early_exit and scores.scores:
+                break
+        return scores
+
+    def find_matches_for_tokens(self, tokens: list[int], salt: str = "") -> OverlapScores:
+        from dynamo_tpu.tokens import compute_block_hashes
+
+        return self.find_matches(
+            compute_block_hashes(tokens, self.block_size, salt=salt)
+        )
+
+
+class ApproxKvIndexer:
+    """No-events indexer: ASSUMES a routed prefix is cached on the worker it
+    was routed to, with TTL expiry (reference kv_router/approx.rs:157).
+    Useful when engines can't publish KV events."""
+
+    def __init__(self, block_size: int, ttl_s: float = 120.0):
+        self.inner = KvIndexer(block_size, expiration_s=ttl_s)
+
+    def find_matches(self, block_hashes: list[int]) -> OverlapScores:
+        return self.inner.find_matches(block_hashes)
+
+    def process_routing_decision(
+        self, worker_id: WorkerId, block_hashes: list[int]
+    ) -> None:
+        """Record that `worker_id` is now presumed to hold these blocks."""
+        from dynamo_tpu.kv_router.protocols import KvCacheEvent, StoredBlock
+
+        self.inner.apply_event(
+            KvCacheEvent(
+                kind=KvEventKind.STORED,
+                worker_id=worker_id,
+                blocks=[StoredBlock(block_hash=h) for h in block_hashes],
+            )
+        )

@@ -1,0 +1,116 @@
+"""KV-aware router: pick the worker with the warmest prefix, stream from it.
+
+Parity: reference kv_router.rs — KvRouter (:100) find_best_match;
+KvPushRouter (:242-304) wraps routing as an AsyncEngine: choose a worker,
+annotate the request with ``estimated_prefix_hit_num_blocks``, direct-route,
+track generated tokens per request (push) and free on completion.
+
+Workers are anything with the AsyncEngine ``generate()`` contract — local
+engines, mockers, or remote endpoint clients from the distributed runtime.
+"""
+from __future__ import annotations
+
+import logging
+from typing import Any, AsyncIterator, Callable, Optional
+
+from dynamo_tpu.kv_router.indexer import KvIndexer, WorkerId
+from dynamo_tpu.kv_router.scheduler import (
+    DefaultWorkerSelector,
+    KvRouterConfig,
+    KvScheduler,
+    KVHitRateEvent,
+    SchedulingRequest,
+)
+from dynamo_tpu.kv_router.sequence import ActiveSequencesMultiWorker
+from dynamo_tpu.protocols.common import LLMEngineOutput, PreprocessedRequest
+from dynamo_tpu.tokens import TokenBlockSequence
+
+log = logging.getLogger(__name__)
+
+
+class KvRouter:
+    """Scoring core: indexer + per-worker active-sequence prediction +
+    softmax scheduler."""
+
+    def __init__(
+        self,
+        block_size: int,
+        config: Optional[KvRouterConfig] = None,
+        on_hit_rate: Optional[Callable[[KVHitRateEvent], None]] = None,
+    ):
+        self.block_size = block_size
+        self.indexer = KvIndexer(block_size)
+        self.sequences = ActiveSequencesMultiWorker(block_size, [])
+        self.scheduler = KvScheduler(
+            block_size,
+            selector=DefaultWorkerSelector(config),
+            on_hit_rate=on_hit_rate,
+        )
+
+    def update_workers(self, worker_ids: list[WorkerId]) -> None:
+        self.sequences.update_workers(worker_ids)
+
+    def find_best_match(
+        self, request_id: str, tokens: list[int], salt: str = ""
+    ) -> tuple[WorkerId, int]:
+        """(worker_id, overlap_blocks). Registers the request against the
+        chosen worker's predicted active set (kv_router.rs:178-214)."""
+        seq = TokenBlockSequence.from_tokens(tokens, self.block_size, salt=salt)
+        overlap = self.indexer.find_matches(seq.block_hashes())
+        req = SchedulingRequest(
+            isl_tokens=len(tokens),
+            overlap=overlap,
+            potential_blocks=self.sequences.potential_blocks(seq),
+        )
+        worker, overlap_blocks = self.scheduler.schedule(
+            self.sequences.worker_ids(), req
+        )
+        self.sequences.add_request(request_id, worker, seq)
+        return worker, overlap_blocks
+
+    def push(self, request_id: str, token: int) -> None:
+        self.sequences.push(request_id, token)
+
+    def free(self, request_id: str) -> None:
+        self.sequences.free(request_id)
+
+
+class KvPushRouter:
+    """AsyncEngine wrapper: route + stream + per-token tracking
+    (kv_router.rs:242-304)."""
+
+    def __init__(
+        self,
+        router: KvRouter,
+        workers: Optional[dict[WorkerId, Any]] = None,
+    ):
+        self.router = router
+        self.workers: dict[WorkerId, Any] = workers or {}
+        self.router.update_workers(list(self.workers))
+
+    def add_worker(self, worker_id: WorkerId, engine: Any) -> None:
+        self.workers[worker_id] = engine
+        self.router.update_workers(list(self.workers))
+
+    def remove_worker(self, worker_id: WorkerId) -> None:
+        self.workers.pop(worker_id, None)
+        self.router.update_workers(list(self.workers))
+        self.router.indexer.remove_worker(worker_id)
+
+    async def generate(
+        self, request: PreprocessedRequest
+    ) -> AsyncIterator[LLMEngineOutput]:
+        rid = request.request_id
+        worker_id, overlap = self.router.find_best_match(
+            rid, request.token_ids, salt=request.model
+        )
+        request.estimated_prefix_hit_num_blocks = overlap
+        engine = self.workers[worker_id]
+        log.debug("routing %s to %s (overlap %d blocks)", rid, worker_id, overlap)
+        try:
+            async for out in engine.generate(request):
+                for tok in out.token_ids:
+                    self.router.push(rid, tok)
+                yield out
+        finally:
+            self.router.free(rid)

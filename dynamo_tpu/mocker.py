@@ -71,9 +71,9 @@ class _MockRequest:
     prefilling: bool = False
     enqueue_time: float = field(default_factory=time.monotonic)
 
-    @property
-    def prompt(self) -> list[int]:
-        return self.req.token_ids
+    # current (possibly restart-extended) prompt — kept separate from
+    # req.token_ids so preemption never mutates the caller's request object
+    prompt: list[int] = field(default_factory=list)
 
 
 class MockerEngine:
@@ -143,6 +143,7 @@ class MockerEngine:
             ),
             out=asyncio.Queue(),
             orig_prompt=list(request.token_ids),
+            prompt=list(request.token_ids),
         )
         self._waiting.append(r)
         self._wake.set()
@@ -219,6 +220,7 @@ class MockerEngine:
             n_pages = (len(r.prompt) + ps - 1) // ps
             if n_pages > min(self.allocator.total_pages, a.max_pages_per_seq):
                 # can never fit: fail instead of blocking the queue forever
+                self.allocator.free(matched)
                 self._waiting.pop(0)
                 r.out.put_nowait(ValueError("prompt does not fit page table"))
                 continue
@@ -273,8 +275,10 @@ class MockerEngine:
                         r.pages[blk.position], blk.block_hash, blk.parent_hash
                     )
             r.last_token = -1
-        # grow the page table for the next position
-        total = len(r.prompt) + r.produced
+        # grow the page table for the next position; total context derives
+        # from the ORIGINAL prompt (preemption folds generated tokens into
+        # r.prompt, but produced already counts them)
+        total = len(r.orig_prompt) + r.produced
         need_pages = total // a.page_size + 1
         while len(r.pages) < min(need_pages, a.max_pages_per_seq):
             got = self.allocator.allocate(1)
@@ -334,7 +338,7 @@ class MockerEngine:
         new_prompt = victim.seq.tokens + (
             [victim.last_token] if victim.last_token >= 0 else []
         )
-        victim.req.token_ids = new_prompt
+        victim.prompt = new_prompt
         victim.seq = TokenBlockSequence.from_tokens(
             new_prompt, self.args.page_size, salt=victim.req.model
         )
