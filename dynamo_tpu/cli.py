@@ -2,11 +2,49 @@
 
 Mirrors the reference's launcher surface (launch/dynamo-run/src/main.rs).
 Subcommands:
-  run   serve a graph: in=<http|text|stdin|batch:FILE> out=<echo|mocker|tpu>
+  run   serve a graph: in=<http|text|stdin|batch:FILE|endpoint> out=<echo|mocker|tpu>
+        (distributed mode: --control-plane HOST:PORT; workers use
+         in=endpoint, frontends in=http discover models dynamically)
+  cp    run the control-plane store (native dcp-server if built, else the
+        wire-compatible Python fallback): cp --port 7111
 """
 from __future__ import annotations
 
 import sys
+
+
+def _run_cp(rest: list[str]) -> int:
+    import argparse
+    import os
+    import subprocess
+
+    p = argparse.ArgumentParser(prog="dynamo-tpu cp")
+    p.add_argument("--port", type=int, default=7111)
+    p.add_argument("--python", action="store_true",
+                   help="force the Python store (skip the native binary)")
+    args = p.parse_args(rest)
+
+    native = os.path.join(
+        os.path.dirname(__file__), "native", "build", "dcp-server"
+    )
+    if not args.python and os.path.exists(native):
+        return subprocess.call([native, str(args.port)])
+
+    import asyncio
+
+    from dynamo_tpu.runtime.store import serve_store
+
+    async def _serve():
+        server, _ = await serve_store(port=args.port)
+        print(f"dcp-server (python) listening on 127.0.0.1:{args.port}")
+        async with server:
+            await server.serve_forever()
+
+    try:
+        asyncio.run(_serve())
+    except KeyboardInterrupt:
+        pass
+    return 0
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -19,6 +57,8 @@ def main(argv: list[str] | None = None) -> int:
         from dynamo_tpu.launch.run import run_cli
 
         return run_cli(rest)
+    if cmd == "cp":
+        return _run_cp(rest)
     print(f"dynamo-tpu: unknown subcommand {cmd!r}", file=sys.stderr)
     return 2
 

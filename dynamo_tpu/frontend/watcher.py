@@ -1,0 +1,238 @@
+"""Model discovery: watch registrations, build chains, update the manager.
+
+Parity: reference lib/llm/src/discovery/watcher.rs:187-300 ModelWatcher —
+watches etcd MODEL_ROOT_PATH for ModelEntry puts/deletes, builds the
+preprocessor->router->backend chain per model, and registers it in the
+ModelManager. Here model entries live at
+``dynamo://{namespace}/_models/{model_name}`` (value: JSON ModelEntry) and
+worker instances under the component prefix the entry names.
+
+register_llm (reference lib/bindings/python rust/lib.rs:134) is the
+worker-side half: put the model entry + serve the engine endpoint.
+"""
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+from dataclasses import asdict, dataclass, field
+from typing import Any, Optional
+
+from dynamo_tpu.backend import Backend
+from dynamo_tpu.frontend.model_manager import ModelChain, ModelManager
+from dynamo_tpu.kv_router.router import KvPushRouter, KvRouter
+from dynamo_tpu.kv_router.scheduler import KvRouterConfig
+from dynamo_tpu.preprocessor import OpenAIPreprocessor, PromptFormatter
+from dynamo_tpu.runtime.component import DistributedRuntime, Instance
+from dynamo_tpu.runtime.remote_engine import RemoteEngine, RemoteWorkerEngine
+from dynamo_tpu.kv_router.protocols import KvCacheEvent
+
+log = logging.getLogger(__name__)
+
+MODEL_PREFIX = "_models/"
+KV_EVENTS_TOPIC = "kv_events"  # reference kv_router.rs:45
+
+
+def model_key(namespace: str, name: str) -> str:
+    return f"dynamo://{namespace}/{MODEL_PREFIX}{name}"
+
+
+@dataclass
+class ModelEntry:
+    """What a worker publishes about a model (reference
+    discovery/ModelEntry + model_card basics)."""
+
+    name: str
+    namespace: str
+    component: str
+    endpoint: str = "generate"
+    model_type: str = "chat"          # chat | completions | both
+    block_size: int = 64              # router block size (must match engine)
+    router_mode: str = "kv"           # kv | round_robin | random
+    # minimal card payload: tokenizer/template source directory, context len
+    model_path: Optional[str] = None
+    context_length: Optional[int] = None
+
+    def to_json(self) -> str:
+        return json.dumps(asdict(self))
+
+    @classmethod
+    def from_json(cls, s: str) -> "ModelEntry":
+        return cls(**json.loads(s))
+
+
+async def register_llm(
+    rt: DistributedRuntime,
+    engine: Any,
+    entry: ModelEntry,
+    *,
+    worker_id: str = "",
+    lease_ttl_s: float = 5.0,
+    publish_kv_events: bool = True,
+):
+    """Worker-side: serve the engine + publish the model entry. Entries are
+    per-instance keys suffixed with the lease id, so the model vanishes
+    exactly when the last instance's lease dies. If the engine has a page
+    allocator, its KV events are published on the event plane under the
+    instance's lease id (the id routers use as the worker key)."""
+    from dynamo_tpu.runtime.publisher import KvEventPublisher
+    from dynamo_tpu.runtime.remote_engine import serve_engine
+
+    ep = rt.namespace(entry.namespace).component(entry.component).endpoint(
+        entry.endpoint
+    )
+    served = await serve_engine(
+        ep, engine, worker_id=worker_id or entry.name, lease_ttl_s=lease_ttl_s
+    )
+    key = model_key(entry.namespace, entry.name) + f"/{served.lease_id}"
+    await rt.kv.put(key, entry.to_json(), lease=served.lease_id)
+
+    allocator = getattr(engine, "allocator", None)
+    if publish_kv_events and allocator is not None:
+        pub = KvEventPublisher(rt.kv, str(served.lease_id))
+        pub.start()
+        allocator.worker_id = str(served.lease_id)
+        allocator.on_event = pub
+        served.kv_publisher = pub
+    return served
+
+
+class ModelWatcher:
+    """Frontend-side: reconcile the ModelManager with discovered models."""
+
+    def __init__(
+        self,
+        rt: DistributedRuntime,
+        manager: ModelManager,
+        namespace: str = "dynamo",
+        router_config: Optional[KvRouterConfig] = None,
+    ):
+        self.rt = rt
+        self.manager = manager
+        self.namespace = namespace
+        self.router_config = router_config
+        self._task: Optional[asyncio.Task] = None
+        self._models: dict[str, dict[int, ModelEntry]] = {}  # name -> lease -> entry
+        self._chains: dict[str, Any] = {}
+        self._kv_sub_task: Optional[asyncio.Task] = None
+        self._routers: dict[str, KvPushRouter] = {}
+
+    async def start(self) -> "ModelWatcher":
+        prefix = f"dynamo://{self.namespace}/{MODEL_PREFIX}"
+        watch = await self.rt.kv.watch_prefix(prefix)
+        for k, v, _ in watch.initial:
+            await self._apply("put", k, v)
+        self._task = asyncio.get_running_loop().create_task(self._follow(watch))
+        self._kv_sub_task = asyncio.get_running_loop().create_task(
+            self._follow_kv_events()
+        )
+        return self
+
+    async def stop(self) -> None:
+        for t in (self._task, self._kv_sub_task):
+            if t is not None:
+                t.cancel()
+        self._task = self._kv_sub_task = None
+
+    async def _follow(self, watch) -> None:
+        async for ev in watch:
+            try:
+                await self._apply(ev["event"], ev["key"], ev.get("value"))
+            except Exception:  # noqa: BLE001
+                log.exception("model watcher failed applying %s", ev)
+
+    async def _follow_kv_events(self) -> None:
+        """Feed worker KV events into every kv-router's indexer
+        (reference: NATS kv_events subject -> KvIndexer)."""
+        sub = await self.rt.kv.subscribe(f"{KV_EVENTS_TOPIC}.>")
+        async for ev in sub:
+            try:
+                event = KvCacheEvent.from_dict(json.loads(ev["value"]))
+            except (KeyError, ValueError, TypeError):
+                continue
+            for router in self._routers.values():
+                router.router.indexer.apply_event(event)
+
+    async def _apply(self, event: str, key: str, value: Optional[str]) -> None:
+        # key: dynamo://{ns}/_models/{name}/{lease_id}
+        tail = key.rsplit(MODEL_PREFIX, 1)[-1]
+        if "/" not in tail:
+            return
+        name, lease_s = tail.rsplit("/", 1)
+        try:
+            lease_id = int(lease_s)
+        except ValueError:
+            return
+        entries = self._models.setdefault(name, {})
+        if event == "put" and value is not None:
+            entries[lease_id] = ModelEntry.from_json(value)
+            if name not in self._chains:
+                await self._add_model(name, entries[lease_id])
+        elif event == "delete":
+            entries.pop(lease_id, None)
+            if not entries and name in self._chains:
+                await self._remove_model(name)
+
+    async def _add_model(self, name: str, entry: ModelEntry) -> None:
+        log.info("model %s discovered (%s/%s)", name, entry.component, entry.endpoint)
+        client = await self.rt.namespace(entry.namespace).component(
+            entry.component
+        ).endpoint(entry.endpoint).client()
+
+        if entry.router_mode == "kv":
+            router = KvRouter(entry.block_size, self.router_config)
+            push = KvPushRouter(router)
+            self._routers[name] = push
+
+            def sync_workers(instances: list[Instance], push=push, client=client):
+                current = {str(i.id) for i in instances}
+                for wid in list(push.workers):
+                    if wid not in current:
+                        push.remove_worker(wid)
+                for inst in instances:
+                    wid = str(inst.id)
+                    if wid not in push.workers:
+                        push.add_worker(
+                            wid, RemoteWorkerEngine(client, inst.id)
+                        )
+
+            client.on_change = sync_workers
+            sync_workers(list(client.instances.values()))
+            engine: Any = push
+        else:
+            engine = RemoteEngine(
+                client,
+                mode="random" if entry.router_mode == "random" else "round_robin",
+            )
+
+        if entry.model_path:
+            from dynamo_tpu.tokenizer import HfTokenizer
+
+            tok = HfTokenizer.from_dir(entry.model_path)
+            fmt = PromptFormatter.from_dir(entry.model_path)
+        else:
+            from dynamo_tpu.tokenizer import make_test_tokenizer
+
+            tok = make_test_tokenizer()
+            fmt = PromptFormatter()
+        chain = ModelChain(
+            name=name,
+            preprocessor=OpenAIPreprocessor(
+                tokenizer=tok, formatter=fmt, model_name=name,
+                context_length=entry.context_length,
+            ),
+            engine=engine,
+            backend=Backend(tok),
+            chat=entry.model_type in ("chat", "both"),
+            completions=entry.model_type in ("completions", "both", "chat"),
+        )
+        self._chains[name] = (chain, client)
+        self.manager.register(chain)
+
+    async def _remove_model(self, name: str) -> None:
+        log.info("model %s removed (last instance gone)", name)
+        chain_client = self._chains.pop(name, None)
+        self._routers.pop(name, None)
+        self.manager.unregister(name)
+        if chain_client is not None:
+            await chain_client[1].stop()

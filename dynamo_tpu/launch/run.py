@@ -44,6 +44,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--page-size", type=int, default=64)
     p.add_argument("--max-decode-slots", type=int, default=8)
     p.add_argument("--cache-dtype", default="bfloat16")
+    # distributed mode (reference: etcd/NATS endpoints; here the dcp store)
+    p.add_argument("--control-plane", default=None, metavar="HOST:PORT",
+                   help="control-plane store address; enables discovery")
+    p.add_argument("--namespace", default="dynamo")
+    p.add_argument("--component", default="backend")
+    p.add_argument("--endpoint-name", default="generate")
+    p.add_argument("--router-mode", default="kv",
+                   choices=["kv", "round_robin", "random"])
     return p
 
 
@@ -189,13 +197,83 @@ async def _serve_batch(args, chain, path: str) -> None:
         print(json.dumps({"prompt": rec.get("prompt", ""), "text": text}))
 
 
+def _cp_addr(args) -> tuple[str, int]:
+    host, _, port = args.control_plane.partition(":")
+    return host or "127.0.0.1", int(port or 7111)
+
+
+async def _serve_worker(args, chain) -> None:
+    """in=endpoint: register the engine on the runtime and serve forever
+    (reference Input::Endpoint, entrypoint/input.rs:43)."""
+    from dynamo_tpu.frontend.watcher import ModelEntry, register_llm
+    from dynamo_tpu.runtime.component import DistributedRuntime
+
+    host, port = _cp_addr(args)
+    rt = await DistributedRuntime.connect(host=host, port=port)
+    entry = ModelEntry(
+        name=chain.name,
+        namespace=args.namespace,
+        component=args.component,
+        endpoint=args.endpoint_name,
+        block_size=args.page_size,
+        router_mode=args.router_mode,
+        model_path=args.model_path,
+    )
+    served = await register_llm(rt, chain.engine, entry)
+    print(
+        f"worker {chain.name!r} instance {served.lease_id} serving "
+        f"{args.namespace}/{args.component}/{args.endpoint_name}"
+    )
+    try:
+        await served.lease.lost.wait()  # run until the control plane drops us
+        print("lease lost; shutting down")
+    finally:
+        await served.shutdown()
+
+
+async def _serve_http_dynamic(args) -> None:
+    """in=http + --control-plane: discover models instead of building a
+    local chain (reference EngineConfig::Dynamic, input/common.rs:55-90)."""
+    from dynamo_tpu.frontend import HttpService, ModelManager
+    from dynamo_tpu.frontend.watcher import ModelWatcher
+    from dynamo_tpu.runtime.component import DistributedRuntime
+
+    host, port = _cp_addr(args)
+    rt = await DistributedRuntime.connect(host=host, port=port)
+    manager = ModelManager()
+    watcher = await ModelWatcher(rt, manager, namespace=args.namespace).start()
+    svc = HttpService(manager, host=args.http_host, port=args.http_port)
+    await svc.start()
+    print(
+        f"dynamic frontend on http://{args.http_host}:{args.http_port} "
+        f"(namespace {args.namespace!r})"
+    )
+    try:
+        while True:
+            await asyncio.sleep(3600)
+    finally:
+        await svc.stop()
+        await watcher.stop()
+        await rt.close()
+
+
 def run_cli(argv: list[str]) -> int:
     args = build_parser().parse_args(argv)
-    inp, chain = build_chain(args)
-    engine_start = getattr(chain.engine, "start", None)
-    if engine_start is not None:
-        engine_start()
+    inp, _ = _parse_io(args.io)
     try:
+        if inp == "http" and args.control_plane:
+            asyncio.run(_serve_http_dynamic(args))
+            return 0
+        if inp == "endpoint":
+            if not args.control_plane:
+                raise SystemExit("in=endpoint requires --control-plane")
+            _, chain = build_chain(args)
+            asyncio.run(_serve_worker(args, chain))
+            return 0
+        inp, chain = build_chain(args)
+        engine_start = getattr(chain.engine, "start", None)
+        if engine_start is not None:
+            engine_start()
         if inp == "http":
             asyncio.run(_serve_http(args, chain))
         elif inp == "text":

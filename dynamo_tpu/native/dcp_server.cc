@@ -1,0 +1,626 @@
+// dcp-server: native control-plane store for dynamo-tpu.
+//
+// etcd-shaped semantics (keys, TTL leases, prefix watches) plus NATS-core
+// style pub/sub, over length-prefixed JSON frames — the native counterpart
+// of the reference's external etcd+NATS dependency (SURVEY.md §2.1 L0/L1;
+// reference lib/runtime/src/transports/{etcd,nats}.rs). Wire protocol:
+// dynamo_tpu/runtime/protocol.py; the Python fallback implementation is
+// dynamo_tpu/runtime/store.py and both must stay wire-compatible (tested by
+// tests/test_native_store.py, which runs the same client suite against
+// this binary).
+//
+// Design: single-threaded poll() loop — the control plane is tiny-message
+// metadata traffic; one core handles tens of thousands of ops/s without
+// locks. Leases are swept on every loop tick against CLOCK_MONOTONIC.
+//
+// Build: make -C dynamo_tpu/native   (-> build/dcp-server)
+// Run:   dcp-server [port]           (default 7111)
+
+#include <arpa/inet.h>
+#include <errno.h>
+#include <fcntl.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <poll.h>
+#include <signal.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <time.h>
+#include <unistd.h>
+
+#include <map>
+#include <memory>
+#include <set>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+// ---------------------------------------------------------------------------
+// Minimal JSON: flat objects with string / number / bool values. Value
+// strings may contain arbitrary escaped content (nested JSON payloads stay
+// opaque strings). Sufficient for the dcp wire protocol by construction.
+
+struct JValue {
+  enum Kind { STR, NUM, BOOL, NONE } kind = NONE;
+  std::string str;
+  double num = 0;
+  bool b = false;
+};
+
+typedef std::map<std::string, JValue> JObject;
+
+static bool utf8_append(std::string &out, unsigned cp) {
+  if (cp < 0x80) {
+    out += (char)cp;
+  } else if (cp < 0x800) {
+    out += (char)(0xC0 | (cp >> 6));
+    out += (char)(0x80 | (cp & 0x3F));
+  } else if (cp < 0x10000) {
+    out += (char)(0xE0 | (cp >> 12));
+    out += (char)(0x80 | ((cp >> 6) & 0x3F));
+    out += (char)(0x80 | (cp & 0x3F));
+  } else {
+    out += (char)(0xF0 | (cp >> 18));
+    out += (char)(0x80 | ((cp >> 12) & 0x3F));
+    out += (char)(0x80 | ((cp >> 6) & 0x3F));
+    out += (char)(0x80 | (cp & 0x3F));
+  }
+  return true;
+}
+
+struct JParser {
+  const char *p, *end;
+  bool ok = true;
+  explicit JParser(const std::string &s) : p(s.data()), end(s.data() + s.size()) {}
+
+  void ws() {
+    while (p < end && (*p == ' ' || *p == '\t' || *p == '\n' || *p == '\r')) p++;
+  }
+  bool lit(const char *s) {
+    size_t n = strlen(s);
+    if ((size_t)(end - p) < n || strncmp(p, s, n) != 0) return false;
+    p += n;
+    return true;
+  }
+  bool parse_hex4(unsigned &v) {
+    if (end - p < 4) return false;
+    v = 0;
+    for (int i = 0; i < 4; i++) {
+      char c = *p++;
+      v <<= 4;
+      if (c >= '0' && c <= '9') v |= (unsigned)(c - '0');
+      else if (c >= 'a' && c <= 'f') v |= (unsigned)(c - 'a' + 10);
+      else if (c >= 'A' && c <= 'F') v |= (unsigned)(c - 'A' + 10);
+      else return false;
+    }
+    return true;
+  }
+  bool parse_string(std::string &out) {
+    ws();
+    if (p >= end || *p != '"') return false;
+    p++;
+    out.clear();
+    while (p < end && *p != '"') {
+      char c = *p++;
+      if (c != '\\') {
+        out += c;
+        continue;
+      }
+      if (p >= end) return false;
+      char e = *p++;
+      switch (e) {
+        case '"': out += '"'; break;
+        case '\\': out += '\\'; break;
+        case '/': out += '/'; break;
+        case 'b': out += '\b'; break;
+        case 'f': out += '\f'; break;
+        case 'n': out += '\n'; break;
+        case 'r': out += '\r'; break;
+        case 't': out += '\t'; break;
+        case 'u': {
+          unsigned cp;
+          if (!parse_hex4(cp)) return false;
+          if (cp >= 0xD800 && cp <= 0xDBFF) {  // surrogate pair
+            if (end - p < 6 || p[0] != '\\' || p[1] != 'u') return false;
+            p += 2;
+            unsigned lo;
+            if (!parse_hex4(lo)) return false;
+            cp = 0x10000 + ((cp - 0xD800) << 10) + (lo - 0xDC00);
+          }
+          utf8_append(out, cp);
+          break;
+        }
+        default: return false;
+      }
+    }
+    if (p >= end) return false;
+    p++;  // closing quote
+    return true;
+  }
+  bool parse_number(double &out) {
+    ws();
+    char *q = nullptr;
+    out = strtod(p, &q);
+    if (q == p) return false;
+    p = q;
+    return true;
+  }
+  // Parse a flat object; nested objects/arrays are skipped structurally and
+  // recorded as NONE (the protocol never needs them).
+  bool skip_value();
+  bool parse_object(JObject &obj) {
+    ws();
+    if (p >= end || *p != '{') return false;
+    p++;
+    ws();
+    if (p < end && *p == '}') { p++; return true; }
+    while (true) {
+      std::string key;
+      if (!parse_string(key)) return false;
+      ws();
+      if (p >= end || *p != ':') return false;
+      p++;
+      ws();
+      JValue v;
+      if (p < end && *p == '"') {
+        if (!parse_string(v.str)) return false;
+        v.kind = JValue::STR;
+      } else if (lit("true")) {
+        v.kind = JValue::BOOL; v.b = true;
+      } else if (lit("false")) {
+        v.kind = JValue::BOOL; v.b = false;
+      } else if (lit("null")) {
+        v.kind = JValue::NONE;
+      } else if (p < end && (*p == '{' || *p == '[')) {
+        if (!skip_value()) return false;
+        v.kind = JValue::NONE;
+      } else {
+        if (!parse_number(v.num)) return false;
+        v.kind = JValue::NUM;
+      }
+      obj[key] = v;
+      ws();
+      if (p < end && *p == ',') { p++; continue; }
+      if (p < end && *p == '}') { p++; return true; }
+      return false;
+    }
+  }
+};
+
+bool JParser::skip_value() {
+  ws();
+  if (p >= end) return false;
+  if (*p == '"') {
+    std::string tmp;
+    return parse_string(tmp);
+  }
+  if (*p == '{' || *p == '[') {
+    char open = *p, close = (open == '{') ? '}' : ']';
+    int depth = 0;
+    while (p < end) {
+      if (*p == '"') {
+        std::string tmp;
+        if (!parse_string(tmp)) return false;
+        continue;
+      }
+      if (*p == open) depth++;
+      if (*p == close) {
+        depth--;
+        if (depth == 0) { p++; return true; }
+      }
+      p++;
+    }
+    return false;
+  }
+  if (lit("true") || lit("false") || lit("null")) return true;
+  double d;
+  return parse_number(d);
+}
+
+static void jesc(std::string &out, const std::string &s) {
+  out += '"';
+  for (unsigned char c : s) {
+    switch (c) {
+      case '"': out += "\\\""; break;
+      case '\\': out += "\\\\"; break;
+      case '\n': out += "\\n"; break;
+      case '\r': out += "\\r"; break;
+      case '\t': out += "\\t"; break;
+      default:
+        if (c < 0x20) {
+          char buf[8];
+          snprintf(buf, sizeof buf, "\\u%04x", c);
+          out += buf;
+        } else {
+          out += (char)c;  // raw UTF-8 passes through
+        }
+    }
+  }
+  out += '"';
+}
+
+struct JWriter {
+  std::string body = "{";
+  bool first = true;
+  void comma() {
+    if (!first) body += ',';
+    first = false;
+  }
+  void key(const char *k) {
+    comma();
+    jesc(body, k);
+    body += ':';
+  }
+  JWriter &s(const char *k, const std::string &v) { key(k); jesc(body, v); return *this; }
+  JWriter &n(const char *k, long long v) {
+    key(k);
+    char buf[32];
+    snprintf(buf, sizeof buf, "%lld", v);
+    body += buf;
+    return *this;
+  }
+  JWriter &b(const char *k, bool v) { key(k); body += v ? "true" : "false"; return *this; }
+  JWriter &raw(const char *k, const std::string &v) { key(k); body += v; return *this; }
+  std::string done() { return body + "}"; }
+};
+
+// ---------------------------------------------------------------------------
+// Store
+
+static double now_mono() {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return (double)ts.tv_sec + (double)ts.tv_nsec * 1e-9;
+}
+
+struct Conn;
+
+struct WatchRec {
+  long long id;
+  std::string prefix;  // watch: key prefix; sub: topic pattern
+  Conn *conn;
+  bool is_sub;
+};
+
+struct Store {
+  std::map<std::string, std::pair<std::string, long long>> kv;  // key -> (val, lease)
+  std::unordered_map<long long, double> lease_deadline;
+  std::unordered_map<long long, double> lease_ttl;
+  std::unordered_map<long long, std::set<std::string>> lease_keys;
+  std::map<long long, WatchRec> watches;  // watch/sub id -> rec
+  long long next_id = 1;
+  long long revision = 0;
+
+  void notify(const char *event, const std::string &key, const std::string *value);
+  void notify_sub(const std::string &topic, const std::string &value);
+
+  long long put(const std::string &key, const std::string &value, long long lease) {
+    if (lease) lease_keys[lease].insert(key);
+    auto it = kv.find(key);
+    if (it != kv.end() && it->second.second && it->second.second != lease)
+      lease_keys[it->second.second].erase(key);
+    kv[key] = {value, lease};
+    revision++;
+    notify("put", key, &value);
+    return revision;
+  }
+  int del(const std::string &key) {
+    auto it = kv.find(key);
+    if (it == kv.end()) return 0;
+    long long lease = it->second.second;
+    kv.erase(it);
+    if (lease) lease_keys[lease].erase(key);
+    revision++;
+    notify("delete", key, nullptr);
+    return 1;
+  }
+  long long lease_grant(double ttl) {
+    long long id = next_id++;
+    lease_deadline[id] = now_mono() + ttl;
+    lease_ttl[id] = ttl;
+    return id;
+  }
+  bool lease_keepalive(long long id) {
+    auto it = lease_deadline.find(id);
+    if (it == lease_deadline.end()) return false;
+    it->second = now_mono() + lease_ttl[id];
+    return true;
+  }
+  void lease_revoke(long long id) {
+    lease_deadline.erase(id);
+    lease_ttl.erase(id);
+    auto it = lease_keys.find(id);
+    if (it != lease_keys.end()) {
+      std::vector<std::string> keys(it->second.begin(), it->second.end());
+      lease_keys.erase(it);
+      for (auto &k : keys) del(k);
+    }
+  }
+  void sweep() {
+    double t = now_mono();
+    std::vector<long long> expired;
+    for (auto &kvp : lease_deadline)
+      if (kvp.second < t) expired.push_back(kvp.first);
+    for (long long id : expired) {
+      fprintf(stderr, "dcp: lease %lld expired\n", id);
+      lease_revoke(id);
+    }
+  }
+};
+
+// ---------------------------------------------------------------------------
+// Connections
+
+struct Conn {
+  int fd;
+  std::string rbuf;
+  std::string wbuf;
+  std::vector<long long> watch_ids;
+  bool dead = false;
+
+  void send_frame(const std::string &body) {
+    uint32_t n = htonl((uint32_t)body.size());
+    wbuf.append((const char *)&n, 4);
+    wbuf.append(body);
+  }
+};
+
+void Store::notify(const char *event, const std::string &key,
+                   const std::string *value) {
+  for (auto &w : watches) {
+    if (w.second.is_sub) continue;
+    if (key.compare(0, w.second.prefix.size(), w.second.prefix) == 0 ||
+        w.second.prefix.empty()) {
+      if (key.size() < w.second.prefix.size()) continue;
+      if (key.compare(0, w.second.prefix.size(), w.second.prefix) != 0) continue;
+      JWriter jw;
+      jw.n("watch", w.second.id).s("event", event);
+      jw.s("key", key);
+      if (value) jw.s("value", *value);
+      w.second.conn->send_frame(jw.done());
+    }
+  }
+}
+
+void Store::notify_sub(const std::string &topic, const std::string &value) {
+  for (auto &w : watches) {
+    if (!w.second.is_sub) continue;
+    const std::string &pat = w.second.prefix;
+    bool match = (pat == topic);
+    if (!match && pat.size() >= 2 && pat.compare(pat.size() - 2, 2, ".>") == 0)
+      match = topic.compare(0, pat.size() - 1, pat, 0, pat.size() - 1) == 0;
+    if (match) {
+      JWriter jw;
+      jw.n("sub", w.second.id).s("topic", topic).s("value", value);
+      w.second.conn->send_frame(jw.done());
+    }
+  }
+}
+
+static std::string handle(Store &st, Conn *conn, JObject &req) {
+  std::string op = req["op"].str;
+  JWriter jw;
+  if (op == "put") {
+    long long lease = (long long)req["lease"].num;
+    if (lease && !st.lease_deadline.count(lease)) {
+      jw.b("ok", false).s("error", "lease not found");
+      return jw.done();
+    }
+    long long rev = st.put(req["key"].str, req["value"].str, lease);
+    jw.b("ok", true).n("rev", rev);
+  } else if (op == "get") {
+    jw.b("ok", true);
+    auto it = st.kv.find(req["key"].str);
+    std::string arr = "[";
+    if (it != st.kv.end()) {
+      std::string one = "[";
+      jesc(one, it->first);
+      one += ',';
+      jesc(one, it->second.first);
+      char buf[32];
+      snprintf(buf, sizeof buf, ",%lld]", it->second.second);
+      one += buf;
+      arr += one;
+    }
+    arr += "]";
+    jw.raw("kvs", arr);
+  } else if (op == "get_prefix") {
+    const std::string &pfx = req["prefix"].str;
+    jw.b("ok", true);
+    std::string arr = "[";
+    bool first = true;
+    for (auto it = st.kv.lower_bound(pfx); it != st.kv.end(); ++it) {
+      if (it->first.compare(0, pfx.size(), pfx) != 0) break;
+      if (!first) arr += ',';
+      first = false;
+      std::string one = "[";
+      jesc(one, it->first);
+      one += ',';
+      jesc(one, it->second.first);
+      char buf[32];
+      snprintf(buf, sizeof buf, ",%lld]", it->second.second);
+      one += buf;
+      arr += one;
+    }
+    arr += "]";
+    jw.raw("kvs", arr);
+  } else if (op == "delete") {
+    jw.b("ok", true).n("deleted", st.del(req["key"].str));
+  } else if (op == "delete_prefix") {
+    const std::string &pfx = req["prefix"].str;
+    std::vector<std::string> keys;
+    for (auto it = st.kv.lower_bound(pfx); it != st.kv.end(); ++it) {
+      if (it->first.compare(0, pfx.size(), pfx) != 0) break;
+      keys.push_back(it->first);
+    }
+    for (auto &k : keys) st.del(k);
+    jw.b("ok", true).n("deleted", (long long)keys.size());
+  } else if (op == "lease_grant") {
+    double ttl = req["ttl"].kind == JValue::NUM ? req["ttl"].num : 10.0;
+    jw.b("ok", true).n("lease", st.lease_grant(ttl));
+  } else if (op == "lease_keepalive") {
+    bool ok = st.lease_keepalive((long long)req["lease"].num);
+    if (ok) jw.b("ok", true);
+    else jw.b("ok", false).s("error", "lease expired");
+  } else if (op == "lease_revoke") {
+    st.lease_revoke((long long)req["lease"].num);
+    jw.b("ok", true);
+  } else if (op == "watch" || op == "subscribe") {
+    long long id = st.next_id++;
+    WatchRec rec;
+    rec.id = id;
+    rec.prefix = (op == "watch") ? req["prefix"].str : req["topic"].str;
+    rec.conn = conn;
+    rec.is_sub = (op == "subscribe");
+    st.watches[id] = rec;
+    conn->watch_ids.push_back(id);
+    jw.b("ok", true).n(rec.is_sub ? "sub" : "watch", id);
+  } else if (op == "unwatch") {
+    st.watches.erase((long long)req["watch"].num);
+    jw.b("ok", true);
+  } else if (op == "unsubscribe") {
+    st.watches.erase((long long)req["sub"].num);
+    jw.b("ok", true);
+  } else if (op == "publish") {
+    long long n = 0;
+    const std::string &topic = req["topic"].str;
+    for (auto &w : st.watches) {
+      if (!w.second.is_sub) continue;
+      const std::string &pat = w.second.prefix;
+      bool match = (pat == topic);
+      if (!match && pat.size() >= 2 && pat.compare(pat.size() - 2, 2, ".>") == 0)
+        match = topic.compare(0, pat.size() - 1, pat, 0, pat.size() - 1) == 0;
+      if (match) n++;
+    }
+    st.notify_sub(topic, req["value"].str);
+    jw.b("ok", true).n("receivers", n);
+  } else if (op == "ping") {
+    jw.b("ok", true);
+  } else {
+    jw.b("ok", false).s("error", "unknown op '" + op + "'");
+  }
+  return jw.done();
+}
+
+int main(int argc, char **argv) {
+  int port = argc > 1 ? atoi(argv[1]) : 7111;
+  signal(SIGPIPE, SIG_IGN);
+
+  int lfd = socket(AF_INET, SOCK_STREAM, 0);
+  int one = 1;
+  setsockopt(lfd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof one);
+  struct sockaddr_in addr;
+  memset(&addr, 0, sizeof addr);
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  addr.sin_port = htons((uint16_t)port);
+  if (bind(lfd, (struct sockaddr *)&addr, sizeof addr) != 0) {
+    perror("bind");
+    return 1;
+  }
+  if (listen(lfd, 128) != 0) {
+    perror("listen");
+    return 1;
+  }
+  // report the actual port (port 0 = ephemeral, used by tests)
+  socklen_t alen = sizeof addr;
+  getsockname(lfd, (struct sockaddr *)&addr, &alen);
+  fprintf(stdout, "dcp-server listening on 127.0.0.1:%d\n", ntohs(addr.sin_port));
+  fflush(stdout);
+
+  Store st;
+  std::map<int, std::unique_ptr<Conn>> conns;
+
+  while (true) {
+    std::vector<struct pollfd> pfds;
+    pfds.push_back({lfd, POLLIN, 0});
+    for (auto &c : conns) {
+      short ev = POLLIN;
+      if (!c.second->wbuf.empty()) ev |= POLLOUT;
+      pfds.push_back({c.first, ev, 0});
+    }
+    poll(pfds.data(), (nfds_t)pfds.size(), 100 /* ms: lease sweep tick */);
+    st.sweep();
+
+    if (pfds[0].revents & POLLIN) {
+      int cfd = accept(lfd, nullptr, nullptr);
+      if (cfd >= 0) {
+        fcntl(cfd, F_SETFL, O_NONBLOCK);
+        setsockopt(cfd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
+        Conn *nc = new Conn();
+        nc->fd = cfd;
+        conns[cfd] = std::unique_ptr<Conn>(nc);
+      }
+    }
+
+    for (size_t i = 1; i < pfds.size(); i++) {
+      auto it = conns.find(pfds[i].fd);
+      if (it == conns.end()) continue;
+      Conn *c = it->second.get();
+      if (pfds[i].revents & (POLLERR | POLLHUP)) c->dead = true;
+      if (!c->dead && (pfds[i].revents & POLLIN)) {
+        char buf[65536];
+        while (true) {
+          ssize_t n = read(c->fd, buf, sizeof buf);
+          if (n > 0) {
+            c->rbuf.append(buf, (size_t)n);
+          } else if (n == 0) {
+            c->dead = true;
+            break;
+          } else {
+            if (errno != EAGAIN && errno != EWOULDBLOCK) c->dead = true;
+            break;
+          }
+        }
+        // parse complete frames
+        while (c->rbuf.size() >= 4) {
+          uint32_t len;
+          memcpy(&len, c->rbuf.data(), 4);
+          len = ntohl(len);
+          if (len > (64u << 20)) { c->dead = true; break; }
+          if (c->rbuf.size() < 4 + (size_t)len) break;
+          std::string body = c->rbuf.substr(4, len);
+          c->rbuf.erase(0, 4 + (size_t)len);
+          JObject req;
+          JParser jp(body);
+          if (!jp.parse_object(req)) continue;
+          std::string resp = handle(st, c, req);
+          if (req.count("req_id")) {
+            // splice req_id into the response object
+            char buf2[48];
+            snprintf(buf2, sizeof buf2, ",\"req_id\":%lld}",
+                     (long long)req["req_id"].num);
+            resp = resp.substr(0, resp.size() - 1) + buf2;
+          }
+          c->send_frame(resp);
+        }
+      }
+      if (!c->dead && (pfds[i].revents & POLLOUT) && !c->wbuf.empty()) {
+        ssize_t n = write(c->fd, c->wbuf.data(), c->wbuf.size());
+        if (n > 0) c->wbuf.erase(0, (size_t)n);
+        else if (n < 0 && errno != EAGAIN && errno != EWOULDBLOCK) c->dead = true;
+      }
+      // opportunistic flush for freshly queued responses
+      if (!c->dead && !c->wbuf.empty()) {
+        ssize_t n = write(c->fd, c->wbuf.data(), c->wbuf.size());
+        if (n > 0) c->wbuf.erase(0, (size_t)n);
+        else if (n < 0 && errno != EAGAIN && errno != EWOULDBLOCK) c->dead = true;
+      }
+    }
+
+    // reap dead connections (NOT their leases — etcd parity: leases only
+    // die by TTL or explicit revoke)
+    for (auto it2 = conns.begin(); it2 != conns.end();) {
+      if (it2->second->dead) {
+        for (long long wid : it2->second->watch_ids) st.watches.erase(wid);
+        close(it2->first);
+        it2 = conns.erase(it2);
+      } else {
+        ++it2;
+      }
+    }
+  }
+  return 0;
+}

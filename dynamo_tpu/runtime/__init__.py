@@ -1,0 +1,31 @@
+"""Distributed runtime (L1): discovery, leases, push RPC, response streaming.
+
+The reference's L1 (lib/runtime: etcd + NATS + raw TCP response plane) maps
+here to:
+
+  - a **control-plane store** with etcd semantics — keys, leases,
+    prefix watch, lease-expiry-deletes-keys — served by the native C++
+    ``dcp-server`` (dynamo_tpu/native/dcp_server.cc) or the wire-compatible
+    Python fallback (store.py), reachable over one TCP socket;
+  - **push RPC with streamed responses** — instead of NATS publish + worker
+    call-home TCP (reference push_endpoint.rs:26 + tcp/server.rs), each
+    endpoint instance listens on its own TCP port registered in the store;
+    routers connect directly and read a framed response stream. One hop
+    fewer, same at-most-once + streaming semantics;
+  - the **Namespace -> Component -> Endpoint** model with lease-bound
+    instance registration (reference component.rs:114, instance =
+    ns+component+endpoint+lease_id).
+"""
+from dynamo_tpu.runtime.client import KvClient, Lease
+from dynamo_tpu.runtime.component import (
+    DistributedRuntime,
+    Endpoint,
+    EndpointClient,
+    Instance,
+)
+from dynamo_tpu.runtime.store import KvStore, serve_store
+
+__all__ = [
+    "KvClient", "Lease", "KvStore", "serve_store",
+    "DistributedRuntime", "Endpoint", "EndpointClient", "Instance",
+]

@@ -1,0 +1,239 @@
+"""Asyncio client for the control-plane store (native dcp-server or the
+Python fallback — same wire protocol).
+
+Mirrors the reference's etcd client surface (transports/etcd.rs):
+``primary_lease`` with background keep-alive tied to a cancellation
+callback (etcd.rs:66-148), kv_get/put/delete, and
+``kv_get_and_watch_prefix`` — snapshot + live event stream.
+"""
+from __future__ import annotations
+
+import asyncio
+import itertools
+import logging
+from typing import Any, AsyncIterator, Callable, Optional
+
+from dynamo_tpu.runtime.protocol import encode_frame, read_frame
+
+log = logging.getLogger(__name__)
+
+
+class StoreError(RuntimeError):
+    pass
+
+
+class Lease:
+    """A granted lease + its keep-alive loop (etcd.rs lease keep-alive)."""
+
+    def __init__(self, client: "KvClient", lease_id: int, ttl_s: float):
+        self.client = client
+        self.id = lease_id
+        self.ttl_s = ttl_s
+        self._task: Optional[asyncio.Task] = None
+        self.lost: asyncio.Event = asyncio.Event()
+
+    def start_keepalive(self) -> None:
+        if self._task is None:
+            self._task = asyncio.get_running_loop().create_task(self._beat())
+
+    async def _beat(self) -> None:
+        # 3 beats per TTL; a missed beat window ⇒ lease gone ⇒ lost event
+        # (the reference cancels the runtime when the primary lease dies)
+        interval = max(self.ttl_s / 3.0, 0.05)
+        while True:
+            await asyncio.sleep(interval)
+            try:
+                ok = await self.client.lease_keepalive(self.id)
+            except (StoreError, ConnectionError, OSError):
+                ok = False
+            if not ok:
+                log.warning("lease %d lost", self.id)
+                self.lost.set()
+                return
+
+    async def revoke(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None
+        try:
+            await self.client.lease_revoke(self.id)
+        except (StoreError, ConnectionError, OSError):
+            pass
+
+
+class Watch:
+    """A live prefix watch: async-iterate events; `initial` holds the
+    snapshot taken when the watch started."""
+
+    def __init__(self, client: "KvClient", watch_id: int,
+                 initial: list[tuple[str, str, int]], kind: str = "watch"):
+        self.client = client
+        self.watch_id = watch_id
+        self.initial = initial
+        self.kind = kind  # "watch" (kv prefix) | "sub" (pub/sub topic)
+        self.queue: asyncio.Queue = asyncio.Queue()
+
+    def __aiter__(self) -> AsyncIterator[dict[str, Any]]:
+        return self
+
+    async def __anext__(self) -> dict[str, Any]:
+        item = await self.queue.get()
+        if item is None:
+            raise StopAsyncIteration
+        return item
+
+    async def cancel(self) -> None:
+        self.client._watches.pop(self.watch_id, None)
+        op = (
+            {"op": "unwatch", "watch": self.watch_id}
+            if self.kind == "watch"
+            else {"op": "unsubscribe", "sub": self.watch_id}
+        )
+        try:
+            await self.client._call(op)
+        except (StoreError, ConnectionError, OSError):
+            pass
+        self.queue.put_nowait(None)
+
+
+class KvClient:
+    """One TCP connection multiplexing requests + watch events."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 7111):
+        self.host = host
+        self.port = port
+        self._reader: Optional[asyncio.StreamReader] = None
+        self._writer: Optional[asyncio.StreamWriter] = None
+        self._pending: dict[int, asyncio.Future] = {}
+        self._watches: dict[int, Watch] = {}
+        self._ids = itertools.count(1)
+        self._rx_task: Optional[asyncio.Task] = None
+        self.closed = asyncio.Event()
+
+    async def connect(self, retries: int = 40, delay_s: float = 0.25) -> "KvClient":
+        last: Optional[Exception] = None
+        for _ in range(retries):
+            try:
+                self._reader, self._writer = await asyncio.open_connection(
+                    self.host, self.port
+                )
+                break
+            except OSError as e:
+                last = e
+                await asyncio.sleep(delay_s)
+        else:
+            raise ConnectionError(
+                f"cannot reach control plane at {self.host}:{self.port}: {last}"
+            )
+        self._rx_task = asyncio.get_running_loop().create_task(self._rx())
+        return self
+
+    async def close(self) -> None:
+        if self._rx_task is not None:
+            self._rx_task.cancel()
+            self._rx_task = None
+        if self._writer is not None:
+            self._writer.close()
+            self._writer = None
+        self.closed.set()
+
+    async def _rx(self) -> None:
+        try:
+            while True:
+                msg = await read_frame(self._reader)
+                rid = msg.pop("req_id", None)
+                if rid is not None:
+                    fut = self._pending.pop(rid, None)
+                    if fut is not None and not fut.done():
+                        fut.set_result(msg)
+                elif "watch" in msg or "sub" in msg:
+                    w = self._watches.get(msg.get("watch") or msg.get("sub"))
+                    if w is not None:
+                        w.queue.put_nowait(msg)
+        except (asyncio.IncompleteReadError, ConnectionResetError, OSError):
+            pass
+        finally:
+            self.closed.set()
+            for fut in self._pending.values():
+                if not fut.done():
+                    fut.set_exception(ConnectionError("control plane connection lost"))
+            self._pending.clear()
+            for w in self._watches.values():
+                w.queue.put_nowait(None)
+
+    async def _call(self, req: dict[str, Any]) -> dict[str, Any]:
+        if self._writer is None:
+            raise ConnectionError("not connected")
+        rid = next(self._ids)
+        req["req_id"] = rid
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._pending[rid] = fut
+        self._writer.write(encode_frame(req))
+        await self._writer.drain()
+        resp = await fut
+        if not resp.get("ok", False) and "error" in resp:
+            raise StoreError(resp["error"])
+        return resp
+
+    # ---- API ----
+
+    async def put(self, key: str, value: str, lease: int = 0) -> int:
+        return (await self._call(
+            {"op": "put", "key": key, "value": value, "lease": lease}
+        ))["rev"]
+
+    async def get(self, key: str) -> Optional[str]:
+        kvs = (await self._call({"op": "get", "key": key}))["kvs"]
+        return kvs[0][1] if kvs else None
+
+    async def get_prefix(self, prefix: str) -> list[tuple[str, str, int]]:
+        resp = await self._call({"op": "get_prefix", "prefix": prefix})
+        return [tuple(kv) for kv in resp["kvs"]]
+
+    async def delete(self, key: str) -> int:
+        return (await self._call({"op": "delete", "key": key}))["deleted"]
+
+    async def delete_prefix(self, prefix: str) -> int:
+        return (await self._call({"op": "delete_prefix", "prefix": prefix}))["deleted"]
+
+    async def lease_grant(self, ttl_s: float, keepalive: bool = True) -> Lease:
+        resp = await self._call({"op": "lease_grant", "ttl": ttl_s})
+        lease = Lease(self, resp["lease"], ttl_s)
+        if keepalive:
+            lease.start_keepalive()
+        return lease
+
+    async def lease_keepalive(self, lease: int) -> bool:
+        try:
+            resp = await self._call({"op": "lease_keepalive", "lease": lease})
+        except StoreError:
+            return False
+        return bool(resp.get("ok"))
+
+    async def lease_revoke(self, lease: int) -> None:
+        await self._call({"op": "lease_revoke", "lease": lease})
+
+    async def ping(self) -> bool:
+        return bool((await self._call({"op": "ping"})).get("ok"))
+
+    async def watch_prefix(self, prefix: str) -> Watch:
+        """Snapshot + live events (etcd.rs kv_get_and_watch_prefix)."""
+        snapshot = await self.get_prefix(prefix)
+        resp = await self._call({"op": "watch", "prefix": prefix})
+        w = Watch(self, resp["watch"], snapshot)
+        self._watches[w.watch_id] = w
+        return w
+
+    # ---- pub/sub (NATS-core-equivalent event plane) ----
+
+    async def publish(self, topic: str, value: str) -> int:
+        resp = await self._call({"op": "publish", "topic": topic, "value": value})
+        return resp.get("receivers", 0)
+
+    async def subscribe(self, topic: str) -> Watch:
+        """Subscribe to a topic; iterate {'topic', 'value'} events. Topic
+        may end in '.>' for NATS-style suffix wildcard."""
+        resp = await self._call({"op": "subscribe", "topic": topic})
+        w = Watch(self, resp["sub"], [], kind="sub")
+        self._watches[w.watch_id] = w
+        return w

@@ -1,0 +1,79 @@
+"""Worker-side event/metrics publishing onto the runtime's pub/sub plane.
+
+Parity: reference kv_router/publisher.rs — KvEventPublisher (:99) pushes
+block stored/removed events on the ``kv_events`` subject;
+WorkerMetricsPublisher (:463) exposes ForwardPassMetrics. Engine callbacks
+are synchronous; a queue + drain task bridges them onto the async client.
+"""
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+from typing import Optional
+
+from dynamo_tpu.kv_router.protocols import ForwardPassMetrics, KvCacheEvent
+from dynamo_tpu.runtime.client import KvClient
+
+log = logging.getLogger(__name__)
+
+KV_EVENTS_TOPIC = "kv_events"
+METRICS_TOPIC = "load_metrics"
+
+
+class _TopicPublisher:
+    def __init__(self, kv: KvClient, topic: str):
+        self.kv = kv
+        self.topic = topic
+        self.queue: asyncio.Queue = asyncio.Queue(maxsize=4096)
+        self._task: Optional[asyncio.Task] = None
+
+    def start(self) -> None:
+        if self._task is None:
+            self._task = asyncio.get_running_loop().create_task(self._drain())
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None
+
+    def offer(self, payload: dict) -> None:
+        try:
+            self.queue.put_nowait(payload)
+        except asyncio.QueueFull:
+            log.warning("publisher queue full; dropping %s event", self.topic)
+
+    async def _drain(self) -> None:
+        while True:
+            payload = await self.queue.get()
+            try:
+                await self.kv.publish(
+                    self.topic, json.dumps(payload, separators=(",", ":"))
+                )
+            except (ConnectionError, OSError):
+                log.warning("publish to %s failed; control plane down?", self.topic)
+                await asyncio.sleep(0.5)
+
+
+class KvEventPublisher(_TopicPublisher):
+    """Callable sink for engine on_kv_event (publisher.rs:99)."""
+
+    def __init__(self, kv: KvClient, worker_id: str):
+        super().__init__(kv, f"{KV_EVENTS_TOPIC}.{worker_id}")
+        self.worker_id = worker_id
+
+    def __call__(self, event: KvCacheEvent) -> None:
+        event.worker_id = self.worker_id
+        self.offer(event.to_dict())
+
+
+class WorkerMetricsPublisher(_TopicPublisher):
+    """Callable sink for engine on_metrics (publisher.rs:463)."""
+
+    def __init__(self, kv: KvClient, worker_id: str):
+        super().__init__(kv, f"{METRICS_TOPIC}.{worker_id}")
+        self.worker_id = worker_id
+
+    def __call__(self, metrics: ForwardPassMetrics) -> None:
+        metrics.worker_id = self.worker_id
+        self.offer(metrics.to_dict())

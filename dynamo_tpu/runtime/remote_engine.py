@@ -1,0 +1,86 @@
+"""Engine <-> endpoint adapters: serve a local engine over the runtime, or
+consume a remote endpoint as an AsyncEngine.
+
+Parity: worker side mirrors the reference PushEndpoint binding an
+AsyncEngine to the network (pipeline/network/ingress/push_endpoint.rs:26);
+client side mirrors PushRouter-as-engine (egress/push_router.rs +
+kv_router.rs KvPushRouter's inner client). Payloads are
+PreprocessedRequest/LLMEngineOutput dicts (protocols/common.py to_dict).
+"""
+from __future__ import annotations
+
+from typing import Any, AsyncIterator, Optional
+
+from dynamo_tpu.runtime.component import Endpoint, EndpointClient, ServedEndpoint
+from dynamo_tpu.protocols.common import LLMEngineOutput, PreprocessedRequest
+
+
+def engine_handler(engine: Any):
+    """Wrap an AsyncEngine into an endpoint handler (worker side)."""
+
+    async def handler(payload: dict[str, Any]) -> AsyncIterator[dict[str, Any]]:
+        req = PreprocessedRequest.from_dict(payload)
+        async for out in engine.generate(req):
+            yield out.to_dict()
+
+    return handler
+
+
+async def serve_engine(
+    endpoint: Endpoint,
+    engine: Any,
+    *,
+    worker_id: str = "",
+    metadata: Optional[dict[str, Any]] = None,
+    lease_ttl_s: float = 5.0,
+) -> ServedEndpoint:
+    """Expose `engine.generate` at an endpoint instance (lease-bound)."""
+    start = getattr(engine, "start", None)
+    if start is not None:
+        start()
+    return await endpoint.serve(
+        engine_handler(engine),
+        worker_id=worker_id,
+        metadata=metadata,
+        lease_ttl_s=lease_ttl_s,
+    )
+
+
+class RemoteEngine:
+    """AsyncEngine over a remote endpoint: the frontend's view of a worker
+    fleet. Routing mode is round_robin/random/direct per request."""
+
+    def __init__(self, client: EndpointClient, mode: str = "round_robin"):
+        self.client = client
+        self.mode = mode
+
+    async def generate(
+        self, request: PreprocessedRequest, instance_id: Optional[int] = None
+    ) -> AsyncIterator[LLMEngineOutput]:
+        async for item in self.client.generate(
+            request.to_dict(),
+            mode="direct" if instance_id is not None else self.mode,
+            instance_id=instance_id,
+            request_id=request.request_id,
+        ):
+            yield LLMEngineOutput.from_dict(item)
+
+
+class RemoteWorkerEngine:
+    """Per-worker direct engine view keyed by instance id — what the KV
+    router's worker table holds for remote workers."""
+
+    def __init__(self, client: EndpointClient, instance_id: int):
+        self.client = client
+        self.instance_id = instance_id
+
+    async def generate(
+        self, request: PreprocessedRequest
+    ) -> AsyncIterator[LLMEngineOutput]:
+        async for item in self.client.generate(
+            request.to_dict(),
+            mode="direct",
+            instance_id=self.instance_id,
+            request_id=request.request_id,
+        ):
+            yield LLMEngineOutput.from_dict(item)

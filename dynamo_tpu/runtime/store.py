@@ -1,0 +1,272 @@
+"""Control-plane KV store with leases and prefix watches (Python impl).
+
+etcd-shaped semantics (reference transports/etcd.rs:44-148): every key may
+be bound to a lease; leases expire unless kept alive; expiry deletes the
+bound keys and notifies watchers — that's the whole liveness story: a dead
+worker stops sending keep-alives, its registration keys vanish, routers
+drop it.
+
+This is the wire-compatible fallback for the native C++ ``dcp-server``
+(dynamo_tpu/native/dcp_server.cc); protocol in runtime/protocol.py. The
+in-process `KvStore` core is shared by both the asyncio server here and
+unit tests.
+"""
+from __future__ import annotations
+
+import asyncio
+import itertools
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+from dynamo_tpu.runtime.protocol import encode_frame, read_frame
+
+log = logging.getLogger(__name__)
+
+WatchSink = Callable[[dict[str, Any]], None]
+
+
+@dataclass
+class _Watch:
+    prefix: str
+    sink: WatchSink
+    watch_id: int
+
+
+class KvStore:
+    """The store core: keys, leases, watches. Time injected for tests."""
+
+    def __init__(self, clock: Callable[[], float] = time.monotonic):
+        self._clock = clock
+        self._kv: dict[str, tuple[str, int]] = {}       # key -> (value, lease)
+        self._leases: dict[int, float] = {}             # lease -> deadline
+        self._lease_ttl: dict[int, float] = {}
+        self._lease_keys: dict[int, set[str]] = {}
+        self._watches: dict[int, _Watch] = {}
+        self._subs: dict[int, tuple[str, WatchSink]] = {}
+        self._ids = itertools.count(1)
+        self.revision = 0
+
+    # ---- kv ----
+
+    def put(self, key: str, value: str, lease: int = 0) -> int:
+        if lease:
+            if lease not in self._leases:
+                raise KeyError(f"lease {lease} not found")
+            self._lease_keys.setdefault(lease, set()).add(key)
+        old = self._kv.get(key)
+        if old is not None and old[1] and old[1] != lease:
+            # key moved off its old lease
+            ks = self._lease_keys.get(old[1])
+            if ks is not None:
+                ks.discard(key)
+        self._kv[key] = (value, lease)
+        self.revision += 1
+        self._notify("put", key, value)
+        return self.revision
+
+    def get(self, key: str) -> Optional[tuple[str, int]]:
+        return self._kv.get(key)
+
+    def get_prefix(self, prefix: str) -> list[tuple[str, str, int]]:
+        return sorted(
+            (k, v, l) for k, (v, l) in self._kv.items() if k.startswith(prefix)
+        )
+
+    def delete(self, key: str) -> int:
+        if key not in self._kv:
+            return 0
+        _, lease = self._kv.pop(key)
+        if lease:
+            ks = self._lease_keys.get(lease)
+            if ks is not None:
+                ks.discard(key)
+        self.revision += 1
+        self._notify("delete", key, None)
+        return 1
+
+    def delete_prefix(self, prefix: str) -> int:
+        keys = [k for k in self._kv if k.startswith(prefix)]
+        for k in keys:
+            self.delete(k)
+        return len(keys)
+
+    # ---- leases ----
+
+    def lease_grant(self, ttl: float) -> int:
+        lease = next(self._ids)
+        self._leases[lease] = self._clock() + ttl
+        self._lease_ttl[lease] = ttl
+        return lease
+
+    def lease_keepalive(self, lease: int) -> bool:
+        if lease not in self._leases:
+            return False
+        self._leases[lease] = self._clock() + self._lease_ttl[lease]
+        return True
+
+    def lease_revoke(self, lease: int) -> None:
+        self._leases.pop(lease, None)
+        self._lease_ttl.pop(lease, None)
+        for k in list(self._lease_keys.pop(lease, set())):
+            self.delete(k)
+
+    def sweep_leases(self) -> list[int]:
+        """Expire overdue leases (delete their keys + notify). Called
+        periodically by the server loop."""
+        now = self._clock()
+        expired = [l for l, dl in self._leases.items() if dl < now]
+        for l in expired:
+            log.info("lease %d expired", l)
+            self.lease_revoke(l)
+        return expired
+
+    # ---- pub/sub (NATS-core-style transient topics; reference
+    # transports/nats.rs — carries KV events and metrics) ----
+
+    def subscribe(self, topic: str, sink: WatchSink) -> int:
+        sid = next(self._ids)
+        self._subs[sid] = (topic, sink)
+        return sid
+
+    def unsubscribe(self, sub_id: int) -> None:
+        self._subs.pop(sub_id, None)
+
+    def publish(self, topic: str, value: str) -> int:
+        n = 0
+        for sid, (t, sink) in list(self._subs.items()):
+            # NATS-style token wildcard: exact match or 'a.b.>' suffix
+            if t == topic or (t.endswith(".>") and topic.startswith(t[:-1])):
+                try:
+                    sink({"sub": sid, "topic": topic, "value": value})
+                    n += 1
+                except Exception:  # noqa: BLE001
+                    self._subs.pop(sid, None)
+        return n
+
+    # ---- watches ----
+
+    def watch(self, prefix: str, sink: WatchSink) -> int:
+        wid = next(self._ids)
+        self._watches[wid] = _Watch(prefix, sink, wid)
+        return wid
+
+    def unwatch(self, watch_id: int) -> None:
+        self._watches.pop(watch_id, None)
+
+    def _notify(self, event: str, key: str, value: Optional[str]) -> None:
+        for w in list(self._watches.values()):
+            if key.startswith(w.prefix):
+                msg = {"watch": w.watch_id, "event": event, "key": key}
+                if value is not None:
+                    msg["value"] = value
+                try:
+                    w.sink(msg)
+                except Exception:  # noqa: BLE001 — one dead watcher can't stop others
+                    self._watches.pop(w.watch_id, None)
+
+
+class _Conn:
+    """One client connection to the store server."""
+
+    def __init__(self, store: KvStore, writer: asyncio.StreamWriter):
+        self.store = store
+        self.writer = writer
+        self.watch_ids: list[int] = []
+        self.sub_ids: list[int] = []
+        self.lease_ids: list[int] = []
+
+    def send(self, msg: dict[str, Any]) -> None:
+        if not self.writer.is_closing():
+            self.writer.write(encode_frame(msg))
+
+    def handle(self, req: dict[str, Any]) -> dict[str, Any]:
+        op = req.get("op")
+        s = self.store
+        if op == "put":
+            rev = s.put(req["key"], req.get("value", ""), req.get("lease", 0))
+            return {"ok": True, "rev": rev}
+        if op == "get":
+            kv = s.get(req["key"])
+            return {"ok": True, "kvs": [[req["key"], kv[0], kv[1]]] if kv else []}
+        if op == "get_prefix":
+            return {"ok": True, "kvs": [list(t) for t in s.get_prefix(req["prefix"])]}
+        if op == "delete":
+            return {"ok": True, "deleted": s.delete(req["key"])}
+        if op == "delete_prefix":
+            return {"ok": True, "deleted": s.delete_prefix(req["prefix"])}
+        if op == "lease_grant":
+            lease = s.lease_grant(float(req.get("ttl", 10.0)))
+            self.lease_ids.append(lease)
+            return {"ok": True, "lease": lease}
+        if op == "lease_keepalive":
+            ok = s.lease_keepalive(int(req["lease"]))
+            return {"ok": ok} if ok else {"ok": False, "error": "lease expired"}
+        if op == "lease_revoke":
+            s.lease_revoke(int(req["lease"]))
+            return {"ok": True}
+        if op == "watch":
+            wid = s.watch(req["prefix"], self.send)
+            self.watch_ids.append(wid)
+            return {"ok": True, "watch": wid}
+        if op == "unwatch":
+            s.unwatch(int(req["watch"]))
+            return {"ok": True}
+        if op == "subscribe":
+            sid = s.subscribe(req["topic"], self.send)
+            self.sub_ids.append(sid)
+            return {"ok": True, "sub": sid}
+        if op == "unsubscribe":
+            s.unsubscribe(int(req["sub"]))
+            return {"ok": True}
+        if op == "publish":
+            n = s.publish(req["topic"], req.get("value", ""))
+            return {"ok": True, "receivers": n}
+        if op == "ping":
+            return {"ok": True}
+        return {"ok": False, "error": f"unknown op {op!r}"}
+
+
+async def serve_store(
+    host: str = "127.0.0.1",
+    port: int = 7111,
+    store: Optional[KvStore] = None,
+    sweep_interval_s: float = 0.5,
+) -> tuple[asyncio.AbstractServer, KvStore]:
+    """Run the Python control-plane server. Returns (server, store)."""
+    store = store or KvStore()
+
+    async def on_conn(reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        conn = _Conn(store, writer)
+        try:
+            while True:
+                req = await read_frame(reader)
+                resp = conn.handle(req)
+                if "req_id" in req:
+                    resp["req_id"] = req["req_id"]
+                conn.send(resp)
+                await writer.drain()
+        except (asyncio.IncompleteReadError, ConnectionResetError):
+            pass
+        except Exception:  # noqa: BLE001
+            log.exception("store connection error")
+        finally:
+            # NOTE deliberate etcd parity: leases are NOT revoked on
+            # disconnect — only on TTL expiry or explicit revoke. Watches
+            # die with the connection.
+            for wid in conn.watch_ids:
+                store.unwatch(wid)
+            for sid in conn.sub_ids:
+                store.unsubscribe(sid)
+            writer.close()
+
+    async def sweeper():
+        while True:
+            await asyncio.sleep(sweep_interval_s)
+            store.sweep_leases()
+
+    server = await asyncio.start_server(on_conn, host, port)
+    task = asyncio.get_running_loop().create_task(sweeper())
+    server._dcp_sweeper = task  # keep a ref; dies with the loop
+    return server, store

@@ -1,0 +1,159 @@
+"""Full-system distributed serving test (reference: the examples/llm graph
+run against mock workers + docker-compose etcd/NATS, SURVEY.md §4.5/4.7).
+
+store <- workers (register_llm + KV events)  <- discovery -> frontend
+HTTP requests stream through the KV router to mocker workers; killing a
+worker fails over; KV events concentrate prefix traffic.
+"""
+import asyncio
+import json
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from dynamo_tpu.frontend import HttpService, ModelManager
+from dynamo_tpu.frontend.watcher import ModelEntry, ModelWatcher, register_llm
+from dynamo_tpu.mocker import MockerArgs, MockerEngine
+from dynamo_tpu.runtime.component import DistributedRuntime
+from dynamo_tpu.runtime.store import serve_store
+
+BS = 4
+
+
+async def setup_system(n_workers=2):
+    server, store, port = None, None, None
+    server, store = await serve_store(port=0, sweep_interval_s=0.05)
+    port = server.sockets[0].getsockname()[1]
+
+    workers = []
+    for i in range(n_workers):
+        rt = await DistributedRuntime.connect(port=port)
+        eng = MockerEngine(
+            MockerArgs(speedup_ratio=100.0, page_size=BS, num_pages=64)
+        )
+        entry = ModelEntry(
+            name="mock-model", namespace="test", component="backend",
+            block_size=BS, router_mode="kv",
+        )
+        served = await register_llm(rt, eng, entry, lease_ttl_s=0.4)
+        workers.append((rt, eng, served))
+
+    frontend_rt = await DistributedRuntime.connect(port=port)
+    manager = ModelManager()
+    from dynamo_tpu.kv_router.scheduler import KvRouterConfig
+
+    watcher = await ModelWatcher(
+        frontend_rt, manager, namespace="test",
+        router_config=KvRouterConfig(router_temperature=0.0),
+    ).start()
+    svc = HttpService(manager)
+    client = TestClient(TestServer(svc.app))
+    await client.start_server()
+    return server, workers, frontend_rt, watcher, client, manager
+
+
+async def teardown(server, workers, frontend_rt, watcher, client):
+    await client.close()
+    await watcher.stop()
+    await frontend_rt.close()
+    for rt, eng, served in workers:
+        await served.shutdown()
+        await eng.stop()
+        await rt.close()
+    server.close()
+
+
+async def chat(client, content, max_tokens=4):
+    r = await client.post(
+        "/v1/chat/completions",
+        json={
+            "model": "mock-model",
+            "messages": [{"role": "user", "content": content}],
+            "max_tokens": max_tokens,
+        },
+    )
+    return r
+
+
+async def test_discovery_serving_and_failover():
+    server, workers, frontend_rt, watcher, client, manager = await setup_system(2)
+    try:
+        # model discovered from worker registration
+        for _ in range(100):
+            if len(manager) > 0:
+                break
+            await asyncio.sleep(0.02)
+        assert manager.list_models() == ["mock-model"]
+
+        r = await chat(client, "w1 w2 w3 w4 w5")
+        assert r.status == 200
+        body = await r.json()
+        assert body["choices"][0]["finish_reason"] in ("stop", "length")
+
+        # kill worker 0 ungracefully: cancel its keep-alive (lease expires)
+        rt0, eng0, served0 = workers[0]
+        served0.lease._task.cancel()
+        await served0.server.stop()
+        # traffic must keep working throughout failover
+        deadline = asyncio.get_running_loop().time() + 6
+        ok = 0
+        while asyncio.get_running_loop().time() < deadline:
+            r = await chat(client, "w1 w2 w3 w4 w5")
+            if r.status == 200:
+                ok += 1
+            await asyncio.sleep(0.05)
+            routers = watcher._routers
+            if routers and len(routers["mock-model"].workers) == 1:
+                break
+        assert ok > 0
+        # after eviction, requests consistently succeed on the survivor
+        for _ in range(3):
+            r = await chat(client, "w6 w7")
+            assert r.status == 200
+    finally:
+        await teardown(server, workers, frontend_rt, watcher, client)
+
+
+async def test_kv_events_flow_to_router_and_concentrate():
+    server, workers, frontend_rt, watcher, client, manager = await setup_system(3)
+    try:
+        for _ in range(100):
+            if len(manager) > 0:
+                break
+            await asyncio.sleep(0.02)
+        push = None
+        for _ in range(100):
+            push = watcher._routers.get("mock-model")
+            if push is not None and len(push.workers) == 3:
+                break
+            await asyncio.sleep(0.02)
+        assert push is not None and len(push.workers) == 3
+
+        prefix = " ".join(f"w{i%9}" for i in range(40))
+        await chat(client, prefix)
+        # events propagate asynchronously over pub/sub; wait until the
+        # stream settles so the warm worker's full prefix is indexed
+        last = -1
+        for _ in range(100):
+            n = push.router.indexer.events_applied
+            if n > 0 and n == last:
+                break
+            last = n
+            await asyncio.sleep(0.05)
+        assert push.router.indexer.events_applied > 0
+
+        # follow-ups with the same prefix concentrate on the warm worker
+        before = {w: e.tokens_generated for (_, e, _), w in zip(
+            workers, [str(s.lease_id) for _, _, s in workers]
+        )}
+        hits = {w: 0 for w in before}
+        for _ in range(6):
+            await chat(client, prefix)
+            for (_, e, s) in workers:
+                w = str(s.lease_id)
+                if e.tokens_generated > before[w]:
+                    hits[w] += 1
+                before[w] = e.tokens_generated
+        assert max(hits.values()) == 6, hits
+    finally:
+        await teardown(server, workers, frontend_rt, watcher, client)

@@ -1,0 +1,218 @@
+"""Distributed runtime tests (reference lib/runtime tests: pipeline.rs,
+namespace_etcd_path.rs, leader_worker_barrier.rs test strategy).
+
+Covers the store core (keys/leases/watches/pubsub), the TCP server+client,
+the endpoint data plane, and the keystone failover scenario: two workers
+register, one dies, traffic fails over to the survivor.
+"""
+import asyncio
+import json
+
+import pytest
+
+from dynamo_tpu.runtime.client import KvClient
+from dynamo_tpu.runtime.component import DistributedRuntime
+from dynamo_tpu.runtime.endpoint import (
+    EndpointServer,
+    EndpointStreamError,
+    call_endpoint,
+)
+from dynamo_tpu.runtime.store import KvStore, serve_store
+
+
+# ---------------------------------------------------------------------------
+# store core (no sockets)
+
+
+def test_store_kv_and_prefix():
+    s = KvStore()
+    s.put("a/1", "x")
+    s.put("a/2", "y")
+    s.put("b/1", "z")
+    assert s.get("a/1") == ("x", 0)
+    assert [k for k, _, _ in s.get_prefix("a/")] == ["a/1", "a/2"]
+    assert s.delete("a/1") == 1
+    assert s.delete("a/1") == 0
+    assert s.delete_prefix("a/") == 1
+    assert s.get_prefix("a/") == []
+
+
+def test_store_lease_expiry_deletes_keys():
+    now = [0.0]
+    s = KvStore(clock=lambda: now[0])
+    lease = s.lease_grant(ttl=5.0)
+    s.put("w/1", "alive", lease=lease)
+    events = []
+    s.watch("w/", events.append)
+    now[0] = 4.0
+    assert s.sweep_leases() == []
+    assert s.lease_keepalive(lease)
+    now[0] = 8.9  # within refreshed ttl
+    assert s.sweep_leases() == []
+    now[0] = 9.1  # past it
+    assert s.sweep_leases() == [lease]
+    assert s.get("w/1") is None
+    assert events == [{"watch": events[0]["watch"], "event": "delete", "key": "w/1"}]
+
+
+def test_store_pubsub_wildcard():
+    s = KvStore()
+    got = []
+    s.subscribe("kv_events.>", got.append)
+    assert s.publish("kv_events.w0", "e1") == 1
+    assert s.publish("other.topic", "e2") == 0
+    assert got[0]["value"] == "e1"
+
+
+# ---------------------------------------------------------------------------
+# server + client over TCP
+
+
+async def start_test_store():
+    server, store = await serve_store(port=0, sweep_interval_s=0.05)
+    port = server.sockets[0].getsockname()[1]
+    return server, store, port
+
+
+async def test_client_kv_watch_pubsub():
+    server, store, port = await start_test_store()
+    c = await KvClient(port=port).connect()
+    await c.put("m/a", "1")
+    assert await c.get("m/a") == "1"
+    assert await c.get("m/missing") is None
+
+    w = await c.watch_prefix("m/")
+    assert [k for k, _, _ in w.initial] == ["m/a"]
+    await c.put("m/b", "2")
+    ev = await asyncio.wait_for(w.__anext__(), 2)
+    assert (ev["event"], ev["key"], ev["value"]) == ("put", "m/b", "2")
+
+    sub = await c.subscribe("events.>")
+    c2 = await KvClient(port=port).connect()
+    await c2.publish("events.x", "hello")
+    ev = await asyncio.wait_for(sub.__anext__(), 2)
+    assert ev["value"] == "hello"
+
+    await c.close()
+    await c2.close()
+    server.close()
+
+
+async def test_lease_keepalive_and_crash_expiry():
+    server, store, port = await start_test_store()
+    c = await KvClient(port=port).connect()
+    lease = await c.lease_grant(0.3)
+    await c.put("inst/1", "up", lease=lease.id)
+    watcher = await KvClient(port=port).connect()
+    w = await watcher.watch_prefix("inst/")
+
+    # keep-alive holds the key past several TTLs
+    await asyncio.sleep(1.0)
+    assert await c.get("inst/1") == "up"
+
+    # simulated crash: stop beating (but keep the connection open — leases
+    # must expire by TTL, not connection state)
+    lease._task.cancel()
+    ev = await asyncio.wait_for(w.__anext__(), 5)
+    assert ev["event"] == "delete" and ev["key"] == "inst/1"
+    assert await c.get("inst/1") is None
+    await c.close()
+    await watcher.close()
+    server.close()
+
+
+# ---------------------------------------------------------------------------
+# endpoint data plane
+
+
+async def test_endpoint_stream_and_error():
+    async def handler(payload):
+        for i in range(payload["n"]):
+            yield {"i": i}
+        if payload.get("boom"):
+            raise RuntimeError("boom")
+
+    srv = EndpointServer(handler)
+    host, port = await srv.start()
+    got = [m async for m in call_endpoint(host, port, {"n": 3})]
+    assert got == [{"i": 0}, {"i": 1}, {"i": 2}]
+
+    with pytest.raises(EndpointStreamError, match="boom"):
+        async for _ in call_endpoint(host, port, {"n": 1, "boom": True}):
+            pass
+    await srv.stop()
+
+
+async def test_endpoint_client_drop_cancels_handler():
+    cancelled = asyncio.Event()
+
+    async def handler(payload):
+        try:
+            for i in range(10_000):
+                await asyncio.sleep(0.01)
+                yield {"i": i}
+        finally:
+            cancelled.set()
+
+    srv = EndpointServer(handler)
+    host, port = await srv.start()
+    stream = call_endpoint(host, port, {})
+    assert (await stream.__anext__())["i"] == 0
+    await stream.aclose()
+    await asyncio.wait_for(cancelled.wait(), 5)
+    await srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# the keystone: discovery + failover
+
+
+async def test_component_discovery_and_failover():
+    server, store, port = await start_test_store()
+    rt = await DistributedRuntime.connect(port=port)
+    ep = rt.namespace("test").component("worker").endpoint("generate")
+
+    def make_handler(tag):
+        async def handler(payload):
+            yield {"from": tag, "echo": payload.get("x")}
+        return handler
+
+    w0 = await ep.serve(make_handler("w0"), worker_id="w0", lease_ttl_s=0.3)
+    w1 = await ep.serve(make_handler("w1"), worker_id="w1", lease_ttl_s=0.3)
+
+    client_rt = await DistributedRuntime.connect(port=port)
+    cl = await client_rt.namespace("test").component("worker").endpoint("generate").client()
+    await cl.wait_for_instances(2)
+
+    # round-robin reaches both workers
+    seen = set()
+    for _ in range(4):
+        async for m in cl.generate({"x": 1}):
+            seen.add(m["from"])
+    assert seen == {"w0", "w1"}
+
+    # graceful shutdown: revoke deregisters immediately
+    await w0.shutdown()
+    t0 = asyncio.get_running_loop().time()
+    while len(cl.instances) > 1:
+        assert asyncio.get_running_loop().time() - t0 < 5
+        await asyncio.sleep(0.02)
+    for _ in range(3):
+        async for m in cl.generate({"x": 2}):
+            assert m["from"] == "w1"
+
+    # crash: stop w1's keep-alive without revoking; lease expiry evicts it
+    w1.lease._task.cancel()
+    t0 = asyncio.get_running_loop().time()
+    while len(cl.instances) > 0:
+        assert asyncio.get_running_loop().time() - t0 < 5
+        await asyncio.sleep(0.02)
+    with pytest.raises(ConnectionError):
+        async for m in cl.generate({"x": 3}):
+            pass
+
+    await cl.stop()
+    await client_rt.close()
+    await w1.server.stop()
+    await rt.close()
+    server.close()
