@@ -36,6 +36,7 @@ from __future__ import annotations
 import asyncio
 import functools
 import logging
+import os
 import queue as queue_mod
 import threading
 import time
@@ -585,10 +586,22 @@ class TpuEngine:
             )
 
         so = r.req.sampling_options
-        seed = so.seed if so.seed is not None else 0
+        if so.seed is not None:
+            # seeded: fully reproducible keys derived from the seed alone
+            first_key = np.array([_FIRST_TOKEN_KEY_TAG, so.seed], np.uint32)
+            step_keys = np.array([0, so.seed], np.uint32)
+        else:
+            # unseeded: fresh entropy per request — two identical prompts
+            # must NOT produce identical outputs (landing on the same slot
+            # previously reused the [0, slot+1] key stream)
+            nonce = np.frombuffer(os.urandom(8), np.uint32).copy()
+            first_key = np.array(
+                [_FIRST_TOKEN_KEY_TAG ^ int(nonce[0]), int(nonce[1])], np.uint32
+            )
+            step_keys = nonce
         first_tok = self._sample_first(
             logits,
-            jnp.asarray(np.array([_FIRST_TOKEN_KEY_TAG, seed], np.uint32)),
+            jnp.asarray(first_key),
             jnp.float32(so.temperature or 0.0),
             jnp.int32(so.top_k or 0),
             jnp.float32(so.top_p if so.top_p is not None else 1.0),
@@ -608,8 +621,7 @@ class TpuEngine:
                 slot=slot,
                 ctx=len(prompt) + 1,
                 tok=first_tok,
-                keys=np.array([0, seed if so.seed is not None else slot + 1],
-                              np.uint32),
+                keys=step_keys,
                 temp=so.temperature or 0.0,
                 top_k=so.top_k or 0,
                 top_p=so.top_p if so.top_p is not None else 1.0,

@@ -100,17 +100,49 @@ class KvPushRouter:
     async def generate(
         self, request: PreprocessedRequest
     ) -> AsyncIterator[LLMEngineOutput]:
+        """Route + stream. An unreachable worker (connection refused, or
+        died before producing anything) is evicted — its warm-prefix blocks
+        leave the indexer so they stop attracting traffic for the rest of
+        the lease window — and the request re-routes to the next-best
+        worker. Once tokens have streamed, failures propagate (the decode
+        state died with the worker; resume is the caller's call)."""
         rid = request.request_id
-        worker_id, overlap = self.router.find_best_match(
-            rid, request.token_ids, salt=request.model
-        )
-        request.estimated_prefix_hit_num_blocks = overlap
-        engine = self.workers[worker_id]
-        log.debug("routing %s to %s (overlap %d blocks)", rid, worker_id, overlap)
-        try:
-            async for out in engine.generate(request):
-                for tok in out.token_ids:
-                    self.router.push(rid, tok)
-                yield out
-        finally:
-            self.router.free(rid)
+        attempts = max(1, len(self.workers))
+        last_err: Optional[BaseException] = None
+        for attempt in range(attempts):
+            if not self.workers:
+                break
+            worker_id, overlap = self.router.find_best_match(
+                rid, request.token_ids, salt=request.model
+            )
+            request.estimated_prefix_hit_num_blocks = overlap
+            engine = self.workers.get(worker_id)
+            if engine is None:  # scheduler raced a removal
+                self.router.free(rid)
+                self.remove_worker(worker_id)  # purge sequences + indexer too
+                continue
+            log.debug(
+                "routing %s to %s (overlap %d blocks)", rid, worker_id, overlap
+            )
+            streamed = False
+            try:
+                async for out in engine.generate(request):
+                    for tok in out.token_ids:
+                        self.router.push(rid, tok)
+                    streamed = True
+                    yield out
+                return
+            except (ConnectionError, OSError) as e:
+                if streamed or attempt == attempts - 1:
+                    raise
+                last_err = e
+                log.warning(
+                    "worker %s unreachable (%s); evicting and re-routing %s",
+                    worker_id, e, rid,
+                )
+                self.remove_worker(worker_id)
+            finally:
+                self.router.free(rid)
+        raise ConnectionError(
+            f"no reachable worker for request {rid}"
+        ) from last_err

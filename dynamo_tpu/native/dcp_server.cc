@@ -30,6 +30,7 @@
 #include <time.h>
 #include <unistd.h>
 
+#include <deque>
 #include <map>
 #include <memory>
 #include <set>
@@ -284,12 +285,23 @@ struct WatchRec {
   bool is_sub;
 };
 
+// Parked qpop long-poll: answered by the next qpush or the sweep timeout.
+struct QWaiter {
+  Conn *conn;
+  long long req_id;
+  double deadline;
+};
+
 struct Store {
   std::map<std::string, std::pair<std::string, long long>> kv;  // key -> (val, lease)
   std::unordered_map<long long, double> lease_deadline;
   std::unordered_map<long long, double> lease_ttl;
   std::unordered_map<long long, std::set<std::string>> lease_keys;
   std::map<long long, WatchRec> watches;  // watch/sub id -> rec
+  // durable FIFO queues (JetStream-work-queue equivalent; carries the
+  // disagg prefill queue) + parked poppers
+  std::map<std::string, std::deque<std::string>> queues;
+  std::map<std::string, std::deque<QWaiter>> qwaiters;
   long long next_id = 1;
   long long revision = 0;
 
@@ -338,6 +350,9 @@ struct Store {
       for (auto &k : keys) del(k);
     }
   }
+  // Deliver straight to the oldest live parked popper, else enqueue.
+  long long qpush(const std::string &q, const std::string &value);
+
   void sweep() {
     double t = now_mono();
     std::vector<long long> expired;
@@ -347,7 +362,9 @@ struct Store {
       fprintf(stderr, "dcp: lease %lld expired\n", id);
       lease_revoke(id);
     }
+    sweep_qwaiters(t);
   }
+  void sweep_qwaiters(double t);
 };
 
 // ---------------------------------------------------------------------------
@@ -380,6 +397,49 @@ void Store::notify(const char *event, const std::string &key,
       jw.s("key", key);
       if (value) jw.s("value", *value);
       w.second.conn->send_frame(jw.done());
+    }
+  }
+}
+
+long long Store::qpush(const std::string &q, const std::string &value) {
+  auto wit = qwaiters.find(q);
+  if (wit != qwaiters.end()) {
+    while (!wit->second.empty()) {
+      QWaiter w = wit->second.front();
+      wit->second.pop_front();
+      if (w.conn->dead) continue;
+      JWriter jw;
+      jw.b("ok", true).s("queue", q).s("value", value).n("req_id", w.req_id);
+      w.conn->send_frame(jw.done());
+      if (wit->second.empty()) qwaiters.erase(wit);
+      auto qit = queues.find(q);
+      return qit == queues.end() ? 0 : (long long)qit->second.size();
+    }
+    qwaiters.erase(wit);
+  }
+  queues[q].push_back(value);
+  return (long long)queues[q].size();
+}
+
+void Store::sweep_qwaiters(double t) {
+  for (auto it = qwaiters.begin(); it != qwaiters.end();) {
+    std::deque<QWaiter> keep;
+    for (auto &w : it->second) {
+      if (w.conn->dead) continue;
+      if (w.deadline < t) {
+        JWriter jw;
+        jw.b("ok", true).s("queue", it->first).b("empty", true)
+            .n("req_id", w.req_id);
+        w.conn->send_frame(jw.done());
+      } else {
+        keep.push_back(w);
+      }
+    }
+    if (keep.empty()) {
+      it = qwaiters.erase(it);
+    } else {
+      it->second = std::move(keep);
+      ++it;
     }
   }
 }
@@ -477,6 +537,28 @@ static std::string handle(Store &st, Conn *conn, JObject &req) {
     st.watches[id] = rec;
     conn->watch_ids.push_back(id);
     jw.b("ok", true).n(rec.is_sub ? "sub" : "watch", id);
+    if (!rec.is_sub) {
+      // snapshot returned atomically with watch registration — single
+      // store traversal, so no put/delete can be lost in between
+      const std::string &pfx = rec.prefix;
+      std::string arr = "[";
+      bool first = true;
+      for (auto it = st.kv.lower_bound(pfx); it != st.kv.end(); ++it) {
+        if (it->first.compare(0, pfx.size(), pfx) != 0) break;
+        if (!first) arr += ',';
+        first = false;
+        std::string one = "[";
+        jesc(one, it->first);
+        one += ',';
+        jesc(one, it->second.first);
+        char buf[32];
+        snprintf(buf, sizeof buf, ",%lld]", it->second.second);
+        one += buf;
+        arr += one;
+      }
+      arr += "]";
+      jw.raw("kvs", arr);
+    }
   } else if (op == "unwatch") {
     st.watches.erase((long long)req["watch"].num);
     jw.b("ok", true);
@@ -496,6 +578,31 @@ static std::string handle(Store &st, Conn *conn, JObject &req) {
     }
     st.notify_sub(topic, req["value"].str);
     jw.b("ok", true).n("receivers", n);
+  } else if (op == "qpush") {
+    jw.b("ok", true).n("len", st.qpush(req["queue"].str, req["value"].str));
+  } else if (op == "qpop") {
+    const std::string &q = req["queue"].str;
+    auto it = st.queues.find(q);
+    if (it != st.queues.end() && !it->second.empty()) {
+      std::string v = it->second.front();
+      it->second.pop_front();
+      if (it->second.empty()) st.queues.erase(it);
+      jw.b("ok", true).s("queue", q).s("value", v);
+    } else {
+      double timeout =
+          req["timeout"].kind == JValue::NUM ? req["timeout"].num : 0.0;
+      if (timeout > 0) {
+        // park the long-poll: answered by the next qpush or sweep timeout
+        QWaiter w{conn, (long long)req["req_id"].num, now_mono() + timeout};
+        st.qwaiters[q].push_back(w);
+        return "";  // deferred — no immediate response
+      }
+      jw.b("ok", true).s("queue", q).b("empty", true);
+    }
+  } else if (op == "qlen") {
+    auto it = st.queues.find(req["queue"].str);
+    jw.b("ok", true).n(
+        "len", it == st.queues.end() ? 0 : (long long)it->second.size());
   } else if (op == "ping") {
     jw.b("ok", true);
   } else {
@@ -587,6 +694,7 @@ int main(int argc, char **argv) {
           JParser jp(body);
           if (!jp.parse_object(req)) continue;
           std::string resp = handle(st, c, req);
+          if (resp.empty()) continue;  // deferred (parked qpop)
           if (req.count("req_id")) {
             // splice req_id into the response object
             char buf2[48];
@@ -615,6 +723,19 @@ int main(int argc, char **argv) {
     for (auto it2 = conns.begin(); it2 != conns.end();) {
       if (it2->second->dead) {
         for (long long wid : it2->second->watch_ids) st.watches.erase(wid);
+        // drop parked qpops held by this conn (pointers would dangle)
+        Conn *dying = it2->second.get();
+        for (auto qit = st.qwaiters.begin(); qit != st.qwaiters.end();) {
+          std::deque<QWaiter> keep;
+          for (auto &w : qit->second)
+            if (w.conn != dying) keep.push_back(w);
+          if (keep.empty()) {
+            qit = st.qwaiters.erase(qit);
+          } else {
+            qit->second = std::move(keep);
+            ++qit;
+          }
+        }
         close(it2->first);
         it2 = conns.erase(it2);
       } else {

@@ -11,6 +11,7 @@ from __future__ import annotations
 import asyncio
 import itertools
 import logging
+import time
 from typing import Any, AsyncIterator, Callable, Optional
 
 from dynamo_tpu.runtime.protocol import encode_frame, read_frame
@@ -37,17 +38,34 @@ class Lease:
             self._task = asyncio.get_running_loop().create_task(self._beat())
 
     async def _beat(self) -> None:
-        # 3 beats per TTL; a missed beat window ⇒ lease gone ⇒ lost event
-        # (the reference cancels the runtime when the primary lease dies)
+        # 3 beats per TTL. etcd-client semantics: transient failures are
+        # retried until the TTL has actually elapsed since the last ack —
+        # only a server round-trip that reports the lease gone, or a full
+        # TTL of silence, declares it lost (the reference cancels the
+        # runtime when the primary lease dies).
         interval = max(self.ttl_s / 3.0, 0.05)
+        last_ack = time.monotonic()
         while True:
             await asyncio.sleep(interval)
             try:
-                ok = await self.client.lease_keepalive(self.id)
-            except (StoreError, ConnectionError, OSError):
-                ok = False
-            if not ok:
-                log.warning("lease %d lost", self.id)
+                # bound the RPC: a hung server (silent partition, no RST)
+                # must not park _beat forever past the TTL deadline
+                ok = await asyncio.wait_for(
+                    self.client.lease_keepalive(self.id), timeout=interval
+                )
+            except (ConnectionError, OSError, asyncio.TimeoutError):
+                # transient: control plane unreachable; the lease may still
+                # be live server-side. Retry until the TTL deadline passes.
+                if time.monotonic() - last_ack > self.ttl_s:
+                    log.warning("lease %d lost (no ack within TTL)", self.id)
+                    self.lost.set()
+                    return
+                continue
+            if ok:
+                last_ack = time.monotonic()
+            else:
+                # authoritative: the server answered and the lease is gone
+                log.warning("lease %d lost (expired server-side)", self.id)
                 self.lost.set()
                 return
 
@@ -93,6 +111,10 @@ class Watch:
             await self.client._call(op)
         except (StoreError, ConnectionError, OSError):
             pass
+        # events in flight during the unwatch round-trip landed in the
+        # orphan buffer under this (never-reused) id; reclaim them now that
+        # the server has stopped sending
+        self.client._orphan_events.pop(self.watch_id, None)
         self.queue.put_nowait(None)
 
 
@@ -106,6 +128,10 @@ class KvClient:
         self._writer: Optional[asyncio.StreamWriter] = None
         self._pending: dict[int, asyncio.Future] = {}
         self._watches: dict[int, Watch] = {}
+        # events that arrive between a watch/subscribe response and the
+        # caller registering the Watch object (same-loop race: _rx may read
+        # the first event frame before the requesting coroutine resumes)
+        self._orphan_events: dict[int, list[dict[str, Any]]] = {}
         self._ids = itertools.count(1)
         self._rx_task: Optional[asyncio.Task] = None
         self.closed = asyncio.Event()
@@ -147,9 +173,16 @@ class KvClient:
                     if fut is not None and not fut.done():
                         fut.set_result(msg)
                 elif "watch" in msg or "sub" in msg:
-                    w = self._watches.get(msg.get("watch") or msg.get("sub"))
+                    wid = msg.get("watch") or msg.get("sub")
+                    w = self._watches.get(wid)
                     if w is not None:
                         w.queue.put_nowait(msg)
+                    else:
+                        self._orphan_events.setdefault(wid, []).append(msg)
+                        # hard bound: ids are monotonic, so the smallest
+                        # buffered wid is the stalest claim-in-flight
+                        while len(self._orphan_events) > 64:
+                            self._orphan_events.pop(min(self._orphan_events))
         except (asyncio.IncompleteReadError, ConnectionResetError, OSError):
             pass
         finally:
@@ -217,12 +250,42 @@ class KvClient:
         return bool((await self._call({"op": "ping"})).get("ok"))
 
     async def watch_prefix(self, prefix: str) -> Watch:
-        """Snapshot + live events (etcd.rs kv_get_and_watch_prefix)."""
-        snapshot = await self.get_prefix(prefix)
+        """Snapshot + live events (etcd.rs kv_get_and_watch_prefix). The
+        server returns the snapshot atomically with watch registration in a
+        single op, so no put/delete can fall between snapshot and watch."""
         resp = await self._call({"op": "watch", "prefix": prefix})
+        snapshot = [tuple(kv) for kv in resp.get("kvs", [])]
         w = Watch(self, resp["watch"], snapshot)
-        self._watches[w.watch_id] = w
+        self._register_watch(w)
         return w
+
+    def _register_watch(self, w: Watch) -> None:
+        self._watches[w.watch_id] = w
+        for msg in self._orphan_events.pop(w.watch_id, []):
+            w.queue.put_nowait(msg)
+
+    # ---- durable FIFO queues (JetStream-work-queue equivalent; carries
+    # the disagg prefill queue — reference utils/prefill_queue.py) ----
+
+    async def qpush(self, queue: str, value: str) -> int:
+        """Push; returns queue depth after the op (0 if delivered straight
+        to a parked popper)."""
+        return (await self._call(
+            {"op": "qpush", "queue": queue, "value": value}
+        ))["len"]
+
+    async def qpop(
+        self, queue: str, timeout_s: float = 0.0
+    ) -> Optional[str]:
+        """Pop the oldest value; with timeout_s > 0 the server parks the
+        request (long-poll) and replies on push or timeout. None if empty."""
+        resp = await self._call(
+            {"op": "qpop", "queue": queue, "timeout": timeout_s}
+        )
+        return None if resp.get("empty") else resp["value"]
+
+    async def qlen(self, queue: str) -> int:
+        return (await self._call({"op": "qlen", "queue": queue}))["len"]
 
     # ---- pub/sub (NATS-core-equivalent event plane) ----
 
@@ -235,5 +298,41 @@ class KvClient:
         may end in '.>' for NATS-style suffix wildcard."""
         resp = await self._call({"op": "subscribe", "topic": topic})
         w = Watch(self, resp["sub"], [], kind="sub")
-        self._watches[w.watch_id] = w
+        self._register_watch(w)
         return w
+
+
+class ObjectStore:
+    """NATS-object-store equivalent over the kv plane (reference
+    model_card/model.rs:256-305 uses the NATS object store for model-card
+    artifacts). Objects are single values under a bucket prefix — the
+    frame cap (64 MB) bounds object size; binary payloads are base64."""
+
+    ROOT = "dynamo://_objects/"
+
+    def __init__(self, kv: KvClient):
+        self.kv = kv
+
+    def _key(self, bucket: str, name: str) -> str:
+        return f"{self.ROOT}{bucket}/{name}"
+
+    async def put(self, bucket: str, name: str, data: bytes) -> None:
+        import base64
+
+        await self.kv.put(
+            self._key(bucket, name), base64.b64encode(data).decode()
+        )
+
+    async def get(self, bucket: str, name: str) -> Optional[bytes]:
+        import base64
+
+        v = await self.kv.get(self._key(bucket, name))
+        return None if v is None else base64.b64decode(v)
+
+    async def delete(self, bucket: str, name: str) -> None:
+        await self.kv.delete(self._key(bucket, name))
+
+    async def list(self, bucket: str) -> list[str]:
+        prefix = f"{self.ROOT}{bucket}/"
+        kvs = await self.kv.get_prefix(prefix)
+        return [k[len(prefix):] for k, _, _ in kvs]

@@ -84,7 +84,12 @@ class EndpointServer:
 
 
 class EndpointStreamError(RuntimeError):
-    pass
+    """Handler-side error reported in-band by the worker."""
+
+
+class EndpointConnectionError(EndpointStreamError, ConnectionError):
+    """Transport-level failure (worker unreachable or died mid-stream) —
+    retriable by routers, unlike an in-band handler error."""
 
 
 async def call_endpoint(
@@ -105,6 +110,6 @@ async def call_endpoint(
             if msg.get("done"):
                 return
     except asyncio.IncompleteReadError as e:
-        raise EndpointStreamError("worker connection lost mid-stream") from e
+        raise EndpointConnectionError("worker connection lost mid-stream") from e
     finally:
         writer.close()

@@ -27,10 +27,12 @@ class _TopicPublisher:
         self.topic = topic
         self.queue: asyncio.Queue = asyncio.Queue(maxsize=4096)
         self._task: Optional[asyncio.Task] = None
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
 
     def start(self) -> None:
         if self._task is None:
-            self._task = asyncio.get_running_loop().create_task(self._drain())
+            self._loop = asyncio.get_running_loop()
+            self._task = self._loop.create_task(self._drain())
 
     async def stop(self) -> None:
         if self._task is not None:
@@ -38,6 +40,25 @@ class _TopicPublisher:
             self._task = None
 
     def offer(self, payload: dict) -> None:
+        """Thread-safe: engine callbacks fire from the engine's dedicated
+        thread; asyncio.Queue is not thread-safe, so hop onto the
+        publisher's loop unless already on it."""
+        loop = self._loop
+        if loop is None or loop.is_closed():
+            # not started yet: buffer directly (put_nowait is safe pre-loop);
+            # drained once start() spawns the task
+            self._enqueue(payload)
+            return
+        try:
+            running = asyncio.get_running_loop()
+        except RuntimeError:
+            running = None
+        if running is loop:
+            self._enqueue(payload)
+        else:
+            loop.call_soon_threadsafe(self._enqueue, payload)
+
+    def _enqueue(self, payload: dict) -> None:
         try:
             self.queue.put_nowait(payload)
         except asyncio.QueueFull:

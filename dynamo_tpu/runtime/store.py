@@ -17,6 +17,7 @@ import asyncio
 import itertools
 import logging
 import time
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Callable, Optional
 
@@ -45,6 +46,10 @@ class KvStore:
         self._lease_keys: dict[int, set[str]] = {}
         self._watches: dict[int, _Watch] = {}
         self._subs: dict[int, tuple[str, WatchSink]] = {}
+        self._queues: dict[str, deque] = {}
+        # queue -> waiters: (sink, req_id, deadline, alive) — parked qpop
+        # long-polls served FIFO on the next push
+        self._qwaiters: dict[str, deque] = {}
         self._ids = itertools.count(1)
         self.revision = 0
 
@@ -122,6 +127,74 @@ class KvStore:
             self.lease_revoke(l)
         return expired
 
+    # ---- durable FIFO queues (JetStream-work-queue equivalent; reference
+    # transports/nats.rs:50-170 + utils/prefill_queue.py — carries the
+    # disagg prefill queue). Values outlive producer connections; a parked
+    # qpop (long-poll) is served directly on the next push. ----
+
+    def qpush(self, queue: str, value: str) -> int:
+        """Push; delivers straight to the oldest parked popper if any.
+        Returns the queue depth after the operation."""
+        waiters = self._qwaiters.get(queue)
+        while waiters:
+            sink, rid, _deadline, alive = waiters.popleft()
+            if not alive():
+                continue
+            try:
+                sink({"ok": True, "queue": queue, "value": value,
+                      "req_id": rid})
+                return len(self._queues.get(queue, ()))
+            except Exception:  # noqa: BLE001 — dead waiter; try the next
+                continue
+        self._queues.setdefault(queue, deque()).append(value)
+        return len(self._queues[queue])
+
+    def qpop(self, queue: str) -> Optional[str]:
+        q = self._queues.get(queue)
+        if q:
+            v = q.popleft()
+            if not q:
+                self._queues.pop(queue, None)
+            return v
+        return None
+
+    def qlen(self, queue: str) -> int:
+        return len(self._queues.get(queue, ()))
+
+    def qwait(
+        self,
+        queue: str,
+        sink: WatchSink,
+        req_id: Any,
+        timeout: float,
+        alive: Callable[[], bool] = lambda: True,
+    ) -> None:
+        self._qwaiters.setdefault(queue, deque()).append(
+            (sink, req_id, self._clock() + timeout, alive)
+        )
+
+    def sweep_qwaiters(self) -> None:
+        """Time out parked qpops (in-band empty reply). Called by the
+        server loop alongside lease sweeping."""
+        now = self._clock()
+        for queue in list(self._qwaiters):
+            ws = self._qwaiters[queue]
+            keep: deque = deque()
+            for sink, rid, deadline, alive in ws:
+                if deadline < now or not alive():
+                    if alive():
+                        try:
+                            sink({"ok": True, "queue": queue, "empty": True,
+                                  "req_id": rid})
+                        except Exception:  # noqa: BLE001
+                            pass
+                else:
+                    keep.append((sink, rid, deadline, alive))
+            if keep:
+                self._qwaiters[queue] = keep
+            else:
+                self._qwaiters.pop(queue, None)
+
     # ---- pub/sub (NATS-core-style transient topics; reference
     # transports/nats.rs — carries KV events and metrics) ----
 
@@ -185,7 +258,12 @@ class _Conn:
         op = req.get("op")
         s = self.store
         if op == "put":
-            rev = s.put(req["key"], req.get("value", ""), req.get("lease", 0))
+            lease = req.get("lease", 0)
+            if lease and lease not in s._leases:
+                # in-band error, wire-identical to dcp_server.cc — a stale
+                # lease must not tear down the whole multiplexed connection
+                return {"ok": False, "error": "lease not found"}
+            rev = s.put(req["key"], req.get("value", ""), lease)
             return {"ok": True, "rev": rev}
         if op == "get":
             kv = s.get(req["key"])
@@ -207,9 +285,16 @@ class _Conn:
             s.lease_revoke(int(req["lease"]))
             return {"ok": True}
         if op == "watch":
+            # register-then-snapshot in one synchronous op: no event can be
+            # lost between the snapshot and the live stream (the reference's
+            # etcd kv_get_and_watch_prefix atomicity)
             wid = s.watch(req["prefix"], self.send)
             self.watch_ids.append(wid)
-            return {"ok": True, "watch": wid}
+            return {
+                "ok": True,
+                "watch": wid,
+                "kvs": [list(t) for t in s.get_prefix(req["prefix"])],
+            }
         if op == "unwatch":
             s.unwatch(int(req["watch"]))
             return {"ok": True}
@@ -223,6 +308,24 @@ class _Conn:
         if op == "publish":
             n = s.publish(req["topic"], req.get("value", ""))
             return {"ok": True, "receivers": n}
+        if op == "qpush":
+            return {"ok": True, "len": s.qpush(req["queue"], req.get("value", ""))}
+        if op == "qpop":
+            v = s.qpop(req["queue"])
+            if v is not None:
+                return {"ok": True, "queue": req["queue"], "value": v}
+            timeout = float(req.get("timeout", 0.0))
+            if timeout > 0:
+                # park: the reply frame is sent by qpush delivery or the
+                # sweeper's timeout, carrying this op's req_id
+                s.qwait(
+                    req["queue"], self.send, req.get("req_id"), timeout,
+                    alive=lambda: not self.writer.is_closing(),
+                )
+                return None  # deferred
+            return {"ok": True, "queue": req["queue"], "empty": True}
+        if op == "qlen":
+            return {"ok": True, "len": s.qlen(req["queue"])}
         if op == "ping":
             return {"ok": True}
         return {"ok": False, "error": f"unknown op {op!r}"}
@@ -242,7 +345,14 @@ async def serve_store(
         try:
             while True:
                 req = await read_frame(reader)
-                resp = conn.handle(req)
+                try:
+                    resp = conn.handle(req)
+                except Exception as e:  # noqa: BLE001 — answer in-band;
+                    # a bad op must not kill the multiplexed connection
+                    log.exception("store op failed: %s", req.get("op"))
+                    resp = {"ok": False, "error": str(e)}
+                if resp is None:  # deferred (parked qpop long-poll)
+                    continue
                 if "req_id" in req:
                     resp["req_id"] = req["req_id"]
                 conn.send(resp)
@@ -265,6 +375,7 @@ async def serve_store(
         while True:
             await asyncio.sleep(sweep_interval_s)
             store.sweep_leases()
+            store.sweep_qwaiters()
 
     server = await asyncio.start_server(on_conn, host, port)
     task = asyncio.get_running_loop().create_task(sweeper())

@@ -262,6 +262,23 @@ async def test_engine_sampling_seeded(engine_setup):
     await eng.stop()
 
 
+async def test_engine_unseeded_sampling_differs(engine_setup):
+    """Two identical unseeded prompts must not produce identical streams
+    (advisor r1/r2: slot-derived keys made them deterministic)."""
+    eng = make_engine(engine_setup)
+    req = lambda: PreprocessedRequest(  # noqa: E731
+        token_ids=list(range(1, 20)),
+        stop_conditions=StopConditions(max_tokens=16, ignore_eos=True),
+        sampling_options=SamplingOptions(temperature=1.5, top_k=50),
+    )
+    # run sequentially so both land on the same freed slot
+    outs = [await collect(eng, req()) for _ in range(4)]
+    streams = [o[0] for o in outs]
+    assert all(len(s) == 16 for s in streams)
+    assert len({tuple(s) for s in streams}) > 1
+    await eng.stop()
+
+
 async def test_engine_chunked_prefill_long_prompt(engine_setup):
     """Prompts longer than the largest prefill bucket run as page-aligned
     continuation chunks; logits must match the short-bucket path exactly."""

@@ -219,3 +219,67 @@ async def test_router_tracks_and_frees_active_blocks():
     # after completion the request's blocks are freed
     assert router.sequences.active_blocks() == {"w0": 0}
     await eng.stop()
+
+
+async def test_router_evicts_dead_worker_and_reroutes():
+    """Advisor r2 (high): a warm prefix mapped to a dead worker must not
+    deterministically 500 for the whole lease window — on a connection
+    error the router evicts the worker (indexer included) and re-routes."""
+    router = KvRouter(BS, KvRouterConfig(router_temperature=0.0))
+    push = KvPushRouter(router)
+
+    class DeadEngine:
+        async def generate(self, request):
+            raise ConnectionError("connection refused")
+            yield  # pragma: no cover — make it an async generator
+
+    live = MockerEngine(MockerArgs(speedup_ratio=100.0, page_size=BS,
+                                   num_pages=64, worker_id="live"))
+    push.add_worker("dead", DeadEngine())
+    push.add_worker("live", live)
+
+    # warm ONLY the dead worker in the indexer: temp-0 routing will always
+    # prefer it for this prefix
+    prefix = list(range(1, 33))
+    hashes = compute_block_hashes(prefix, BS)
+    router.indexer.apply_event(stored("dead", hashes))
+
+    req = PreprocessedRequest(
+        token_ids=prefix + [99],
+        stop_conditions=StopConditions(max_tokens=4, ignore_eos=True),
+    )
+    toks = []
+    async for out in push.generate(req):
+        toks.extend(out.token_ids)
+    assert len(toks) == 4                  # served by the live worker
+    assert "dead" not in push.workers      # evicted
+    assert router.indexer.find_matches(hashes).scores.get("dead") is None
+    # subsequent requests route straight to the live worker
+    toks2 = []
+    async for out in push.generate(req):
+        toks2.extend(out.token_ids)
+    assert len(toks2) == 4
+    await live.stop()
+
+
+async def test_router_raises_when_all_workers_dead():
+    router = KvRouter(BS, KvRouterConfig(router_temperature=0.0))
+    push = KvPushRouter(router)
+
+    class DeadEngine:
+        async def generate(self, request):
+            raise ConnectionError("refused")
+            yield  # pragma: no cover
+
+    push.add_worker("d0", DeadEngine())
+    push.add_worker("d1", DeadEngine())
+    req = PreprocessedRequest(
+        token_ids=list(range(1, 10)),
+        stop_conditions=StopConditions(max_tokens=2, ignore_eos=True),
+    )
+    try:
+        async for _ in push.generate(req):
+            pass
+        raise AssertionError("expected ConnectionError")
+    except ConnectionError:
+        pass

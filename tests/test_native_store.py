@@ -22,14 +22,17 @@ BINARY = NATIVE / "build" / "dcp-server"
 
 @pytest.fixture(scope="module")
 def dcp_binary():
-    if not BINARY.exists():
-        if shutil.which("make") is None or shutil.which("g++") is None:
-            pytest.skip("no native toolchain")
-        r = subprocess.run(
-            ["make", "-C", str(NATIVE)], capture_output=True, text=True
-        )
-        if r.returncode != 0:
-            pytest.skip(f"native build failed: {r.stderr[-500:]}")
+    # always run make (incremental) so a stale binary never masks source
+    # changes; the binary itself is gitignored
+    if shutil.which("make") is None or shutil.which("g++") is None:
+        if BINARY.exists():
+            return BINARY
+        pytest.skip("no native toolchain")
+    r = subprocess.run(
+        ["make", "-C", str(NATIVE)], capture_output=True, text=True
+    )
+    if r.returncode != 0:
+        pytest.skip(f"native build failed: {r.stderr[-500:]}")
     return BINARY
 
 
@@ -123,3 +126,27 @@ async def test_native_component_failover(dcp_server):
     await cl.stop()
     await w1.shutdown()
     await rt.close()
+
+
+async def test_native_queue_longpoll(dcp_server):
+    """The C++ server's queue plane must match the Python store's wire
+    behavior: FIFO, cross-connection durability, parked long-poll, timeout."""
+    producer = await KvClient(port=dcp_server).connect()
+    consumer = await KvClient(port=dcp_server).connect()
+
+    await producer.qpush("prefill", "j1")
+    await producer.qpush("prefill", "j2")
+    assert await producer.qlen("prefill") == 2
+    assert await consumer.qpop("prefill") == "j1"
+    assert await consumer.qpop("prefill") == "j2"
+    assert await consumer.qpop("prefill") is None
+
+    pop_task = asyncio.create_task(consumer.qpop("q2", timeout_s=5.0))
+    await asyncio.sleep(0.1)
+    await producer.qpush("q2", "late")
+    assert await asyncio.wait_for(pop_task, 2) == "late"
+
+    assert await consumer.qpop("empty", timeout_s=0.3) is None
+
+    await producer.close()
+    await consumer.close()

@@ -216,3 +216,142 @@ async def test_component_discovery_and_failover():
     await w1.server.stop()
     await rt.close()
     server.close()
+
+
+async def test_put_with_stale_lease_is_in_band_error():
+    """Advisor r2 (medium): a put against an expired/unknown lease must
+    answer {"ok": false} in-band — not tear down the multiplexed
+    connection (killing every watch and pending future on it)."""
+    from dynamo_tpu.runtime.client import StoreError
+
+    server, store, port = await start_test_store()
+    c = await KvClient(port=port).connect()
+    w = await c.watch_prefix("k/")
+    with pytest.raises(StoreError):
+        await c.put("k/x", "v", lease=999999)
+    # connection and watch both survive
+    assert await c.ping()
+    await c.put("k/y", "1")
+    ev = await asyncio.wait_for(w.__anext__(), 2)
+    assert (ev["event"], ev["key"]) == ("put", "k/y")
+    await c.close()
+    server.close()
+
+
+async def test_watch_snapshot_is_atomic_with_registration():
+    """The watch op returns the snapshot atomically with registration — a
+    put landing right around watch start is seen exactly once (either in
+    the snapshot or as an event), never lost."""
+    server, store, port = await start_test_store()
+    writer = await KvClient(port=port).connect()
+    await writer.put("a/0", "x")
+
+    for i in range(1, 6):
+        c = await KvClient(port=port).connect()
+        # concurrent put racing the watch registration
+        put_task = asyncio.create_task(writer.put(f"a/{i}", "y"))
+        w = await c.watch_prefix("a/")
+        await put_task
+        seen = {k for k, _, _ in w.initial}
+        if f"a/{i}" not in seen:
+            ev = await asyncio.wait_for(w.__anext__(), 2)
+            assert ev["key"] == f"a/{i}"
+        await c.close()
+    await writer.close()
+    server.close()
+
+
+async def test_lease_keepalive_retries_transient_failures():
+    """Advisor r2 (low): one failed beat must not kill the lease — the
+    client retries until a full TTL of silence."""
+    server, store, port = await start_test_store()
+    c = await KvClient(port=port).connect()
+    lease = await c.lease_grant(1.2)
+
+    # monkeypatch one transient failure into the keepalive path
+    real = c.lease_keepalive
+    fails = {"n": 1}
+
+    async def flaky(lease_id):
+        if fails["n"] > 0:
+            fails["n"] -= 1
+            raise ConnectionError("transient blip")
+        return await real(lease_id)
+
+    c.lease_keepalive = flaky
+    await asyncio.sleep(1.0)  # spans ≥2 beats incl. the failed one
+    assert not lease.lost.is_set()
+    assert store.lease_keepalive(lease.id)  # still live server-side
+    await lease.revoke()
+    await c.close()
+    server.close()
+
+
+# ---------------------------------------------------------------------------
+# durable queues (JetStream-work-queue equivalent; prefill queue transport)
+
+
+def test_store_queue_fifo_core():
+    s = KvStore()
+    assert s.qlen("q") == 0
+    assert s.qpop("q") is None
+    assert s.qpush("q", "a") == 1
+    assert s.qpush("q", "b") == 2
+    assert s.qpop("q") == "a"
+    assert s.qpop("q") == "b"
+    assert s.qpop("q") is None
+
+
+async def test_queue_longpoll_and_fifo_over_wire():
+    server, store, port = await start_test_store()
+    producer = await KvClient(port=port).connect()
+    consumer = await KvClient(port=port).connect()
+
+    # values outlive the producer connection (durability across clients)
+    await producer.qpush("prefill", "job1")
+    await producer.qpush("prefill", "job2")
+    assert await producer.qlen("prefill") == 2
+    assert await consumer.qpop("prefill") == "job1"
+
+    # parked long-poll served by the next push
+    pop_task = asyncio.create_task(consumer.qpop("prefill2", timeout_s=5.0))
+    await asyncio.sleep(0.1)  # let it park
+    await producer.qpush("prefill2", "job3")
+    assert await asyncio.wait_for(pop_task, 2) == "job3"
+
+    # long-poll timeout returns None (served by the sweeper)
+    assert await consumer.qpop("empty-q", timeout_s=0.2) is None
+
+    # FIFO among waiters: two parked pops served in park order
+    c2 = await KvClient(port=port).connect()
+    p1 = asyncio.create_task(consumer.qpop("q3", timeout_s=5.0))
+    await asyncio.sleep(0.05)
+    p2 = asyncio.create_task(c2.qpop("q3", timeout_s=5.0))
+    await asyncio.sleep(0.05)
+    await producer.qpush("q3", "first")
+    await producer.qpush("q3", "second")
+    assert await asyncio.wait_for(p1, 2) == "first"
+    assert await asyncio.wait_for(p2, 2) == "second"
+
+    await producer.close()
+    await consumer.close()
+    await c2.close()
+    server.close()
+
+
+async def test_object_store_roundtrip():
+    from dynamo_tpu.runtime.client import ObjectStore
+
+    server, store, port = await start_test_store()
+    c = await KvClient(port=port).connect()
+    obj = ObjectStore(c)
+    blob = bytes(range(256)) * 3
+    await obj.put("cards", "llama-8b", blob)
+    assert await obj.get("cards", "llama-8b") == blob
+    assert await obj.get("cards", "missing") is None
+    await obj.put("cards", "other", b"x")
+    assert sorted(await obj.list("cards")) == ["llama-8b", "other"]
+    await obj.delete("cards", "other")
+    assert await obj.list("cards") == ["llama-8b"]
+    await c.close()
+    server.close()
