@@ -71,6 +71,16 @@ log = logging.getLogger(__name__)
 _FIRST_TOKEN_KEY_TAG = 0x46697273  # distinct PRNG stream for first tokens
 
 
+def pow2_cover(n: int, lo: int = 1) -> int:
+    """Smallest power of two >= max(n, lo) — the compile-cache bucketing
+    used for page-table widths and transfer sizes (padding always targets
+    scratch page 0)."""
+    w = lo
+    while w < n:
+        w *= 2
+    return w
+
+
 @dataclass
 class _Request:
     req: PreprocessedRequest
@@ -190,6 +200,7 @@ class TpuEngine:
         self._build_jits()
 
         self._intake: queue_mod.Queue = queue_mod.Queue()
+        self._xfer: queue_mod.Queue = queue_mod.Queue()  # page export/import
         self._waiting: list[_Request] = []
         self._entries: list[_Entry] = []
         self._grow_dirty: set[int] = set()
@@ -338,6 +349,64 @@ class TpuEngine:
         finally:
             r.cancelled = True
 
+    # ------------------------------------------------------------------
+    # KV page export/import (block-transfer data plane hooks;
+    # kv_transfer.py BlockTransferServer read_fn/write_fn)
+
+    def export_pages(self, page_ids: list[int]) -> np.ndarray:
+        """Gather whole pages to host: [2, L, kvh, n, ps, hd]. Thread-safe —
+        blocks the CALLER until the engine loop services it at a round
+        boundary (device-order safe w.r.t. in-flight steps)."""
+        return self._xfer_op("export", page_ids, None)
+
+    def import_pages(self, page_ids: list[int], data: np.ndarray) -> None:
+        """Scatter host pages into the pool (inverse of export_pages)."""
+        self._xfer_op("import", page_ids, data)
+
+    def _xfer_op(self, kind: str, page_ids: list[int], data) -> Any:
+        if self._stop.is_set():
+            raise RuntimeError("engine stopped")
+        if not self._started:
+            self.start()
+        done = threading.Event()
+        box: dict[str, Any] = {}
+        self._xfer.put((kind, list(page_ids), data, done, box))
+        if not done.wait(timeout=120.0):
+            raise TimeoutError(f"page {kind} timed out")
+        if "error" in box:
+            raise box["error"]
+        return box.get("result")
+
+    def _process_transfers(self) -> None:
+        while True:
+            try:
+                kind, ids, data, done, box = self._xfer.get_nowait()
+            except queue_mod.Empty:
+                return
+            try:
+                n = len(ids)
+                # pow2 bucket (pad with scratch page 0) to bound recompiles
+                w = pow2_cover(n)
+                padded = np.zeros(w, np.int32)
+                padded[:n] = ids
+                if kind == "export":
+                    out = llama.gather_pages(self.cache, jnp.asarray(padded))
+                    box["result"] = np.asarray(out)[:, :, :, :n]
+                else:
+                    pad_shape = list(data.shape)
+                    pad_shape[3] = w - n
+                    full = np.concatenate(
+                        [data, np.zeros(pad_shape, data.dtype)], axis=3
+                    ) if w > n else data
+                    self.cache = llama.scatter_pages(
+                        self.cache, jnp.asarray(padded), jnp.asarray(full)
+                    )
+                    box["result"] = None
+            except Exception as e:  # noqa: BLE001 — surface to the caller
+                box["error"] = e
+            finally:
+                done.set()
+
     def metrics(self) -> ForwardPassMetrics:
         a = self.allocator
         return ForwardPassMetrics(
@@ -371,6 +440,14 @@ class TpuEngine:
                     self._waiting.append(self._intake.get(timeout=0.02))
                 except queue_mod.Empty:
                     pass
+        # abandon queued transfer ops with an error, not a 120s stall
+        while True:
+            try:
+                *_ignored, done, box = self._xfer.get_nowait()
+            except queue_mod.Empty:
+                break
+            box["error"] = RuntimeError("engine stopped")
+            done.set()
 
     def _round(self) -> bool:
         """One scheduling round: process ready results, apply patches
@@ -380,6 +457,7 @@ class TpuEngine:
         rounds_in_flight = sum(1 for en in self._entries if en.kind == "round")
         self._process_entries(block=rounds_in_flight > e.max_inflight_rounds)
         self._apply_releases()
+        self._process_transfers()
         self._admit()
 
         active = [i for i, s in enumerate(self._slots) if s is not None]
@@ -413,10 +491,7 @@ class TpuEngine:
         widest = max(
             (len(self._slots[i].pages) for i in active), default=1
         )
-        w = 2
-        while w < widest:
-            w *= 2
-        w = min(w, e.max_pages_per_seq)
+        w = min(pow2_cover(widest, lo=2), e.max_pages_per_seq)
         pt_dev = jnp.asarray(self._pt_disp[:, :w])
         # ring slot 0 holds the position decoded by this round's first step
         ring_base_np = np.maximum(self._ctx_disp - 1, 0)
@@ -567,10 +642,8 @@ class TpuEngine:
             toks[: len(chunk)] = chunk
             # width-bucketed table (pow2 cover of pages in play); one
             # compile per (bucket, width) pair
-            w = 2
-            while w < start // ps + pad_t // ps:
-                w *= 2
-            w = min(w, e.max_pages_per_seq)
+            w = min(pow2_cover(start // ps + pad_t // ps, lo=2),
+                    e.max_pages_per_seq)
             table = np.zeros(w, np.int32)
             table[: len(r.pages)] = r.pages[:w]
             self.cache, logits = llama.prefill(

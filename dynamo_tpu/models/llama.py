@@ -374,6 +374,34 @@ flush = jax.jit(flush_impl, static_argnums=(0,), donate_argnums=(1,))
 
 
 # ---------------------------------------------------------------------------
+# KV page export/import (the block-transfer data plane's device ops;
+# reference analogue: NIXL block read/write, block_manager/block/transfer.rs)
+
+def gather_pages_impl(cache: Cache, page_ids: jnp.ndarray) -> jnp.ndarray:
+    """Pull whole pages out of the pool: [2, L, kvh, n, ps, hd] (k then v).
+    Callers bucket n to a pow2 (padding with scratch page 0) to bound
+    recompiles; the host slices the padding off after fetch."""
+    return jnp.stack(
+        [cache["k"][:, :, page_ids], cache["v"][:, :, page_ids]]
+    )
+
+
+def scatter_pages_impl(
+    cache: Cache, page_ids: jnp.ndarray, data: jnp.ndarray
+) -> Cache:
+    """Write whole pages into the pool (inverse of gather_pages). Padding
+    entries must point at scratch page 0 — it is garbage by contract."""
+    return {
+        "k": cache["k"].at[:, :, page_ids].set(data[0].astype(cache["k"].dtype)),
+        "v": cache["v"].at[:, :, page_ids].set(data[1].astype(cache["v"].dtype)),
+    }
+
+
+gather_pages = jax.jit(gather_pages_impl)
+scatter_pages = jax.jit(scatter_pages_impl, donate_argnums=(0,))
+
+
+# ---------------------------------------------------------------------------
 # HF weight loading
 
 _HF_LAYER_MAP = {

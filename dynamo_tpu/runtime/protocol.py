@@ -49,6 +49,36 @@ async def read_frame(reader: asyncio.StreamReader) -> dict[str, Any]:
     return json.loads(body)
 
 
+# ---------------------------------------------------------------------------
+# Two-part frames: JSON header + raw binary payload — the bulk data-plane
+# framing (KV block transfer). True TwoPartCodec parity (two_part.rs:23):
+# 4-byte header length + header + 8-byte payload length + payload.
+
+_PLEN = struct.Struct(">Q")
+MAX_PAYLOAD = 8 * 1024 * 1024 * 1024  # 8 GiB: bounded by sanity, not design
+
+
+def encode_frame2(header: dict[str, Any], payload: bytes) -> bytes:
+    body = json.dumps(header, separators=(",", ":")).encode("utf-8")
+    return _LEN.pack(len(body)) + body + _PLEN.pack(len(payload)) + payload
+
+
+async def read_frame2(
+    reader: asyncio.StreamReader,
+) -> tuple[dict[str, Any], bytes]:
+    """Read one header+payload frame; IncompleteReadError on clean EOF."""
+    head = await reader.readexactly(4)
+    (n,) = _LEN.unpack(head)
+    if n > MAX_FRAME:
+        raise ValueError(f"header too large: {n}")
+    header = json.loads(await reader.readexactly(n))
+    (pn,) = _PLEN.unpack(await reader.readexactly(8))
+    if pn > MAX_PAYLOAD:
+        raise ValueError(f"payload too large: {pn}")
+    payload = await reader.readexactly(pn) if pn else b""
+    return header, payload
+
+
 class FrameDecoder:
     """Incremental decoder for sync/byte-buffer contexts (tests, C++ parity
     checks)."""
