@@ -1,0 +1,460 @@
+"""Disaggregated prefill/decode — the framework's defining feature.
+
+Reference flow (disagg_router.rs:25-120, examples/llm/components/
+prefill_worker.py:157-211, utils/prefill_queue.py:27-49,
+docs/architecture/disagg_serving.md:74): a decode worker receiving a
+request decides — against a store-watched threshold and the global prefill
+queue depth — whether to prefill locally or enqueue a RemotePrefillRequest;
+a dedicated prefill worker dequeues it, runs the prefill forward pass, and
+writes the KV blocks directly into the decode worker's pre-allocated
+blocks; decode then continues from local KV.
+
+TPU redesign: the KV handoff rides the block-transfer plane
+(kv_transfer.py — host-staged pages over TCP, ICI-local inside a mesh) and
+lands in the decode engine's *prefix cache*: the transferred blocks are
+committed under their chained token-block hashes, so the decode engine's
+ordinary admission path (`_try_prefill` prefix match) picks them up and
+computes only the sub-page tail. That keeps the engine loop disagg-unaware
+— remote prefill is a cache warmer with completion semantics — and
+degrades gracefully: on any failure/timeout the request simply prefills
+locally.
+
+The prefill queue and done-notifications use the store's durable FIFO
+queue ops (JetStream work-queue parity).
+"""
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import threading
+import time
+from dataclasses import asdict, dataclass, field
+from typing import Any, AsyncIterator, Optional
+
+from dynamo_tpu.kv_transfer import (
+    get_descriptor,
+    write_remote_pages,
+)
+from dynamo_tpu.protocols.common import LLMEngineOutput, PreprocessedRequest
+from dynamo_tpu.runtime.client import KvClient
+from dynamo_tpu.runtime.component import DistributedRuntime
+from dynamo_tpu.tokens import TokenBlockSequence
+
+log = logging.getLogger(__name__)
+
+
+def disagg_conf_key(namespace: str) -> str:
+    return f"dynamo://{namespace}/_disagg/conf"
+
+
+def prefill_queue_name(namespace: str) -> str:
+    return f"{namespace}.prefill"
+
+
+def prefill_done_queue(namespace: str, request_id: str) -> str:
+    return f"{namespace}.prefill_done.{request_id}"
+
+
+@dataclass
+class DisaggConfig:
+    """Store-watched disagg thresholds (DisaggRouterConf,
+    disagg_router.rs:25-35)."""
+
+    max_local_prefill_length: int = 512
+    max_prefill_queue_size: int = 16
+
+    def to_json(self) -> str:
+        return json.dumps(asdict(self))
+
+    @classmethod
+    def from_json(cls, s: str) -> "DisaggConfig":
+        return cls(**json.loads(s))
+
+
+async def set_disagg_config(
+    kv: KvClient, namespace: str, conf: DisaggConfig
+) -> None:
+    await kv.put(disagg_conf_key(namespace), conf.to_json())
+
+
+class DisaggConfigWatcher:
+    """Live view of the disagg config (etcd-watched conf,
+    disagg_router.rs:38-120). Missing key -> defaults."""
+
+    def __init__(self, kv: KvClient, namespace: str,
+                 default: Optional[DisaggConfig] = None):
+        self.kv = kv
+        self.namespace = namespace
+        self.current = default or DisaggConfig()
+        self._task: Optional[asyncio.Task] = None
+
+    async def start(self) -> "DisaggConfigWatcher":
+        watch = await self.kv.watch_prefix(disagg_conf_key(self.namespace))
+        for _, v, _ in watch.initial:
+            self._apply(v)
+        self._task = asyncio.get_running_loop().create_task(self._follow(watch))
+        return self
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None
+
+    async def _follow(self, watch) -> None:
+        async for ev in watch:
+            if ev.get("event") == "put":
+                self._apply(ev.get("value"))
+
+    def _apply(self, value: Optional[str]) -> None:
+        if not value:
+            return
+        try:
+            self.current = DisaggConfig.from_json(value)
+            log.info("disagg config updated: %s", self.current)
+        except (ValueError, TypeError):
+            log.warning("bad disagg config value ignored: %r", value)
+
+
+@dataclass
+class RemotePrefillRequest:
+    """One prefill job on the queue (RemotePrefillRequest equivalent,
+    worker.py:187-196): which tokens, and which of the decode worker's
+    pages to fill (block m..n of the prompt's chained blocks)."""
+
+    request_id: str
+    token_ids: list[int]
+    salt: str                      # block-hash salt (= model name)
+    dst_worker_id: str             # blockset descriptor key on the store
+    dst_pages: list[int]           # decode-side pre-allocated page ids
+    first_block: int               # transfer covers blocks [first, first+len)
+    done_queue: str
+    # unix time after which the decode side has given up (local fallback):
+    # workers drop expired jobs instead of wasting a prefill + leaking a
+    # done-queue entry nobody will pop. 0 = never expires.
+    expires_at: float = 0.0
+
+    def to_json(self) -> str:
+        return json.dumps(asdict(self))
+
+    @classmethod
+    def from_json(cls, s: str) -> "RemotePrefillRequest":
+        return cls(**json.loads(s))
+
+
+# ---------------------------------------------------------------------------
+# Prefill worker
+
+
+class PrefillWorker:
+    """Consumes the prefill queue: prefill locally, push KV pages into the
+    decode worker's pool, notify (prefill_worker.py:157-211)."""
+
+    def __init__(
+        self,
+        rt: DistributedRuntime,
+        engine: Any,                 # TpuEngine (needs allocator+export_pages)
+        namespace: str = "dynamo",
+        poll_timeout_s: float = 1.0,
+    ):
+        self.rt = rt
+        self.engine = engine
+        self.namespace = namespace
+        self.poll_timeout_s = poll_timeout_s
+        self.jobs_handled = 0
+        self.jobs_failed = 0
+        self.jobs_expired = 0
+        # cross-host clock-skew grace before declaring a job expired
+        self.expiry_skew_s = 5.0
+        self._task: Optional[asyncio.Task] = None
+        self._stopping = False
+
+    async def start(self) -> "PrefillWorker":
+        start = getattr(self.engine, "start", None)
+        if start is not None:
+            start()
+        self._task = asyncio.get_running_loop().create_task(self._loop())
+        return self
+
+    async def stop(self) -> None:
+        self._stopping = True
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None
+
+    async def _loop(self) -> None:
+        queue = prefill_queue_name(self.namespace)
+        while not self._stopping:
+            try:
+                raw = await self.rt.kv.qpop(queue, timeout_s=self.poll_timeout_s)
+            except (ConnectionError, OSError):
+                await asyncio.sleep(0.5)
+                continue
+            if raw is None:
+                continue
+            try:
+                job = RemotePrefillRequest.from_json(raw)
+            except (ValueError, TypeError):
+                log.warning("malformed prefill job dropped: %.200r", raw)
+                continue
+            if job.expires_at and time.time() > job.expires_at + self.expiry_skew_s:
+                # the decode side already fell back locally: skip the
+                # wasted prefill and don't push to a done queue nobody pops
+                self.jobs_expired += 1
+                log.info("dropping expired prefill job %s", job.request_id)
+                continue
+            try:
+                await self._handle(job)
+                self.jobs_handled += 1
+            except Exception as e:  # noqa: BLE001 — report, keep consuming
+                self.jobs_failed += 1
+                log.exception("prefill job %s failed", job.request_id)
+                try:
+                    await self.rt.kv.qpush(job.done_queue, json.dumps(
+                        {"ok": False, "error": str(e)}
+                    ))
+                except (ConnectionError, OSError):
+                    pass
+
+    async def _handle(self, job: RemotePrefillRequest) -> None:
+        t0 = time.monotonic()
+        ps = self.engine.ecfg.page_size
+        n_blocks = job.first_block + len(job.dst_pages)
+
+        # run the prefill forward pass through the engine (one sampled token,
+        # discarded — the decode side samples its own first token after its
+        # tail prefill); this commits the prompt's complete blocks into this
+        # worker's prefix cache
+        req = PreprocessedRequest(
+            token_ids=list(job.token_ids),
+            model=job.salt,
+        )
+        req.stop_conditions.max_tokens = 1
+        req.stop_conditions.ignore_eos = True
+        async for _ in self.engine.generate(req):
+            pass
+
+        seq = TokenBlockSequence.from_tokens(job.token_ids, ps, salt=job.salt)
+        src_pages = self.engine.allocator.match_prefix(
+            seq.block_hashes()[:n_blocks]
+        )
+        try:
+            # under cache pressure some blocks may already be evicted; send
+            # the contiguous run we still have from first_block on
+            have = src_pages[job.first_block:]
+            n_send = min(len(have), len(job.dst_pages))
+            if n_send == 0:
+                raise RuntimeError("prefilled blocks evicted before export")
+            data = await asyncio.to_thread(
+                self.engine.export_pages, have[:n_send]
+            )
+        finally:
+            self.engine.allocator.free(src_pages)
+
+        desc = await get_descriptor(self.rt.kv, self.namespace,
+                                    job.dst_worker_id)
+        if desc is None:
+            raise RuntimeError(
+                f"no blockset descriptor for {job.dst_worker_id}"
+            )
+        await write_remote_pages(
+            desc.host, desc.port, job.dst_pages[:n_send], data,
+            job_id=job.request_id,
+        )
+        await self.rt.kv.qpush(job.done_queue, json.dumps({
+            "ok": True,
+            "blocks": n_send,
+            "prefill_ms": (time.monotonic() - t0) * 1e3,
+        }))
+        log.info(
+            "remote prefill %s: %d tokens, %d blocks -> %s in %.1f ms",
+            job.request_id, len(job.token_ids), n_send, job.dst_worker_id,
+            (time.monotonic() - t0) * 1e3,
+        )
+
+
+# ---------------------------------------------------------------------------
+# Decode-side wrapper
+
+
+class DisaggDecodeEngine:
+    """AsyncEngine wrapper adding the conditional-disagg decision to a
+    TpuEngine (worker.py:199-248 VllmWorker.generate decision point).
+
+    remote iff  (prompt_len − cached_prefix_tokens) > max_local_prefill_length
+            and prefill_queue_len < max_prefill_queue_size
+    (multimodal/components/disagg_router.py:48-66). On the remote path the
+    transferred blocks enter the local prefix cache before admission, so the
+    wrapped engine computes only the sub-page tail."""
+
+    def __init__(
+        self,
+        engine: Any,
+        rt: DistributedRuntime,
+        namespace: str = "dynamo",
+        worker_id: str = "",
+        conf: Optional[DisaggConfigWatcher] = None,
+        prefill_timeout_s: float = 60.0,
+    ):
+        self.engine = engine
+        self.rt = rt
+        self.namespace = namespace
+        self.worker_id = worker_id
+        self.conf = conf
+        self.prefill_timeout_s = prefill_timeout_s
+        # live remote-prefill jobs: a write for a job not in here is
+        # REJECTED — protects against a stale queued job scribbling over
+        # pages that were freed on fallback and reallocated to another
+        # request. The lock guards only set membership (never held across
+        # device I/O); a fallback racing an in-flight write defers the page
+        # free to the writer.
+        self._jobs_lock = threading.Lock()
+        self._pending_jobs: set[str] = set()
+        self._in_write: set[str] = set()
+        self._deferred_free: dict[str, list[int]] = {}
+        # counters (exposed via metrics/tests)
+        self.remote_prefills = 0
+        self.local_prefills = 0
+        self.remote_fallbacks = 0
+
+    # engine delegation so register_llm/serve_engine treat us as the engine
+    @property
+    def allocator(self):
+        return self.engine.allocator
+
+    def start(self) -> None:
+        start = getattr(self.engine, "start", None)
+        if start is not None:
+            start()
+
+    async def stop(self) -> None:
+        await self.engine.stop()
+
+    def metrics(self):
+        return self.engine.metrics()
+
+    def guarded_import(self, pages, data, job_id=None) -> None:
+        """Transfer-server write hook: scatter only while the job is still
+        pending (write_fn contract in kv_transfer.py). The scatter runs
+        OUTSIDE the jobs lock — holding it across device I/O would stall
+        the event loop's own lock acquisitions for the whole transfer."""
+        if job_id is None:
+            self.engine.import_pages(pages, data)
+            return
+        with self._jobs_lock:
+            if job_id not in self._pending_jobs:
+                raise RuntimeError(f"job {job_id} cancelled; write rejected")
+            self._in_write.add(job_id)
+        try:
+            self.engine.import_pages(pages, data)
+        finally:
+            with self._jobs_lock:
+                self._in_write.discard(job_id)
+                late_free = self._deferred_free.pop(job_id, None)
+            if late_free is not None:
+                # fallback cancelled mid-write: the write landed in pages
+                # still held for this job; release them now (uncommitted ->
+                # straight back to the free list)
+                self.engine.allocator.free(late_free)
+
+    async def generate(
+        self, request: PreprocessedRequest
+    ) -> AsyncIterator[LLMEngineOutput]:
+        if await self._maybe_remote_prefill(request):
+            self.remote_prefills += 1
+        else:
+            self.local_prefills += 1
+        async for out in self.engine.generate(request):
+            yield out
+
+    async def _should_remote(self, request: PreprocessedRequest,
+                             n_cached_blocks: int) -> bool:
+        conf = self.conf.current if self.conf else DisaggConfig()
+        ps = self.engine.ecfg.page_size
+        effective = len(request.token_ids) - n_cached_blocks * ps
+        if effective <= conf.max_local_prefill_length:
+            return False
+        try:
+            qlen = await self.rt.kv.qlen(prefill_queue_name(self.namespace))
+        except (ConnectionError, OSError):
+            return False
+        return qlen < conf.max_prefill_queue_size
+
+    async def _maybe_remote_prefill(self, request: PreprocessedRequest) -> bool:
+        """Try the remote path; True if the prefix cache was warmed
+        remotely. Any failure falls back to local prefill."""
+        alloc = self.engine.allocator
+        ps = self.engine.ecfg.page_size
+        tokens = request.token_ids
+        n_blocks = max(0, (len(tokens) - 1) // ps)
+        if n_blocks == 0:
+            return False
+        seq = TokenBlockSequence.from_tokens(tokens, ps, salt=request.model)
+        hashes = seq.block_hashes()[:n_blocks]
+
+        # blocks already cached locally need no transfer (stat-neutral peek
+        # — the engine's admission match does the counted lookup)
+        m = alloc.cached_prefix_len(hashes)
+        if not await self._should_remote(request, m):
+            return False
+        if m >= n_blocks:
+            return False
+
+        dst = alloc.allocate(n_blocks - m)
+        if dst is None:
+            return False  # no room: let admission/preemption deal with it
+        rid = request.request_id
+        done_q = prefill_done_queue(self.namespace, rid)
+        job = RemotePrefillRequest(
+            request_id=rid,
+            token_ids=list(tokens),
+            salt=request.model,
+            dst_worker_id=self.worker_id,
+            dst_pages=dst,
+            first_block=m,
+            done_queue=done_q,
+            expires_at=time.time() + self.prefill_timeout_s,
+        )
+        with self._jobs_lock:
+            self._pending_jobs.add(rid)
+        settled = False  # success path freed/committed dst itself
+        try:
+            await self.rt.kv.qpush(prefill_queue_name(self.namespace),
+                                   job.to_json())
+            raw = await self.rt.kv.qpop(
+                done_q, timeout_s=self.prefill_timeout_s
+            )
+            resp = json.loads(raw) if raw else None
+            if not resp or not resp.get("ok"):
+                raise RuntimeError(
+                    (resp or {}).get("error", "remote prefill timed out")
+                )
+            n_got = int(resp.get("blocks", 0))
+            with self._jobs_lock:
+                self._pending_jobs.discard(rid)
+            # commit the transferred blocks under their chained hashes; the
+            # engine's admission prefix-match picks them up
+            committed = []
+            for pg, blk in zip(dst[:n_got], seq.blocks[m:m + n_got]):
+                if alloc.commit(pg, blk.block_hash, blk.parent_hash):
+                    committed.append(pg)
+            alloc.free(dst)  # committed pages park in LRU; rest return free
+            settled = True
+            return bool(committed)
+        except Exception:  # noqa: BLE001 — disagg is best-effort
+            self.remote_fallbacks += 1
+            log.exception("remote prefill failed for %s; local fallback", rid)
+            return False
+        finally:
+            if not settled:
+                # runs for BOTH the except path and CancelledError (client
+                # dropped while awaiting the done queue): cancel the job and
+                # release its pages exactly once. If a guarded write is in
+                # flight, the writer frees them after its scatter.
+                with self._jobs_lock:
+                    self._pending_jobs.discard(rid)
+                    if rid in self._in_write:
+                        self._deferred_free[rid] = dst
+                        dst = None
+                if dst is not None:
+                    alloc.free(dst)

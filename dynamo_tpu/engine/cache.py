@@ -17,6 +17,7 @@ hash registry block/registry.rs:490) and KvEventPublisher (publisher.rs:99).
 """
 from __future__ import annotations
 
+import threading
 from collections import OrderedDict, deque
 from dataclasses import dataclass
 from typing import Callable, Optional
@@ -34,8 +35,10 @@ class PageRecord:
 
 
 class PageAllocator:
-    """Allocates/reuses device pages. Not thread-safe; the engine scheduler
-    owns it from a single loop."""
+    """Allocates/reuses device pages. Thread-safe: the engine scheduler is
+    the main user, but the disagg decode path (asyncio thread) allocates
+    and commits remote-prefilled pages concurrently — a single lock covers
+    every public mutation."""
 
     def __init__(
         self,
@@ -53,6 +56,7 @@ class PageAllocator:
         self.on_event = on_event
         self.enable_prefix_caching = enable_prefix_caching
 
+        self._lock = threading.RLock()
         self._free: deque[int] = deque(range(1, num_pages))
         self._registry: dict[int, PageRecord] = {}   # block_hash -> record
         self._page_hash: dict[int, int] = {}         # page -> committed hash
@@ -92,27 +96,43 @@ class PageAllocator:
         pages: list[int] = []
         if not self.enable_prefix_caching:
             return pages
-        self.lookup_blocks += len(block_hashes)
-        for h in block_hashes:
-            rec = self._registry.get(h)
-            if rec is None:
-                break
-            self._ref_page(rec.page, h)
-            pages.append(rec.page)
-        self.hit_blocks += len(pages)
-        return pages
+        with self._lock:
+            self.lookup_blocks += len(block_hashes)
+            for h in block_hashes:
+                rec = self._registry.get(h)
+                if rec is None:
+                    break
+                self._ref_page(rec.page, h)
+                pages.append(rec.page)
+            self.hit_blocks += len(pages)
+            return pages
+
+    def cached_prefix_len(self, block_hashes: list[int]) -> int:
+        """How many leading blocks are cached, WITHOUT taking references or
+        touching hit-rate counters — a stat-neutral peek for routing/disagg
+        decisions."""
+        if not self.enable_prefix_caching:
+            return 0
+        with self._lock:
+            n = 0
+            for h in block_hashes:
+                if h not in self._registry:
+                    break
+                n += 1
+            return n
 
     def allocate(self, n: int) -> Optional[list[int]]:
         """n fresh pages (refcount 1 each), evicting LRU-parked committed
         pages if needed. None if not satisfiable (caller queues/preempts)."""
-        if n > self.available_pages:
-            return None
-        while len(self._free) < n:
-            self._evict_one()
-        pages = [self._free.popleft() for _ in range(n)]
-        for p in pages:
-            self._ref[p] = 1
-        return pages
+        with self._lock:
+            if n > self.available_pages:
+                return None
+            while len(self._free) < n:
+                self._evict_one()
+            pages = [self._free.popleft() for _ in range(n)]
+            for p in pages:
+                self._ref[p] = 1
+            return pages
 
     def commit(self, page: int, block_hash: int, parent_hash: int) -> bool:
         """Mark `page` as holding the sealed block `block_hash` (chained on
@@ -120,45 +140,48 @@ class PageAllocator:
         duplicate hash (page stays private to its request)."""
         if not self.enable_prefix_caching:
             return False
-        if block_hash in self._registry:
-            return False
-        self._registry[block_hash] = PageRecord(page, block_hash, parent_hash)
-        self._page_hash[page] = block_hash
-        self._emit(
-            KvCacheEvent(
-                kind=KvEventKind.STORED,
-                parent_hash=parent_hash,
-                blocks=[StoredBlock(block_hash=block_hash)],
+        with self._lock:
+            if block_hash in self._registry:
+                return False
+            self._registry[block_hash] = PageRecord(page, block_hash, parent_hash)
+            self._page_hash[page] = block_hash
+            self._emit(
+                KvCacheEvent(
+                    kind=KvEventKind.STORED,
+                    parent_hash=parent_hash,
+                    blocks=[StoredBlock(block_hash=block_hash)],
+                )
             )
-        )
-        return True
+            return True
 
     def free(self, pages: list[int]) -> None:
         """Release one reference on each page. Unreferenced committed pages
         park in the LRU (still prefix-hittable); uncommitted ones return to
         the free list."""
-        for p in pages:
-            r = self._ref.get(p, 0) - 1
-            if r > 0:
-                self._ref[p] = r
-                continue
-            self._ref.pop(p, None)
-            h = self._page_hash.get(p)
-            if h is not None:
-                self._lru[h] = None
-                self._lru.move_to_end(h)
-            else:
-                self._free.append(p)
+        with self._lock:
+            for p in pages:
+                r = self._ref.get(p, 0) - 1
+                if r > 0:
+                    self._ref[p] = r
+                    continue
+                self._ref.pop(p, None)
+                h = self._page_hash.get(p)
+                if h is not None:
+                    self._lru[h] = None
+                    self._lru.move_to_end(h)
+                else:
+                    self._free.append(p)
 
     def clear(self) -> int:
         """Drop all reusable cached pages (the /clear_kv_blocks operation,
         reference http/service/clear_kv_blocks.rs). In-use pages survive.
         Returns number of pages cleared."""
-        n = len(self._lru)
-        while self._lru:
-            self._evict_one()
-        self._emit(KvCacheEvent(kind=KvEventKind.CLEARED))
-        return n
+        with self._lock:
+            n = len(self._lru)
+            while self._lru:
+                self._evict_one()
+            self._emit(KvCacheEvent(kind=KvEventKind.CLEARED))
+            return n
 
     # ---- internals ----
 

@@ -311,6 +311,8 @@ class TpuEngine:
         self._stop.set()
         if self._thread:
             await asyncio.to_thread(self._thread.join, 30.0)
+        # items raced in after the loop's own exit drain
+        self._drain_xfer_queue()
 
     # ------------------------------------------------------------------
     # AsyncEngine surface
@@ -371,8 +373,21 @@ class TpuEngine:
         done = threading.Event()
         box: dict[str, Any] = {}
         self._xfer.put((kind, list(page_ids), data, done, box))
-        if not done.wait(timeout=120.0):
-            raise TimeoutError(f"page {kind} timed out")
+        # wait in slices. On stop, the loop-exit drain (or stop()'s final
+        # drain) errors still-queued items; an in-flight op completes and
+        # reports its real result — we only bound the wait, never clobber
+        # the box ourselves (that would misreport a completed transfer).
+        deadline = time.monotonic() + 120.0
+        stop_grace: Optional[float] = None
+        while not done.wait(timeout=1.0):
+            now = time.monotonic()
+            if self._stop.is_set():
+                if stop_grace is None:
+                    stop_grace = now + 10.0
+                elif now > stop_grace:
+                    raise RuntimeError(f"engine stopped during page {kind}")
+            elif now > deadline:
+                raise TimeoutError(f"page {kind} timed out")
         if "error" in box:
             raise box["error"]
         return box.get("result")
@@ -440,7 +455,12 @@ class TpuEngine:
                     self._waiting.append(self._intake.get(timeout=0.02))
                 except queue_mod.Empty:
                     pass
-        # abandon queued transfer ops with an error, not a 120s stall
+        self._drain_xfer_queue()
+
+    def _drain_xfer_queue(self) -> None:
+        """Abandon queued transfer ops with an error, not a 120s stall.
+        Only touches items still IN the queue — an in-flight op finishes
+        normally and reports its real result."""
         while True:
             try:
                 *_ignored, done, box = self._xfer.get_nowait()

@@ -93,7 +93,11 @@ class HttpService:
         host: str = "0.0.0.0",
         port: int = 8080,
     ):
-        self.manager = manager or ModelManager()
+        # `is not None`, NOT truthiness: an EMPTY manager (len 0 -> falsy)
+        # must be kept — discovery registers models into it later; replacing
+        # it would silently split the watcher and the HTTP handlers onto
+        # two different registries
+        self.manager = manager if manager is not None else ModelManager()
         self.host = host
         self.port = port
         self.metrics = ServiceMetrics()

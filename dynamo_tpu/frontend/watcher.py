@@ -82,7 +82,8 @@ async def register_llm(
         entry.endpoint
     )
     served = await serve_engine(
-        ep, engine, worker_id=worker_id or entry.name, lease_ttl_s=lease_ttl_s
+        ep, engine, worker_id=worker_id or entry.name, lease_ttl_s=lease_ttl_s,
+        metadata={"model": entry.name},
     )
     key = model_key(entry.namespace, entry.name) + f"/{served.lease_id}"
     await rt.kv.put(key, entry.to_json(), lease=served.lease_id)
@@ -178,13 +179,23 @@ class ModelWatcher:
         client = await self.rt.namespace(entry.namespace).component(
             entry.component
         ).endpoint(entry.endpoint).client()
+        log.debug("model %s: endpoint client up (%d instances)",
+                  name, len(client.instances))
 
         if entry.router_mode == "kv":
             router = KvRouter(entry.block_size, self.router_config)
             push = KvPushRouter(router)
             self._routers[name] = push
 
-            def sync_workers(instances: list[Instance], push=push, client=client):
+            def sync_workers(instances: list[Instance], push=push,
+                             client=client, name=name):
+                # instances carry their model in metadata: two models sharing
+                # a component must not route into each other's workers
+                # (legacy instances without the tag serve any model)
+                instances = [
+                    i for i in instances
+                    if i.metadata.get("model", name) == name
+                ]
                 current = {str(i.id) for i in instances}
                 for wid in list(push.workers):
                     if wid not in current:
@@ -200,6 +211,9 @@ class ModelWatcher:
             sync_workers(list(client.instances.values()))
             engine: Any = push
         else:
+            client.instance_filter = (
+                lambda inst, name=name: inst.metadata.get("model", name) == name
+            )
             engine = RemoteEngine(
                 client,
                 mode="random" if entry.router_mode == "random" else "round_robin",
@@ -215,6 +229,7 @@ class ModelWatcher:
 
             tok = make_test_tokenizer()
             fmt = PromptFormatter()
+        log.debug("model %s: tokenizer ready", name)
         chain = ModelChain(
             name=name,
             preprocessor=OpenAIPreprocessor(
@@ -228,6 +243,8 @@ class ModelWatcher:
         )
         self._chains[name] = (chain, client)
         self.manager.register(chain)
+        log.debug("model %s: registered (%d models, manager id %x)",
+                  name, len(self.manager), id(self.manager))
 
     async def _remove_model(self, name: str) -> None:
         log.info("model %s removed (last instance gone)", name)

@@ -34,7 +34,11 @@ import ml_dtypes  # noqa: F401 — registers bfloat16 with np.dtype
 import numpy as np
 
 from dynamo_tpu.runtime.client import KvClient
-from dynamo_tpu.runtime.protocol import encode_frame2, read_frame2
+from dynamo_tpu.runtime.protocol import (
+    encode_frame2,
+    encode_frame2_header,
+    read_frame2,
+)
 
 log = logging.getLogger(__name__)
 
@@ -48,11 +52,7 @@ def _write_array_frame(
     2x peak host memory per hop)."""
     data = np.ascontiguousarray(data)
     payload = data.view(np.uint8).reshape(-1)
-    import struct
-
-    body = json.dumps(header, separators=(",", ":")).encode()
-    writer.write(struct.pack(">I", len(body)) + body
-                 + struct.pack(">Q", payload.nbytes))
+    writer.write(encode_frame2_header(header, payload.nbytes))
     writer.write(memoryview(payload))
 
 KV_META_PREFIX = "_kvmeta/"
@@ -118,9 +118,11 @@ async def get_descriptor(
 # Data-plane server
 
 # read_fn(page_ids) -> np.ndarray [2, L, kvh, n, ps, hd]
-# write_fn(page_ids, data) -> None
+# write_fn(page_ids, data) -> None — or (page_ids, data, job_id) when the
+# writer tags frames with a job id (disagg guarded writes: the owner
+# validates the job is still live before scattering)
 ReadFn = Callable[[list[int]], np.ndarray]
-WriteFn = Callable[[list[int], np.ndarray], None]
+WriteFn = Callable[..., None]
 
 
 class BlockTransferServer:
@@ -172,8 +174,11 @@ class BlockTransferServer:
                         data = np.frombuffer(
                             payload, dtype=np.dtype(header["dtype"])
                         ).reshape(header["shape"])
+                        args = (pages, data)
+                        if header.get("job") is not None:
+                            args = (pages, data, header["job"])
                         await loop.run_in_executor(
-                            None, self.write_fn, pages, data
+                            None, self.write_fn, *args
                         )
                         writer.write(encode_frame2({"ok": True}, b""))
                     elif op == "read_pages":
@@ -215,18 +220,20 @@ class BlockTransferError(RuntimeError):
 
 
 async def write_remote_pages(
-    host: str, port: int, pages: list[int], data: np.ndarray
+    host: str, port: int, pages: list[int], data: np.ndarray,
+    job_id: Optional[str] = None,
 ) -> None:
     """One-sided write: push pages into a peer's pool (NIXL-write path —
-    prefill pushing computed KV into decode's pre-allocated pages)."""
+    prefill pushing computed KV into decode's pre-allocated pages).
+    `job_id` tags the frame so the receiver can reject writes for a job it
+    has since cancelled (stale-queue protection)."""
     reader, writer = await asyncio.open_connection(host, port)
     try:
-        _write_array_frame(
-            writer,
-            {"op": "write_pages", "pages": [int(p) for p in pages],
-             "shape": list(data.shape), "dtype": data.dtype.name},
-            data,
-        )
+        header = {"op": "write_pages", "pages": [int(p) for p in pages],
+                  "shape": list(data.shape), "dtype": data.dtype.name}
+        if job_id is not None:
+            header["job"] = job_id
+        _write_array_frame(writer, header, data)
         await writer.drain()
         header, _ = await read_frame2(reader)
         if not header.get("ok"):

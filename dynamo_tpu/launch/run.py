@@ -52,6 +52,17 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--endpoint-name", default="generate")
     p.add_argument("--router-mode", default="kv",
                    choices=["kv", "round_robin", "random"])
+    # disaggregated prefill/decode (reference flags.rs + disagg_router.rs)
+    p.add_argument("--role", default="aggregated",
+                   choices=["aggregated", "decode", "prefill"],
+                   help="worker role for disaggregated serving")
+    p.add_argument("--max-local-prefill-length", type=int, default=None,
+                   help="prompts with more uncached tokens go to the "
+                        "prefill queue (writes the store-watched conf)")
+    p.add_argument("--max-prefill-queue-size", type=int, default=None)
+    p.add_argument("--prefill-timeout", type=float, default=60.0,
+                   help="decode-side wait for remote prefill before local "
+                        "fallback")
     return p
 
 
@@ -204,12 +215,48 @@ def _cp_addr(args) -> tuple[str, int]:
 
 async def _serve_worker(args, chain) -> None:
     """in=endpoint: register the engine on the runtime and serve forever
-    (reference Input::Endpoint, entrypoint/input.rs:43)."""
+    (reference Input::Endpoint, entrypoint/input.rs:43). --role decode adds
+    the disagg wrapper + block-transfer data plane."""
     from dynamo_tpu.frontend.watcher import ModelEntry, register_llm
     from dynamo_tpu.runtime.component import DistributedRuntime
 
     host, port = _cp_addr(args)
     rt = await DistributedRuntime.connect(host=host, port=port)
+
+    engine = chain.engine
+    disagg_parts = []
+    if args.role == "decode":
+        import uuid
+
+        from dynamo_tpu.disagg import (
+            DisaggConfig,
+            DisaggConfigWatcher,
+            DisaggDecodeEngine,
+            set_disagg_config,
+        )
+
+        if (args.max_local_prefill_length is not None
+                or args.max_prefill_queue_size is not None):
+            conf = DisaggConfig()
+            if args.max_local_prefill_length is not None:
+                conf.max_local_prefill_length = args.max_local_prefill_length
+            if args.max_prefill_queue_size is not None:
+                conf.max_prefill_queue_size = args.max_prefill_queue_size
+            await set_disagg_config(rt.kv, args.namespace, conf)
+        watcher = await DisaggConfigWatcher(rt.kv, args.namespace).start()
+        engine = DisaggDecodeEngine(
+            engine, rt, namespace=args.namespace, conf=watcher,
+            prefill_timeout_s=args.prefill_timeout,
+        )
+        disagg_parts.append(watcher)
+        # data plane + descriptor up BEFORE the endpoint serves: a request
+        # landing in between would enqueue an unroutable prefill job (the
+        # descriptor key is a fresh uuid, independent of the lease)
+        served_xfer = await _attach_data_plane(
+            args, rt, engine, uuid.uuid4().hex
+        )
+        disagg_parts.append(served_xfer)
+
     entry = ModelEntry(
         name=chain.name,
         namespace=args.namespace,
@@ -219,16 +266,69 @@ async def _serve_worker(args, chain) -> None:
         router_mode=args.router_mode,
         model_path=args.model_path,
     )
-    served = await register_llm(rt, chain.engine, entry)
+    served = await register_llm(rt, engine, entry)
     print(
-        f"worker {chain.name!r} instance {served.lease_id} serving "
+        f"worker {chain.name!r} instance {served.lease_id} "
+        f"({args.role}) serving "
         f"{args.namespace}/{args.component}/{args.endpoint_name}"
     )
     try:
         await served.lease.lost.wait()  # run until the control plane drops us
         print("lease lost; shutting down")
     finally:
+        for part in disagg_parts:
+            await part.stop()
         await served.shutdown()
+
+
+async def _attach_data_plane(args, rt, engine, worker_id: str):
+    """Serve the engine's KV pool on the block-transfer plane + publish the
+    blockset descriptor (lease-less: rides the registration lease via the
+    same worker id)."""
+    from dynamo_tpu.kv_transfer import (
+        BlocksetDescriptor,
+        BlockTransferServer,
+        KvCacheLayout,
+        publish_descriptor,
+    )
+
+    inner = getattr(engine, "engine", engine)
+    engine.worker_id = worker_id
+    write_fn = getattr(engine, "guarded_import", None) or inner.import_pages
+    srv = BlockTransferServer(
+        read_fn=inner.export_pages, write_fn=write_fn
+    )
+    host, port = await srv.start()
+    cfg, ecfg = inner.config, inner.ecfg
+    await publish_descriptor(rt.kv, args.namespace, BlocksetDescriptor(
+        worker_id=worker_id, host=host, port=port,
+        layout=KvCacheLayout(
+            num_layers=cfg.num_layers, num_kv_heads=cfg.num_kv_heads,
+            page_size=ecfg.page_size, head_dim=cfg.head_dim,
+            dtype=ecfg.cache_dtype,
+        ),
+    ))
+    return srv
+
+
+async def _serve_prefill_worker(args, chain) -> None:
+    """--role prefill: consume the prefill queue; no model registration
+    (reference prefill_worker.py)."""
+    from dynamo_tpu.disagg import PrefillWorker
+    from dynamo_tpu.runtime.component import DistributedRuntime
+
+    host, port = _cp_addr(args)
+    rt = await DistributedRuntime.connect(host=host, port=port)
+    worker = await PrefillWorker(
+        rt, chain.engine, namespace=args.namespace
+    ).start()
+    print(f"prefill worker consuming {args.namespace}.prefill")
+    try:
+        while True:
+            await asyncio.sleep(3600)
+    finally:
+        await worker.stop()
+        await rt.close()
 
 
 async def _serve_http_dynamic(args) -> None:
@@ -268,7 +368,10 @@ def run_cli(argv: list[str]) -> int:
             if not args.control_plane:
                 raise SystemExit("in=endpoint requires --control-plane")
             _, chain = build_chain(args)
-            asyncio.run(_serve_worker(args, chain))
+            if args.role == "prefill":
+                asyncio.run(_serve_prefill_worker(args, chain))
+            else:
+                asyncio.run(_serve_worker(args, chain))
             return 0
         inp, chain = build_chain(args)
         engine_start = getattr(chain.engine, "start", None)

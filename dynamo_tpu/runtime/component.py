@@ -89,6 +89,9 @@ class EndpointClient:
         self._rr = itertools.count()
         self._watch_task: Optional[asyncio.Task] = None
         self.on_change: Optional[Any] = None  # callback(list[Instance])
+        # optional predicate restricting routing to a subset of instances
+        # (e.g. only workers serving a given model)
+        self.instance_filter: Optional[Any] = None  # callback(Instance)->bool
 
     async def start(self) -> "EndpointClient":
         watch = await self.kv.watch_prefix(self.prefix)
@@ -145,16 +148,20 @@ class EndpointClient:
     # ---- routing (push_router.rs modes) ----
 
     def _pick(self, mode: str, instance_id: Optional[int]) -> Instance:
-        if not self.instances:
+        pool = self.instances
+        if self.instance_filter is not None:
+            pool = {i: inst for i, inst in pool.items()
+                    if self.instance_filter(inst)}
+        if not pool:
             raise ConnectionError(f"no instances for {self.prefix}")
         if mode == "direct":
             if instance_id not in self.instances:
                 raise ConnectionError(f"instance {instance_id} not found")
             return self.instances[instance_id]
-        ids = sorted(self.instances)
+        ids = sorted(pool)
         if mode == "random":
-            return self.instances[random.choice(ids)]
-        return self.instances[ids[next(self._rr) % len(ids)]]
+            return pool[random.choice(ids)]
+        return pool[ids[next(self._rr) % len(ids)]]
 
     async def generate(
         self,

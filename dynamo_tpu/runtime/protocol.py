@@ -59,8 +59,14 @@ MAX_PAYLOAD = 8 * 1024 * 1024 * 1024  # 8 GiB: bounded by sanity, not design
 
 
 def encode_frame2(header: dict[str, Any], payload: bytes) -> bytes:
+    return encode_frame2_header(header, len(payload)) + payload
+
+
+def encode_frame2_header(header: dict[str, Any], payload_nbytes: int) -> bytes:
+    """Prefix (lengths + header) alone — callers streaming a large payload
+    write this, then the payload buffer, avoiding a full-payload copy."""
     body = json.dumps(header, separators=(",", ":")).encode("utf-8")
-    return _LEN.pack(len(body)) + body + _PLEN.pack(len(payload)) + payload
+    return _LEN.pack(len(body)) + body + _PLEN.pack(payload_nbytes)
 
 
 async def read_frame2(

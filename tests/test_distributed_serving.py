@@ -157,3 +157,49 @@ async def test_kv_events_flow_to_router_and_concentrate():
         assert max(hits.values()) == 6, hits
     finally:
         await teardown(server, workers, frontend_rt, watcher, client)
+
+
+async def test_models_sharing_component_do_not_cross_route():
+    """Two models registered on the SAME component/endpoint must each route
+    only to their own workers (instances are tagged with their model)."""
+    server, store = await serve_store(port=0, sweep_interval_s=0.05)
+    port = server.sockets[0].getsockname()[1]
+
+    engines = {}
+    workers = []
+    for name in ("ma", "mb"):
+        rt = await DistributedRuntime.connect(port=port)
+        eng = MockerEngine(
+            MockerArgs(speedup_ratio=100.0, page_size=BS, num_pages=64)
+        )
+        engines[name] = eng
+        entry = ModelEntry(name=name, namespace="test", component="backend",
+                           block_size=BS, router_mode="kv")
+        served = await register_llm(rt, eng, entry, lease_ttl_s=0.4)
+        workers.append((rt, eng, served))
+
+    frontend_rt = await DistributedRuntime.connect(port=port)
+    manager = ModelManager()
+    watcher = await ModelWatcher(frontend_rt, manager, namespace="test").start()
+    svc = HttpService(manager)
+    client = TestClient(TestServer(svc.app))
+    await client.start_server()
+    try:
+        for _ in range(100):
+            if len(manager) == 2:
+                break
+            await asyncio.sleep(0.02)
+        assert manager.list_models() == ["ma", "mb"]
+        for name in ("ma", "mb"):
+            r = await client.post(
+                "/v1/chat/completions",
+                json={"model": name,
+                      "messages": [{"role": "user", "content": "w1 w2 w3"}],
+                      "max_tokens": 4},
+            )
+            assert r.status == 200
+        # each mocker served exactly its own model's request
+        assert engines["ma"].tokens_generated == 4
+        assert engines["mb"].tokens_generated == 4
+    finally:
+        await teardown(server, workers, frontend_rt, watcher, client)

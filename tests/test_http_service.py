@@ -260,3 +260,32 @@ async def test_tpu_engine_unary_deterministic(tpu_service):
     r2 = await (await client.post("/v1/chat/completions", json=body)).json()
     assert r1["choices"][0]["message"]["content"] == r2["choices"][0]["message"]["content"]
     await client.close()
+
+
+async def test_service_keeps_empty_manager():
+    """Regression: an EMPTY ModelManager is falsy (len 0); HttpService must
+    keep it rather than replacing it with a private clone — dynamic
+    discovery registers models into the original AFTER service start."""
+    from dynamo_tpu.frontend.model_manager import ModelManager
+    from dynamo_tpu.frontend.service import HttpService
+
+    manager = ModelManager()
+    svc = HttpService(manager)          # constructed while still empty
+    assert svc.manager is manager
+    client = TestClient(TestServer(svc.app))
+    await client.start_server()
+    r = await client.get("/v1/models")
+    assert (await r.json())["data"] == []
+    # late discovery: register into the ORIGINAL manager; service must see it
+    tok = make_test_tokenizer(WORDS)
+    manager.register(ModelChain(
+        name="echo",
+        preprocessor=OpenAIPreprocessor(
+            tokenizer=tok, formatter=PromptFormatter(), model_name="echo"
+        ),
+        engine=EchoEngine(delay_s=0.0),
+        backend=Backend(tok),
+    ))
+    r = await client.get("/v1/models")
+    assert [m["id"] for m in (await r.json())["data"]] == ["echo"]
+    await client.close()
