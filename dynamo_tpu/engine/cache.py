@@ -55,6 +55,11 @@ class PageAllocator:
         self.worker_id = worker_id
         self.on_event = on_event
         self.enable_prefix_caching = enable_prefix_caching
+        # offload hook: called (page, block_hash, parent_hash) when a
+        # committed page parks in the LRU — the engine queues it as a G2
+        # offload candidate. Called under the allocator lock: must be cheap
+        # and non-blocking.
+        self.on_park: Optional[Callable[[int, int, int], None]] = None
 
         self._lock = threading.RLock()
         self._free: deque[int] = deque(range(1, num_pages))
@@ -106,6 +111,13 @@ class PageAllocator:
                 pages.append(rec.page)
             self.hit_blocks += len(pages)
             return pages
+
+    def page_for_hash(self, block_hash: int) -> Optional[int]:
+        """Which page currently holds this committed block (None if
+        evicted) — offload-candidate validation."""
+        with self._lock:
+            rec = self._registry.get(block_hash)
+            return None if rec is None else rec.page
 
     def cached_prefix_len(self, block_hashes: list[int]) -> int:
         """How many leading blocks are cached, WITHOUT taking references or
@@ -169,6 +181,8 @@ class PageAllocator:
                 if h is not None:
                     self._lru[h] = None
                     self._lru.move_to_end(h)
+                    if self.on_park is not None:
+                        self.on_park(p, h, self._registry[h].parent_hash)
                 else:
                     self._free.append(p)
 

@@ -37,6 +37,14 @@ class EngineConfig:
     # prefix cache
     enable_prefix_caching: bool = True
 
+    # host-DRAM offload tier (KVBM G2): 0 disables. Pages parked in the
+    # LRU are asynchronously copied to a host pool of this many pages;
+    # prefix misses in HBM onboard from it instead of recomputing.
+    host_offload_pages: int = 0
+    # offload dispatch cap per scheduling round (bounds the per-round
+    # gather size; pow2-bucketed for compile-cache reuse)
+    offload_batch: int = 8
+
     # model memory
     cache_dtype: str = "bfloat16"
 

@@ -37,6 +37,7 @@ import asyncio
 import functools
 import logging
 import os
+from collections import deque
 import queue as queue_mod
 import threading
 import time
@@ -125,6 +126,9 @@ class _Entry:
     n_steps: int = 0
     # first:
     request: Optional[_Request] = None
+    # offload: hashes/parents aligned with the gathered pages
+    hashes: list[int] = field(default_factory=list)
+    parents: list[int] = field(default_factory=list)
 
 
 class TpuEngine:
@@ -172,6 +176,24 @@ class TpuEngine:
             on_event=on_kv_event,
             enable_prefix_caching=e.enable_prefix_caching,
         )
+        # host-DRAM offload tier (KVBM G2): parked pages are batch-gathered
+        # once per round and fetched to host behind compute. A deque:
+        # on_park appends from BOTH the engine loop and the disagg asyncio
+        # thread; the dispatcher drains with popleft (both thread-safe),
+        # never a swap that could drop a concurrent append.
+        self.offload = None
+        self._offload_cands: deque = deque()
+        if e.host_offload_pages > 0:
+            from dynamo_tpu.engine.offload import HostOffloadTier
+
+            self.offload = HostOffloadTier(
+                e.host_offload_pages,
+                (2, c.num_layers, c.num_kv_heads, e.page_size, c.head_dim),
+                cache_dtype,
+            )
+            self.allocator.on_park = (
+                lambda p, h, par: self._offload_cands.append((p, h, par))
+            )
 
         B = e.max_decode_slots
         self._B = B
@@ -352,6 +374,36 @@ class TpuEngine:
             r.cancelled = True
 
     # ------------------------------------------------------------------
+    # padded page I/O (shared by transfers, offload, onboard): page lists
+    # are pow2-bucketed for compile-cache reuse; padding targets scratch
+    # page 0 (garbage by contract)
+
+    def _gather_padded(self, pages: list[int]):
+        """Device gather of whole pages; returns the DEVICE array
+        [2, L, kvh, pow2(n), ps, hd] — callers slice [:len(pages)] on the
+        page axis after fetching."""
+        w = pow2_cover(len(pages))
+        padded = np.zeros(w, np.int32)
+        padded[: len(pages)] = pages
+        return llama.gather_pages(self.cache, jnp.asarray(padded))
+
+    def _scatter_padded(self, pages: list[int], data: np.ndarray) -> None:
+        """Scatter host pages [2, L, kvh, n, ps, hd] into the pool."""
+        n = len(pages)
+        w = pow2_cover(n)
+        padded = np.zeros(w, np.int32)
+        padded[:n] = pages
+        if w > n:
+            pad_shape = list(data.shape)
+            pad_shape[3] = w - n
+            data = np.concatenate(
+                [data, np.zeros(pad_shape, data.dtype)], axis=3
+            )
+        self.cache = llama.scatter_pages(
+            self.cache, jnp.asarray(padded), jnp.asarray(data)
+        )
+
+    # ------------------------------------------------------------------
     # KV page export/import (block-transfer data plane hooks;
     # kv_transfer.py BlockTransferServer read_fn/write_fn)
 
@@ -399,23 +451,11 @@ class TpuEngine:
             except queue_mod.Empty:
                 return
             try:
-                n = len(ids)
-                # pow2 bucket (pad with scratch page 0) to bound recompiles
-                w = pow2_cover(n)
-                padded = np.zeros(w, np.int32)
-                padded[:n] = ids
                 if kind == "export":
-                    out = llama.gather_pages(self.cache, jnp.asarray(padded))
-                    box["result"] = np.asarray(out)[:, :, :, :n]
+                    out = self._gather_padded(ids)
+                    box["result"] = np.asarray(out)[:, :, :, : len(ids)]
                 else:
-                    pad_shape = list(data.shape)
-                    pad_shape[3] = w - n
-                    full = np.concatenate(
-                        [data, np.zeros(pad_shape, data.dtype)], axis=3
-                    ) if w > n else data
-                    self.cache = llama.scatter_pages(
-                        self.cache, jnp.asarray(padded), jnp.asarray(full)
-                    )
+                    self._scatter_padded(ids, data)
                     box["result"] = None
             except Exception as e:  # noqa: BLE001 — surface to the caller
                 box["error"] = e
@@ -436,6 +476,13 @@ class TpuEngine:
                 kv_total_blocks=a.total_pages,
                 gpu_cache_usage_perc=a.usage(),
                 gpu_prefix_cache_hit_rate=a.hit_rate(),
+                host_blocks=len(self.offload) if self.offload else 0,
+                host_total_blocks=(
+                    self.offload.num_pages if self.offload else 0
+                ),
+                host_onboard_hits=(
+                    self.offload.onboard_hits if self.offload else 0
+                ),
             ),
         )
 
@@ -478,6 +525,7 @@ class TpuEngine:
         self._process_entries(block=rounds_in_flight > e.max_inflight_rounds)
         self._apply_releases()
         self._process_transfers()
+        self._dispatch_offloads()
         self._admit()
 
         active = [i for i, s in enumerate(self._slots) if s is not None]
@@ -611,6 +659,59 @@ class TpuEngine:
             jnp.float32(a.get("rep", 1.0)),
         )
 
+    # ---- offload (G2 tier) ----
+
+    def _dispatch_offloads(self) -> None:
+        """Batch-gather validated park candidates and fetch them to host
+        behind compute. Runs BEFORE admission so same-round allocations
+        cannot recycle a candidate page between validation and the gather
+        dispatch (device-order then guarantees the gather reads the
+        pre-recycle content anyway; validation just avoids wasted work)."""
+        if self.offload is None or not self._offload_cands:
+            return
+        batch: list[tuple[int, int, int]] = []
+        while len(batch) < self.ecfg.offload_batch:
+            try:
+                cand = self._offload_cands.popleft()
+            except IndexError:
+                break
+            page, h, _parent = cand
+            if h in self.offload:
+                continue
+            if self.allocator.page_for_hash(h) != page:
+                continue  # evicted/recycled since parking
+            batch.append(cand)
+        if not batch:
+            return
+        out = self._gather_padded([p for p, _, _ in batch])
+        out.copy_to_host_async()
+        self._entries.append(_Entry(
+            kind="offload", handle=out, n_steps=len(batch),
+            hashes=[h for _, h, _ in batch],
+            parents=[par for _, _, par in batch],
+        ))
+
+    def _onboard_from_host(
+        self, hashes: list[int], matched_pages: list[int]
+    ) -> list[int]:
+        """Extend a G1 prefix match with a contiguous run held in the G2
+        host tier: allocate pages, scatter (async H2D — prefill follows in
+        device order), commit under the same chained hashes."""
+        if self.offload is None:
+            return matched_pages
+        m = len(matched_pages)
+        run = self.offload.lookup_run(hashes[m:])
+        if not run:
+            return matched_pages
+        pages = self.allocator.allocate(len(run))
+        if pages is None:
+            return matched_pages
+        self._scatter_padded(pages, self.offload.gather([h for h, _ in run]))
+        for pg, (h, parent) in zip(pages, run):
+            self.allocator.commit(pg, h, parent)
+        log.debug("onboarded %d blocks from host tier", len(pages))
+        return matched_pages + pages
+
     # ---- admission / prefill ----
 
     def _admit(self) -> None:
@@ -628,9 +729,10 @@ class TpuEngine:
         ps = e.page_size
         prompt = r.tokens
         hashes = r.seq.block_hashes()
-        matched_pages = self.allocator.match_prefix(
-            hashes[: max(0, (len(prompt) - 1) // ps)]
-        )
+        matchable = hashes[: max(0, (len(prompt) - 1) // ps)]
+        matched_pages = self.allocator.match_prefix(matchable)
+        # blocks evicted from HBM may still live in the host tier
+        matched_pages = self._onboard_from_host(matchable, matched_pages)
         n_cached = len(matched_pages) * ps
         n_total_pages = (len(prompt) + ps - 1) // ps
         fresh = self.allocator.allocate(n_total_pages - len(matched_pages))
@@ -739,6 +841,11 @@ class TpuEngine:
             data = np.asarray(entry.handle)
             if entry.kind == "first":
                 self._process_first(entry.request, int(data[0]))
+            elif entry.kind == "offload":
+                self.offload.put_batch(
+                    entry.hashes, entry.parents,
+                    data[:, :, :, : entry.n_steps],
+                )
             else:
                 self._process_round(entry, data)
             block = False  # only force at most one blocking wait

@@ -1,0 +1,120 @@
+"""Host-DRAM KV offload tier (KVBM G2 — reference block_manager/offload.rs).
+
+Reference shape (offload.rs:46-80, pool.rs:156): blocks leaving the device
+pool's reuse set are offloaded down the tier hierarchy (G1 HBM -> G2 DRAM
+-> G3 disk) through a priority queue with batched transfers; prefix hits
+consult lower tiers and onboard blocks back up. This buys the BASELINE's
+"40% TTFT from KV offload to CPU RAM" on multi-turn traffic whose working
+set exceeds HBM.
+
+TPU redesign: offload piggybacks on the engine's pipelined round loop —
+candidates are pages PARKED in the allocator's LRU (committed, refcount 0);
+once per round the engine validates them (hash still owns the page),
+batch-gathers them in one fused jit, and fetches device->host
+asynchronously behind compute (same copy_to_host_async pipeline as token
+fetches). Nothing blocks the decode path. Onboard is the reverse: at
+admission, a contiguous run of G2 blocks extends the G1 prefix match via
+one scatter jit (async H2D upload; prefill follows in device order).
+
+This module owns only the host pool + hash registry; the device side
+(gather/scatter, validation, scheduling) lives in engine.py.
+"""
+from __future__ import annotations
+
+import logging
+from collections import OrderedDict
+from typing import Optional
+
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+
+class HostOffloadTier:
+    """Fixed-capacity host pool of KV pages keyed by chained block hash.
+
+    Slots hold [2(k/v), L, kvh, ps, hd] per page. LRU eviction on
+    pressure. Single-owner (the engine loop) except for read-only counter
+    access."""
+
+    def __init__(self, num_pages: int, page_shape: tuple, dtype):
+        # page_shape = (2, L, kvh, ps, hd); pool adds the page axis at 3
+        self.num_pages = num_pages
+        self.page_shape = tuple(page_shape)
+        self.dtype = np.dtype(dtype)
+        self._pool: Optional[np.ndarray] = None  # lazy: it can be GBs
+        # hash -> (slot, parent_hash); insertion order = LRU order
+        self._index: "OrderedDict[int, tuple[int, int]]" = OrderedDict()
+        self._free: list[int] = list(range(num_pages))
+        # counters
+        self.pages_offloaded = 0
+        self.onboard_hits = 0
+        self.lookups = 0
+
+    def _ensure_pool(self) -> np.ndarray:
+        if self._pool is None:
+            shape = (
+                self.page_shape[0], self.page_shape[1], self.page_shape[2],
+                self.num_pages, self.page_shape[3], self.page_shape[4],
+            )
+            self._pool = np.zeros(shape, self.dtype)
+        return self._pool
+
+    def __contains__(self, block_hash: int) -> bool:
+        return block_hash in self._index
+
+    def __len__(self) -> int:
+        return len(self._index)
+
+    def put_batch(
+        self, hashes: list[int], parents: list[int], data: np.ndarray
+    ) -> int:
+        """Store gathered pages (data [2, L, kvh, n, ps, hd], aligned with
+        hashes). Existing entries are refreshed in LRU order. Returns the
+        number of new pages stored."""
+        pool = self._ensure_pool()
+        stored = 0
+        for i, (h, parent) in enumerate(zip(hashes, parents)):
+            if h in self._index:
+                self._index.move_to_end(h)
+                continue
+            if not self._free:
+                old_h, (old_slot, _) = self._index.popitem(last=False)
+                self._free.append(old_slot)
+            slot = self._free.pop()
+            pool[:, :, :, slot] = data[:, :, :, i]
+            self._index[h] = (slot, parent)
+            stored += 1
+        self.pages_offloaded += stored
+        return stored
+
+    def lookup_run(self, hashes: list[int]) -> list[tuple[int, int]]:
+        """Longest leading run of hashes present in the tier; returns
+        [(hash, parent_hash), ...] and refreshes their LRU position."""
+        self.lookups += len(hashes)
+        run: list[tuple[int, int]] = []
+        for h in hashes:
+            ent = self._index.get(h)
+            if ent is None:
+                break
+            self._index.move_to_end(h)
+            run.append((h, ent[1]))
+        self.onboard_hits += len(run)
+        return run
+
+    def gather(self, hashes: list[int]) -> np.ndarray:
+        """Pages for the given (present) hashes: [2, L, kvh, n, ps, hd]."""
+        pool = self._ensure_pool()
+        slots = [self._index[h][0] for h in hashes]
+        return pool[:, :, :, slots]
+
+    def drop(self, block_hash: int) -> None:
+        ent = self._index.pop(block_hash, None)
+        if ent is not None:
+            self._free.append(ent[0])
+
+    def clear(self) -> int:
+        n = len(self._index)
+        for h in list(self._index):
+            self.drop(h)
+        return n

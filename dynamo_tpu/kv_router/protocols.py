@@ -57,6 +57,10 @@ class KvStats:
     kv_total_blocks: int = 0
     gpu_cache_usage_perc: float = 0.0
     gpu_prefix_cache_hit_rate: float = 0.0
+    # host-DRAM offload tier (KVBM G2); zero when the tier is disabled
+    host_blocks: int = 0
+    host_total_blocks: int = 0
+    host_onboard_hits: int = 0
 
 
 @dataclass

@@ -44,6 +44,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--page-size", type=int, default=64)
     p.add_argument("--max-decode-slots", type=int, default=8)
     p.add_argument("--cache-dtype", default="bfloat16")
+    p.add_argument("--host-offload-pages", type=int, default=0,
+                   help="host-DRAM KV offload tier capacity in pages "
+                        "(KVBM G2); 0 disables")
     # distributed mode (reference: etcd/NATS endpoints; here the dcp store)
     p.add_argument("--control-plane", default=None, metavar="HOST:PORT",
                    help="control-plane store address; enables discovery")
@@ -52,6 +55,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--endpoint-name", default="generate")
     p.add_argument("--router-mode", default="kv",
                    choices=["kv", "round_robin", "random"])
+    # multi-host single-engine bootstrap (reference MultiNodeConfig,
+    # flags.rs:86-101 + leader_worker_barrier.rs)
+    p.add_argument("--num-nodes", type=int, default=1)
+    p.add_argument("--node-rank", type=int, default=0)
+    p.add_argument("--leader-addr", default=None, metavar="HOST:PORT",
+                   help="jax.distributed coordinator address (required on "
+                        "the leader when --num-nodes > 1; workers discover "
+                        "it via the barrier)")
     # disaggregated prefill/decode (reference flags.rs + disagg_router.rs)
     p.add_argument("--role", default="aggregated",
                    choices=["aggregated", "decode", "prefill"],
@@ -76,6 +87,64 @@ def _parse_io(io: list[str]) -> tuple[str, str]:
         else:
             raise SystemExit(f"unrecognized arg {item!r} (expected in=/out=)")
     return inp, out
+
+
+def multi_host_bootstrap(args) -> None:
+    """Bring up a multi-host single engine: rendezvous all nodes on a
+    store barrier (leader distributes the jax coordinator address), then
+    jax.distributed.initialize so the engine's mesh spans every host's
+    chips (reference: LeaderBarrier/WorkerBarrier + vLLM's ray bootstrap).
+
+    Liveness: each node's barrier lease is its group membership; after
+    init, a dead node collapses the jax runtime itself, and the leader's
+    registration lease (held by _serve_worker) deregisters the engine."""
+    import json as _json
+
+    import jax
+
+    from dynamo_tpu.runtime.barrier import LeaderBarrier, WorkerBarrier
+    from dynamo_tpu.runtime.client import KvClient
+
+    host, port = _cp_addr(args)
+    barrier_id = f"engine-{args.namespace}-{args.component}"
+
+    async def rendezvous() -> str:
+        kv = await KvClient(host, port).connect()
+        try:
+            if args.node_rank == 0:
+                if not args.leader_addr:
+                    raise SystemExit(
+                        "--leader-addr required on node-rank 0"
+                    )
+                lb = LeaderBarrier(kv, barrier_id, args.num_nodes - 1)
+                await lb.sync(_json.dumps({
+                    "coordinator": args.leader_addr,
+                    "num_nodes": args.num_nodes,
+                }))
+                await lb.close()
+                return args.leader_addr
+            wb = WorkerBarrier(kv, barrier_id, f"node-{args.node_rank}")
+            data = _json.loads(await wb.sync())
+            await wb.close()
+            if data["num_nodes"] != args.num_nodes:
+                raise SystemExit(
+                    f"node count mismatch: leader says {data['num_nodes']}, "
+                    f"this node was started with {args.num_nodes}"
+                )
+            return data["coordinator"]
+        finally:
+            await kv.close()
+
+    coordinator = asyncio.run(rendezvous())
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=args.num_nodes,
+        process_id=args.node_rank,
+    )
+    print(
+        f"multi-host engine up: node {args.node_rank}/{args.num_nodes}, "
+        f"{jax.device_count()} global devices"
+    )
 
 
 def build_chain(args) -> "Any":
@@ -110,6 +179,27 @@ def build_chain(args) -> "Any":
         from dynamo_tpu.models.config import ModelConfig
         from dynamo_tpu.parallel.mesh import MeshConfig
 
+        local_devices = None
+        if args.num_nodes > 1:
+            if not args.control_plane:
+                raise SystemExit("--num-nodes > 1 requires --control-plane")
+            multi_host_bootstrap(args)
+            # Each rank serves an engine over its OWN chips as an
+            # independent DP replica (discovered + routed via the store) —
+            # the multi-host scale-out story of SURVEY §2.5's DP row.
+            # Cross-host TP inside ONE engine requires every rank to
+            # dispatch identical programs in lockstep (the engine loop is
+            # host-driven), so tp is capped at the local device count.
+            import jax
+
+            local_devices = jax.local_devices()
+            if args.tensor_parallel_size > len(local_devices):
+                raise SystemExit(
+                    f"--tensor-parallel-size {args.tensor_parallel_size} "
+                    f"exceeds this host's {len(local_devices)} chips; "
+                    "cross-host TP needs lockstep dispatch (not yet wired)"
+                )
+
         if args.model_path:
             cfg = ModelConfig.from_pretrained(args.model_path)
         elif args.model_config:
@@ -121,14 +211,20 @@ def build_chain(args) -> "Any":
             page_size=args.page_size,
             max_decode_slots=args.max_decode_slots,
             cache_dtype=args.cache_dtype,
+            host_offload_pages=args.host_offload_pages,
         )
         params = None
         if args.model_path:
             from dynamo_tpu.models import llama
 
             params = llama.load_hf_params(cfg, args.model_path)
+        from dynamo_tpu.parallel.mesh import make_mesh
+
         engine = TpuEngine(
             cfg, ecfg, params=params,
+            mesh=make_mesh(
+                MeshConfig(tp=args.tensor_parallel_size), local_devices
+            ) if local_devices is not None else None,
             mesh_config=MeshConfig(tp=args.tensor_parallel_size),
         )
     else:

@@ -1,0 +1,192 @@
+"""Leader/worker barrier for multi-host engine bring-up.
+
+Parity: reference lib/runtime/src/utils/leader_worker_barrier.rs —
+LeaderBarrier (:137) publishes payload data and waits for N workers to
+check in, then marks the barrier complete; WorkerBarrier (:230) waits for
+the data, checks in, and waits for completion. Key layout (:35-42):
+
+    dynamo://{ns}/_barrier/{id}/data            <- leader payload
+    dynamo://{ns}/_barrier/{id}/worker/{name}   <- one per worker
+    dynamo://{ns}/_barrier/{id}/complete        <- leader, after quorum
+    dynamo://{ns}/_barrier/{id}/abort           <- either side, on failure
+
+All keys are lease-bound to their writer: a dead participant's keys vanish
+at lease expiry, and the other side times out instead of hanging forever.
+Used by the multi-host TPU engine bootstrap: the leader distributes its
+coordinator address (jax.distributed) and the mesh config; workers join
+before anyone calls jax.distributed.initialize.
+"""
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Optional
+
+from dynamo_tpu.runtime.client import KvClient, Lease
+
+log = logging.getLogger(__name__)
+
+
+class BarrierError(RuntimeError):
+    pass
+
+
+class BarrierAborted(BarrierError):
+    pass
+
+
+def barrier_prefix(namespace: str, barrier_id: str) -> str:
+    return f"dynamo://{namespace}/_barrier/{barrier_id}/"
+
+
+async def _watch_until(watch, pred, timeout_s: float, state: dict) -> None:
+    """Feed watch events into `state` ({key: value}) until pred(state)."""
+    if pred(state):
+        return
+
+    async def follow():
+        async for ev in watch:
+            if ev.get("event") == "put":
+                state[ev["key"]] = ev.get("value", "")
+            elif ev.get("event") == "delete":
+                state.pop(ev["key"], None)
+            if pred(state):
+                return
+
+    try:
+        await asyncio.wait_for(follow(), timeout_s)
+    except asyncio.TimeoutError:
+        raise BarrierError("barrier timed out") from None
+
+
+class LeaderBarrier:
+    """Leader side: publish data, await quorum, mark complete."""
+
+    def __init__(
+        self,
+        kv: KvClient,
+        barrier_id: str,
+        num_workers: int,
+        *,
+        namespace: str = "dynamo",
+        timeout_s: float = 120.0,
+        lease_ttl_s: float = 5.0,
+    ):
+        self.kv = kv
+        self.prefix = barrier_prefix(namespace, barrier_id)
+        self.num_workers = num_workers
+        self.timeout_s = timeout_s
+        self.lease_ttl_s = lease_ttl_s
+        self.lease: Optional[Lease] = None
+
+    async def sync(self, data: str) -> None:
+        """Publish `data`; return once num_workers checked in and the
+        barrier is marked complete. Raises BarrierError on timeout."""
+        self.lease = await self.kv.lease_grant(self.lease_ttl_s)
+        watch = await self.kv.watch_prefix(self.prefix)
+        state = {k: v for k, v, _ in watch.initial}
+        if self.prefix + "abort" in state:
+            raise BarrierAborted(state[self.prefix + "abort"])
+        await self.kv.put(self.prefix + "data", data, lease=self.lease.id)
+
+        worker_pfx = self.prefix + "worker/"
+
+        def quorum(st: dict) -> bool:
+            if self.prefix + "abort" in st:
+                raise BarrierAborted(st[self.prefix + "abort"])
+            return sum(1 for k in st if k.startswith(worker_pfx)) \
+                >= self.num_workers
+        try:
+            await _watch_until(watch, quorum, self.timeout_s, state)
+        except BarrierAborted:
+            raise
+        except BarrierError:
+            await self.abort("leader timed out waiting for workers")
+            raise
+        finally:
+            await watch.cancel()
+        await self.kv.put(self.prefix + "complete", "1", lease=self.lease.id)
+        log.info("barrier %s complete (%d workers)", self.prefix,
+                 self.num_workers)
+
+    async def abort(self, reason: str) -> None:
+        await _put_abort(self.kv, self.prefix, reason)
+
+    async def close(self) -> None:
+        if self.lease is not None:
+            await self.lease.revoke()
+            self.lease = None
+
+
+async def _put_abort(kv: KvClient, prefix: str, reason: str) -> None:
+    """Abort is a transient signal: bound to a keepalive-less 60s lease so
+    a failed bring-up fails co-participants fast but does NOT permanently
+    poison the barrier id for the next restart."""
+    try:
+        lease = await kv.lease_grant(60.0, keepalive=False)
+        await kv.put(prefix + "abort", reason, lease=lease.id)
+    except (ConnectionError, OSError):
+        pass
+
+
+class WorkerBarrier:
+    """Worker side: await data, check in, await completion."""
+
+    def __init__(
+        self,
+        kv: KvClient,
+        barrier_id: str,
+        worker_name: str,
+        *,
+        namespace: str = "dynamo",
+        timeout_s: float = 120.0,
+        lease_ttl_s: float = 5.0,
+    ):
+        self.kv = kv
+        self.prefix = barrier_prefix(namespace, barrier_id)
+        self.worker_name = worker_name
+        self.timeout_s = timeout_s
+        self.lease_ttl_s = lease_ttl_s
+        self.lease: Optional[Lease] = None
+
+    async def sync(self) -> str:
+        """Check in; returns the leader's data once the barrier completes.
+        Raises BarrierError on timeout, BarrierAborted on abort."""
+        self.lease = await self.kv.lease_grant(self.lease_ttl_s)
+        watch = await self.kv.watch_prefix(self.prefix)
+        state = {k: v for k, v, _ in watch.initial}
+
+        data_key = self.prefix + "data"
+        complete_key = self.prefix + "complete"
+
+        def guard(pred_key: str):
+            def pred(st: dict) -> bool:
+                if self.prefix + "abort" in st:
+                    raise BarrierAborted(st[self.prefix + "abort"])
+                return pred_key in st
+            return pred
+
+        try:
+            await _watch_until(watch, guard(data_key), self.timeout_s, state)
+            await self.kv.put(
+                self.prefix + "worker/" + self.worker_name, "1",
+                lease=self.lease.id,
+            )
+            await _watch_until(
+                watch, guard(complete_key), self.timeout_s, state
+            )
+        except BarrierError as e:
+            if not isinstance(e, BarrierAborted):
+                await self._abort("worker timed out")
+            raise
+        finally:
+            await watch.cancel()
+        return state[data_key]
+
+    async def _abort(self, reason: str) -> None:
+        await _put_abort(self.kv, self.prefix, reason)
+
+    async def close(self) -> None:
+        if self.lease is not None:
+            await self.lease.revoke()
+            self.lease = None

@@ -1,0 +1,168 @@
+"""Leader/worker barrier tests (reference leader_worker_barrier.rs:356
+test strategy) + a 2-process jax.distributed CPU smoke test for the
+multi-host bootstrap path.
+"""
+import asyncio
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from dynamo_tpu.runtime.barrier import (
+    BarrierAborted,
+    BarrierError,
+    LeaderBarrier,
+    WorkerBarrier,
+)
+from dynamo_tpu.runtime.client import KvClient
+from dynamo_tpu.runtime.store import serve_store
+
+
+async def start_store():
+    server, store = await serve_store(port=0, sweep_interval_s=0.05)
+    return server, server.sockets[0].getsockname()[1]
+
+
+async def test_barrier_rendezvous():
+    server, port = await start_store()
+    lkv = await KvClient(port=port).connect()
+    wkv1 = await KvClient(port=port).connect()
+    wkv2 = await KvClient(port=port).connect()
+
+    leader = LeaderBarrier(lkv, "b1", num_workers=2, timeout_s=5)
+    w1 = WorkerBarrier(wkv1, "b1", "n1", timeout_s=5)
+    w2 = WorkerBarrier(wkv2, "b1", "n2", timeout_s=5)
+
+    results = await asyncio.gather(
+        leader.sync("coordinator=10.0.0.1:1234"),
+        w1.sync(),
+        w2.sync(),
+    )
+    assert results[1] == results[2] == "coordinator=10.0.0.1:1234"
+    for b in (leader, w1, w2):
+        await b.close()
+    for kv in (lkv, wkv1, wkv2):
+        await kv.close()
+    server.close()
+
+
+async def test_barrier_worker_joins_late():
+    """Leader publishes first; a worker arriving later sees the data in
+    the snapshot and still completes."""
+    server, port = await start_store()
+    lkv = await KvClient(port=port).connect()
+    wkv = await KvClient(port=port).connect()
+    leader = LeaderBarrier(lkv, "b2", num_workers=1, timeout_s=5)
+    leader_task = asyncio.create_task(leader.sync("d"))
+    await asyncio.sleep(0.3)  # leader is already waiting
+    w = WorkerBarrier(wkv, "b2", "n1", timeout_s=5)
+    assert await w.sync() == "d"
+    await leader_task
+    await leader.close()
+    await w.close()
+    await lkv.close()
+    await wkv.close()
+    server.close()
+
+
+async def test_barrier_leader_timeout_aborts_workers():
+    server, port = await start_store()
+    lkv = await KvClient(port=port).connect()
+    wkv = await KvClient(port=port).connect()
+    leader = LeaderBarrier(lkv, "b3", num_workers=2, timeout_s=0.4)
+    w = WorkerBarrier(wkv, "b3", "n1", timeout_s=5)
+    with pytest.raises(BarrierError):
+        await asyncio.gather(leader.sync("d"), w.sync())
+    # the abort key is visible: a late worker fails fast instead of hanging
+    w2kv = await KvClient(port=port).connect()
+    w2 = WorkerBarrier(w2kv, "b3", "late", timeout_s=5)
+    with pytest.raises(BarrierAborted):
+        await w2.sync()
+    for kv in (lkv, wkv, w2kv):
+        await kv.close()
+    server.close()
+
+
+async def test_barrier_dead_leader_expires():
+    """A leader that dies after publishing: its lease-bound data vanishes;
+    workers time out rather than waiting forever on stale state."""
+    server, port = await start_store()
+    lkv = await KvClient(port=port).connect()
+    leader = LeaderBarrier(lkv, "b4", num_workers=2, timeout_s=30,
+                           lease_ttl_s=0.3)
+    leader_task = asyncio.create_task(leader.sync("d"))
+    await asyncio.sleep(0.2)
+    leader_task.cancel()  # crash the leader mid-wait
+    leader.lease._task.cancel()  # stop keepalives -> lease expires
+    await asyncio.sleep(1.0)
+    kv = await KvClient(port=port).connect()
+    assert await kv.get_prefix("dynamo://dynamo/_barrier/b4/") == []
+    await kv.close()
+    await lkv.close()
+    server.close()
+
+
+# ---------------------------------------------------------------------------
+# 2-process jax.distributed CPU smoke (multi-host bootstrap path)
+
+_SMOKE = textwrap.dedent("""
+    import os, sys
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    coord, rank = sys.argv[1], int(sys.argv[2])
+    jax.distributed.initialize(coordinator_address=coord,
+                               num_processes=2, process_id=rank)
+    assert jax.process_count() == 2, jax.process_count()
+    assert jax.device_count() == 4, jax.device_count()  # 2 procs x 2 cpu
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    mesh = Mesh(jax.devices(), ("dp",))
+    x = jax.make_array_from_callback(
+        (4,), NamedSharding(mesh, P("dp")),
+        lambda idx: jnp.ones((1,), jnp.float32) * (rank + 1),
+    )
+    total = jax.jit(
+        lambda a: jax.numpy.sum(a),
+        out_shardings=NamedSharding(mesh, P()),
+    )(x)
+    print("SMOKE_OK", rank, float(total), flush=True)
+""")
+
+
+def test_jax_distributed_two_process_smoke(tmp_path):
+    script = tmp_path / "smoke.py"
+    script.write_text(_SMOKE)
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        coord = f"127.0.0.1:{s.getsockname()[1]}"
+    env = dict(os.environ)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(script), coord, str(rank)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env,
+        )
+        for rank in (0, 1)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=180)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.skip("jax.distributed CPU smoke timed out on this host")
+        outs.append(out)
+    if any(p.returncode != 0 for p in procs):
+        joined = "\n".join(outs)
+        if "distributed" in joined and "not" in joined.lower():
+            pytest.skip(f"jax.distributed unsupported here: {joined[-300:]}")
+        raise AssertionError(f"smoke failed:\n{joined[-2000:]}")
+    # cross-process sum: ranks contribute 1s and 2s over 2 devices each
+    assert "SMOKE_OK 0 6.0" in outs[0]
+    assert "SMOKE_OK 1 6.0" in outs[1]
