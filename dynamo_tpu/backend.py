@@ -75,18 +75,50 @@ class Backend:
             stop_ids = set()
         produced = 0
         finished = False
+        # carried across engine outputs: entries for tokens whose text was
+        # held back (jail/partial UTF-8) must not be dropped — one entry
+        # per emitted token is the OpenAI contract
+        pending_entries: list[dict] = []
+
+        def tok_entry(tid: int, logprob: float, tops) -> dict:
+            """OpenAI logprobs content entry: token string + bytes + top
+            alternatives (delta.rs logprobs plumbing)."""
+            s = self.tokenizer.decode([tid], skip_special_tokens=False)
+            entry: dict = {
+                "token": s, "logprob": logprob, "bytes": list(s.encode()),
+            }
+            if tops is not None:
+                entry["top_logprobs"] = [
+                    {
+                        "token": (
+                            ts := self.tokenizer.decode(
+                                [int(i)], skip_special_tokens=False
+                            )
+                        ),
+                        "logprob": float(v),
+                        "bytes": list(ts.encode()),
+                    }
+                    for i, v in tops
+                ]
+            return entry
 
         async for out in stream:
             text_parts: list[str] = []
             finish: FinishReason | None = out.finish_reason
             emitted_ids: list[int] = []
-            for tid in out.token_ids:
+            for idx, tid in enumerate(out.token_ids):
                 produced += 1
                 hit_stop_id = tid in stop_ids and (
                     stop.min_tokens is None or produced >= stop.min_tokens
                 )
                 if not hit_stop_id:
                     emitted_ids.append(tid)
+                    if out.log_probs is not None and idx < len(out.log_probs):
+                        tops = (out.top_logprobs[idx]
+                                if out.top_logprobs else None)
+                        pending_entries.append(
+                            tok_entry(tid, out.log_probs[idx], tops)
+                        )
                     piece = decoder.step(tid)
                     if piece:
                         released, stopped = jail.push(piece)
@@ -107,12 +139,21 @@ class Backend:
                 if tail:
                     text_parts.append(tail)
             if text_parts or finish is not None or out.annotations:
+                lp_entries, pending_entries = pending_entries, []
                 yield LLMEngineOutput(
                     token_ids=emitted_ids,
                     text="".join(text_parts) or None,
                     finish_reason=finish,
                     cum_log_probs=out.cum_log_probs,
-                    log_probs=out.log_probs,
+                    log_probs=(
+                        out.log_probs[: len(emitted_ids)]
+                        if out.log_probs is not None else None
+                    ),
+                    top_logprobs=(
+                        out.top_logprobs[: len(emitted_ids)]
+                        if out.top_logprobs is not None else None
+                    ),
+                    logprob_entries=lp_entries or None,
                     annotations=out.annotations,
                 )
             if finish is not None:

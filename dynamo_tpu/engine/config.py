@@ -33,6 +33,9 @@ class EngineConfig:
 
     # sampling
     max_top_k: int = 64           # static top-k width for top-p/top-k sampling
+    # static top-N width for logprobs (OpenAI caps top_logprobs at 20);
+    # requests asking for logprobs compile the lp variant of the step
+    max_logprobs: int = 20
 
     # prefix cache
     enable_prefix_caching: bool = True

@@ -129,6 +129,9 @@ class _Entry:
     # offload: hashes/parents aligned with the gathered pages
     hashes: list[int] = field(default_factory=list)
     parents: list[int] = field(default_factory=list)
+    # logprobs: stacked (chosen [F,B], top_ids [F,B,K], top_lps [F,B,K])
+    # for rounds, or the single-step tuple for "first" entries
+    lp_handle: Optional[tuple] = None
 
 
 class TpuEngine:
@@ -240,14 +243,20 @@ class TpuEngine:
         c, e = self.config, self.ecfg
         max_top_k = e.max_top_k
 
-        @functools.partial(jax.jit, donate_argnums=(2, 3))
-        def engine_step(params, cache, ring, dev, pt, ring_base, ring_pos):
+        max_logprobs = e.max_logprobs
+
+        @functools.partial(jax.jit, donate_argnums=(2, 3),
+                           static_argnums=(7,))
+        def engine_step(params, cache, ring, dev, pt, ring_base, ring_pos,
+                        want_lp):
             # pt is width-bucketed [B, W] (W = pow2 cover of the widest
             # active page table) — narrow tables shrink the attention
             # kernel's page grid; one compile per W bucket. The page pool
             # (cache) is read-only here: the new token's KV lands in ring
             # slot ring_pos; llama.flush commits the ring to the pool at
-            # the round boundary.
+            # the round boundary. `want_lp` (static) adds the logprob
+            # computation — a separate compile used only for rounds where
+            # some request asked for logprobs.
             ring, logits = llama.decode_step_impl(
                 c, params, cache, ring, dev["tokens"], pt, dev["ctx"],
                 ring_base, ring_pos,
@@ -261,6 +270,8 @@ class TpuEngine:
                 logits, sampling.SamplerState(dev["keys"], dev["counts"]),
                 sp, max_top_k,
             )
+            lp = (sampling.compute_logprobs(logits, toks, max_logprobs)
+                  if want_lp else None)
             dev = dict(
                 dev,
                 tokens=toks,
@@ -268,7 +279,7 @@ class TpuEngine:
                 keys=st.keys,
                 counts=st.counts,
             )
-            return ring, dev, toks
+            return ring, dev, toks, lp
 
         @functools.partial(jax.jit, donate_argnums=(0,))
         def patch(
@@ -297,8 +308,8 @@ class TpuEngine:
             dev["rep"] = dev["rep"].at[s].set(admit_rep)
             return dev
 
-        @functools.partial(jax.jit, static_argnums=(5,))
-        def sample_first(logits, key, temp, top_k, top_p, vocab):
+        @functools.partial(jax.jit, static_argnums=(5, 6))
+        def sample_first(logits, key, temp, top_k, top_p, vocab, want_lp):
             st = sampling.SamplerState(
                 keys=key[None], counts=jnp.zeros((1, vocab), jnp.int32)
             )
@@ -308,7 +319,9 @@ class TpuEngine:
                 repetition_penalty=jnp.ones(1),
             )
             toks, _ = sampling.sample_step_impl(logits[None], st, sp, max_top_k)
-            return toks  # [1] i32
+            lp = (sampling.compute_logprobs(logits[None], toks, max_logprobs)
+                  if want_lp else None)
+            return toks, lp  # [1] i32, optional ([1], [1,K], [1,K])
 
         stack = jax.jit(lambda *ts: jnp.stack(ts))
 
@@ -564,13 +577,22 @@ class TpuEngine:
         # ring slot 0 holds the position decoded by this round's first step
         ring_base_np = np.maximum(self._ctx_disp - 1, 0)
         ring_base = jnp.asarray(ring_base_np)
+        want_lp = any(
+            self._slots[i] is not None
+            and not self._slots[i].finished
+            and self._slots[i].req.output_options.logprobs is not None
+            for i in active
+        )
         handles = []
+        lp_handles: list[tuple] = []
         for s in range(n):
-            self.ring, self._dev, toks = self._engine_step(
+            self.ring, self._dev, toks, lp = self._engine_step(
                 self.params, self.cache, self.ring, self._dev, pt_dev,
-                ring_base, jnp.int32(s),
+                ring_base, jnp.int32(s), want_lp,
             )
             handles.append(toks)
+            if lp is not None:
+                lp_handles.append(lp)
             self._ctx_disp = np.minimum(self._ctx_disp + 1, self._cap_disp)
             self.step_count += 1
         # round boundary: batch-scatter the ring into the page pool. Ring
@@ -584,12 +606,20 @@ class TpuEngine:
         )
         stacked = self._stack(*handles)
         stacked.copy_to_host_async()
+        lp_stacked: Optional[tuple] = None
+        if lp_handles:
+            lp_stacked = tuple(
+                self._stack(*[h[j] for h in lp_handles]) for j in range(3)
+            )
+            for arr in lp_stacked:
+                arr.copy_to_host_async()
         self._entries.append(
             _Entry(
                 kind="round",
                 handle=stacked,
                 slots=list(self._slots),
                 n_steps=n,
+                lp_handle=lp_stacked,
             )
         )
 
@@ -794,13 +824,15 @@ class TpuEngine:
                 [_FIRST_TOKEN_KEY_TAG ^ int(nonce[0]), int(nonce[1])], np.uint32
             )
             step_keys = nonce
-        first_tok = self._sample_first(
+        want_lp = r.req.output_options.logprobs is not None
+        first_tok, first_lp = self._sample_first(
             logits,
             jnp.asarray(first_key),
             jnp.float32(so.temperature or 0.0),
             jnp.int32(so.top_k or 0),
             jnp.float32(so.top_p if so.top_p is not None else 1.0),
             self.config.vocab_size,
+            want_lp,
         )
 
         slot = self._slots.index(None)
@@ -827,7 +859,12 @@ class TpuEngine:
         )
         # first token reaches the client via the async fetch pipeline
         first_tok.copy_to_host_async()
-        self._entries.append(_Entry(kind="first", handle=first_tok, request=r))
+        if first_lp is not None:
+            for arr in first_lp:
+                arr.copy_to_host_async()
+        self._entries.append(_Entry(
+            kind="first", handle=first_tok, request=r, lp_handle=first_lp
+        ))
         return True
 
     # ---- processing side (lagged results) ----
@@ -840,7 +877,11 @@ class TpuEngine:
             self._entries.pop(0)
             data = np.asarray(entry.handle)
             if entry.kind == "first":
-                self._process_first(entry.request, int(data[0]))
+                lp = None
+                if entry.lp_handle is not None:
+                    chosen, ids, lps = (np.asarray(a) for a in entry.lp_handle)
+                    lp = (float(chosen[0]), ids[0], lps[0])
+                self._process_first(entry.request, int(data[0]), lp)
             elif entry.kind == "offload":
                 self.offload.put_batch(
                     entry.hashes, entry.parents,
@@ -850,7 +891,17 @@ class TpuEngine:
                 self._process_round(entry, data)
             block = False  # only force at most one blocking wait
 
-    def _process_first(self, r: _Request, tok: int) -> None:
+    def _lp_payload(self, r: _Request, lp) -> dict:
+        """LLMEngineOutput logprob fields for one emitted token."""
+        n_req = r.req.output_options.logprobs
+        if lp is None or n_req is None:
+            return {}
+        chosen, ids, lps = lp
+        n = min(int(n_req), self.ecfg.max_logprobs)
+        pairs = [[int(i), float(v)] for i, v in zip(ids[:n], lps[:n])]
+        return {"log_probs": [float(chosen)], "top_logprobs": [pairs]}
+
+    def _process_first(self, r: _Request, tok: int, lp=None) -> None:
         if r.cancelled or r.finished:
             self._finish(r, None)
             return
@@ -864,11 +915,14 @@ class TpuEngine:
             return
         r.last_token = tok
         r.produced += 1  # may continue a preempted request's count
-        r.emit(LLMEngineOutput(token_ids=[tok]))
+        r.emit(LLMEngineOutput(token_ids=[tok], **self._lp_payload(r, lp)))
         if r.produced >= r.max_new_tokens(self.ecfg.max_context):
             self._finish(r, FinishReason.LENGTH, emit_empty=True)
 
     def _process_round(self, entry: _Entry, toks: np.ndarray) -> None:
+        lp_arrs = None
+        if entry.lp_handle is not None:
+            lp_arrs = tuple(np.asarray(a) for a in entry.lp_handle)
         for step in range(entry.n_steps):
             for slot, r in enumerate(entry.slots):
                 # identity check doubles as the epoch: a recycled slot holds
@@ -878,12 +932,16 @@ class TpuEngine:
                 if r.cancelled:
                     self._finish(r, None)
                     continue
-                self._consume_token(r, int(toks[step, slot]))
+                lp = None
+                if lp_arrs is not None:
+                    lp = (float(lp_arrs[0][step, slot]),
+                          lp_arrs[1][step, slot], lp_arrs[2][step, slot])
+                self._consume_token(r, int(toks[step, slot]), lp)
         self.tokens_generated += int(
             sum(1 for s in entry.slots if s is not None) * entry.n_steps
         )
 
-    def _consume_token(self, r: _Request, tok: int) -> None:
+    def _consume_token(self, r: _Request, tok: int, lp=None) -> None:
         sc = r.req.stop_conditions
         # seal/commit the block completed by the previous token
         if r.last_token >= 0:
@@ -901,10 +959,11 @@ class TpuEngine:
         r.produced += 1
         if r.produced >= r.max_new_tokens(self.ecfg.max_context):
             r.emit(LLMEngineOutput(token_ids=[tok],
-                                   finish_reason=FinishReason.LENGTH))
+                                   finish_reason=FinishReason.LENGTH,
+                                   **self._lp_payload(r, lp)))
             self._finish(r, None)
             return
-        r.emit(LLMEngineOutput(token_ids=[tok]))
+        r.emit(LLMEngineOutput(token_ids=[tok], **self._lp_payload(r, lp)))
 
     def _finish(
         self,

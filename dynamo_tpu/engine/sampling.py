@@ -113,6 +113,20 @@ sample_step = jax.jit(
 )
 
 
+def compute_logprobs(
+    logits: jnp.ndarray,   # [B, V] f32 RAW model logits (pre-penalty)
+    tokens: jnp.ndarray,   # [B] i32 chosen tokens
+    k: int,
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """OpenAI-style logprobs: the MODEL's log-softmax (before sampling
+    transforms), for the chosen token plus the top-k alternatives.
+    Returns (chosen_lp [B], top_ids [B, k], top_lps [B, k])."""
+    logp = logits - jax.nn.logsumexp(logits, axis=-1, keepdims=True)
+    chosen = jnp.take_along_axis(logp, tokens[:, None], axis=-1)[:, 0]
+    top_lps, top_ids = jax.lax.top_k(logp, k)
+    return chosen, top_ids.astype(jnp.int32), top_lps
+
+
 def reset_slot(state: SamplerState, slot: int, seed: int) -> SamplerState:
     """Host-side slot (re)initialization on request assignment."""
     key = jax.random.key_data(jax.random.PRNGKey(seed))

@@ -239,6 +239,7 @@ class HttpService:
         texts = [""] * len(streams)
         tokens = [0] * len(streams)
         finishes: list[FinishReason] = [FinishReason.EOS] * len(streams)
+        lp_entries: list[list[dict]] = [[] for _ in streams]
 
         async def drain(i: int) -> None:
             try:
@@ -246,6 +247,8 @@ class HttpService:
                     if out.text:
                         texts[i] += out.text
                     tokens[i] += len(out.token_ids)
+                    if out.logprob_entries:
+                        lp_entries[i].extend(out.logprob_entries)
                     if out.finish_reason is not None:
                         finishes[i] = out.finish_reason
             finally:
@@ -265,6 +268,9 @@ class HttpService:
                     "index": i,
                     "message": {"role": "assistant", "content": texts[i]},
                     "finish_reason": finishes[i].to_openai(),
+                    "logprobs": (
+                        {"content": lp_entries[i]} if lp_entries[i] else None
+                    ),
                 }
                 for i in range(len(streams))
             ]
@@ -276,12 +282,17 @@ class HttpService:
                 completion_tokens=sum(tokens),
             )
         else:
+            from dynamo_tpu.protocols.openai import completion_logprobs
+
             choices = [
                 {
                     "index": i,
                     "text": texts[i],
                     "finish_reason": finishes[i].to_openai(),
-                    "logprobs": None,
+                    "logprobs": (
+                        completion_logprobs(lp_entries[i])
+                        if lp_entries[i] else None
+                    ),
                 }
                 for i in range(len(streams))
             ]
@@ -338,9 +349,15 @@ class HttpService:
                     )
                     continue
                 completion_tokens += len(item.token_ids)
-                if item.text:
+                if item.text or item.logprob_entries:
+                    # entries may arrive on a text-less output (final token
+                    # eaten by the stop jail / partial UTF-8) — still owed
+                    # to the client, one entry per token
                     await resp.write(
-                        encode_event(gen.text_chunk(item.text, index=i))
+                        encode_event(gen.text_chunk(
+                            item.text or "", index=i,
+                            logprob_entries=item.logprob_entries,
+                        ))
                     )
                 if item.finish_reason is not None:
                     await resp.write(

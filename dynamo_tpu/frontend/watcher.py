@@ -107,11 +107,13 @@ class ModelWatcher:
         manager: ModelManager,
         namespace: str = "dynamo",
         router_config: Optional[KvRouterConfig] = None,
+        kv_recorder: Optional[Any] = None,  # KvRecorder: tees kv_events
     ):
         self.rt = rt
         self.manager = manager
         self.namespace = namespace
         self.router_config = router_config
+        self.kv_recorder = kv_recorder
         self._task: Optional[asyncio.Task] = None
         self._models: dict[str, dict[int, ModelEntry]] = {}  # name -> lease -> entry
         self._chains: dict[str, Any] = {}
@@ -151,6 +153,13 @@ class ModelWatcher:
                 event = KvCacheEvent.from_dict(json.loads(ev["value"]))
             except (KeyError, ValueError, TypeError):
                 continue
+            if self.kv_recorder is not None:
+                try:
+                    self.kv_recorder(event)
+                except Exception:  # noqa: BLE001 — a debug feature must
+                    # never take down routing; disable and keep going
+                    log.exception("kv recorder failed; disabling recording")
+                    self.kv_recorder = None
             for router in self._routers.values():
                 router.router.indexer.apply_event(event)
 

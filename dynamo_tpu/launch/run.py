@@ -55,6 +55,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--endpoint-name", default="generate")
     p.add_argument("--router-mode", default="kv",
                    choices=["kv", "round_robin", "random"])
+    p.add_argument("--record-kv-events", default=None, metavar="PATH",
+                   help="record the frontend's kv_events stream to a JSONL "
+                        "file for later replay (reference KvRecorder)")
     # multi-host single-engine bootstrap (reference MultiNodeConfig,
     # flags.rs:86-101 + leader_worker_barrier.rs)
     p.add_argument("--num-nodes", type=int, default=1)
@@ -437,7 +440,14 @@ async def _serve_http_dynamic(args) -> None:
     host, port = _cp_addr(args)
     rt = await DistributedRuntime.connect(host=host, port=port)
     manager = ModelManager()
-    watcher = await ModelWatcher(rt, manager, namespace=args.namespace).start()
+    kv_recorder = None
+    if args.record_kv_events:
+        from dynamo_tpu.recorder import KvRecorder
+
+        kv_recorder = KvRecorder(args.record_kv_events)
+    watcher = await ModelWatcher(
+        rt, manager, namespace=args.namespace, kv_recorder=kv_recorder
+    ).start()
     svc = HttpService(manager, host=args.http_host, port=args.http_port)
     await svc.start()
     print(

@@ -290,6 +290,15 @@ class MockerEngine:
             r.pages.extend(got)
         self._emit_token(r, self._next_token(r))
 
+    def _lp_fields(self, r: _MockRequest, tok: int) -> dict:
+        """Synthetic-but-shaped logprobs when the request asks for them —
+        lets HTTP-level logprob plumbing be tested without a real model."""
+        n = r.req.output_options.logprobs
+        if n is None:
+            return {}
+        pairs = [[tok + i, -0.1 - 1.0 * i] for i in range(max(int(n), 1))]
+        return {"log_probs": [-0.1], "top_logprobs": [pairs[: int(n)]]}
+
     def _emit_token(self, r: _MockRequest, tok: int) -> None:
         sc = r.req.stop_conditions
         r.produced += 1
@@ -309,12 +318,15 @@ class MockerEngine:
         if sc.max_tokens is not None and r.produced >= sc.max_tokens:
             r.out.put_nowait(
                 LLMEngineOutput(
-                    token_ids=[tok], finish_reason=FinishReason.LENGTH
+                    token_ids=[tok], finish_reason=FinishReason.LENGTH,
+                    **self._lp_fields(r, tok),
                 )
             )
             self._release(r)
             return
-        r.out.put_nowait(LLMEngineOutput(token_ids=[tok]))
+        r.out.put_nowait(
+            LLMEngineOutput(token_ids=[tok], **self._lp_fields(r, tok))
+        )
 
     def _release(self, r: _MockRequest) -> None:
         self.allocator.free(r.pages)

@@ -108,6 +108,11 @@ class LLMEngineOutput:
     text: Optional[str] = None
     cum_log_probs: Optional[float] = None
     log_probs: Optional[list[float]] = None
+    # per emitted token: top-N alternatives as [token_id, logprob] pairs
+    top_logprobs: Optional[list[list[list]]] = None
+    # OpenAI-ready per-token entries, filled by the Backend (token strings
+    # need the tokenizer): {"token", "logprob", "bytes", "top_logprobs"}
+    logprob_entries: Optional[list[dict]] = None
     finish_reason: Optional[FinishReason] = None
     # in-band metrics/events annotation plane (reference Annotated<T>)
     annotations: dict[str, Any] = field(default_factory=dict)

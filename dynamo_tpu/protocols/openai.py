@@ -191,6 +191,25 @@ def model_list_response(models: list[str]) -> dict[str, Any]:
     }
 
 
+def completion_logprobs(entries: list[dict]) -> dict[str, Any]:
+    """Legacy /v1/completions logprobs object from per-token entries
+    (chat uses the entries directly under {"content": [...]})."""
+    offsets, pos = [], 0
+    for e in entries:
+        offsets.append(pos)
+        pos += len(e["token"])
+    return {
+        "tokens": [e["token"] for e in entries],
+        "token_logprobs": [e["logprob"] for e in entries],
+        "top_logprobs": [
+            {t["token"]: t["logprob"] for t in e.get("top_logprobs", [])}
+            or None
+            for e in entries
+        ],
+        "text_offset": offsets,
+    }
+
+
 class DeltaGenerator:
     """Builds OpenAI streaming chunks from engine output deltas.
 
@@ -217,15 +236,24 @@ class DeltaGenerator:
             out["usage"] = usage
         return out
 
-    def text_chunk(self, text: str, index: int = 0) -> dict[str, Any]:
+    def text_chunk(
+        self,
+        text: str,
+        index: int = 0,
+        logprob_entries: Optional[list[dict]] = None,
+    ) -> dict[str, Any]:
         if self.chat:
             delta: dict[str, Any] = {"content": text}
             if not self._first_sent[index]:
                 delta["role"] = "assistant"
                 self._first_sent[index] = True
             choice = {"index": index, "delta": delta, "finish_reason": None}
+            if logprob_entries:
+                choice["logprobs"] = {"content": logprob_entries}
         else:
             choice = {"index": index, "text": text, "finish_reason": None}
+            if logprob_entries:
+                choice["logprobs"] = completion_logprobs(logprob_entries)
         return self._chunk([choice])
 
     def finish_chunk(self, reason: FinishReason, index: int = 0) -> dict[str, Any]:

@@ -1,0 +1,178 @@
+"""Logprobs end-to-end (reference protocols/openai logprobs plumbing +
+engines.rs logprobs): fused-step computation on the engine, token-string
+entries in the backend, OpenAI shapes over HTTP (unary + SSE).
+"""
+import json
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from dynamo_tpu.backend import Backend
+from dynamo_tpu.engine.config import EngineConfig
+from dynamo_tpu.engine.engine import TpuEngine
+from dynamo_tpu.frontend import HttpService, ModelChain, ModelManager
+from dynamo_tpu.mocker import MockerArgs, MockerEngine
+from dynamo_tpu.models import llama
+from dynamo_tpu.models.config import ModelConfig
+from dynamo_tpu.parallel.mesh import MeshConfig
+from dynamo_tpu.preprocessor import OpenAIPreprocessor, PromptFormatter
+from dynamo_tpu.protocols.common import (
+    OutputOptions,
+    PreprocessedRequest,
+    StopConditions,
+)
+from dynamo_tpu.protocols.sse import SseDecoder
+from dynamo_tpu.tokenizer import make_test_tokenizer
+
+PS = 16
+WORDS = [f"w{i}" for i in range(100)]
+
+
+# ---------------------------------------------------------------------------
+# engine level
+
+
+async def test_engine_logprobs_greedy_consistency():
+    cfg = ModelConfig.tiny(dtype="float32")
+    ecfg = EngineConfig(
+        num_pages=32, page_size=PS, max_pages_per_seq=8,
+        max_decode_slots=2, prefill_buckets=(32, 64),
+        cache_dtype="float32", max_logprobs=5,
+    )
+    eng = TpuEngine(cfg, ecfg, params=llama.init_params(cfg, 0),
+                    mesh_config=MeshConfig(tp=1))
+    req = PreprocessedRequest(
+        token_ids=list(range(1, 20)),
+        stop_conditions=StopConditions(max_tokens=6, ignore_eos=True),
+        output_options=OutputOptions(logprobs=3),
+    )
+    outs = []
+    async for out in eng.generate(req):
+        if out.token_ids:
+            outs.append(out)
+    assert len(outs) == 6
+    for out in outs:
+        assert out.log_probs is not None and len(out.log_probs) == 1
+        assert out.top_logprobs is not None and len(out.top_logprobs) == 1
+        tops = out.top_logprobs[0]
+        assert len(tops) == 3
+        # greedy: the chosen token IS the top-1 alternative, same logprob
+        assert tops[0][0] == out.token_ids[0]
+        assert abs(tops[0][1] - out.log_probs[0]) < 1e-5
+        assert out.log_probs[0] <= 0.0
+        # top list is sorted descending
+        assert tops[0][1] >= tops[1][1] >= tops[2][1]
+
+    # requests NOT asking for logprobs don't get them
+    req2 = PreprocessedRequest(
+        token_ids=list(range(1, 20)),
+        stop_conditions=StopConditions(max_tokens=3, ignore_eos=True),
+    )
+    async for out in eng.generate(req2):
+        assert out.log_probs is None
+    await eng.stop()
+
+
+# ---------------------------------------------------------------------------
+# HTTP level (mocker synthesizes shaped logprobs)
+
+
+def make_mock_service() -> HttpService:
+    tok = make_test_tokenizer(WORDS)
+    fmt = PromptFormatter(
+        template="{% for m in messages %}{{ m.content }} {% endfor %}"
+    )
+    chain = ModelChain(
+        name="mock",
+        preprocessor=OpenAIPreprocessor(
+            tokenizer=tok, formatter=fmt, model_name="mock"
+        ),
+        engine=MockerEngine(MockerArgs(speedup_ratio=100.0, page_size=4)),
+        backend=Backend(tok),
+    )
+    manager = ModelManager()
+    manager.register(chain)
+    return HttpService(manager)
+
+
+async def test_http_chat_logprobs_unary():
+    svc = make_mock_service()
+    client = TestClient(TestServer(svc.app))
+    await client.start_server()
+    r = await client.post("/v1/chat/completions", json={
+        "model": "mock",
+        "messages": [{"role": "user", "content": "w1 w2 w3"}],
+        "max_tokens": 4,
+        "logprobs": True,
+        "top_logprobs": 2,
+    })
+    assert r.status == 200
+    body = await r.json()
+    lp = body["choices"][0]["logprobs"]
+    assert lp is not None and "content" in lp
+    assert len(lp["content"]) == 4
+    for entry in lp["content"]:
+        assert set(entry) >= {"token", "logprob", "bytes", "top_logprobs"}
+        assert isinstance(entry["token"], str)
+        assert entry["logprob"] <= 0.0
+        assert len(entry["top_logprobs"]) == 2
+        for t in entry["top_logprobs"]:
+            assert set(t) >= {"token", "logprob"}
+    # without the flag: null logprobs
+    r2 = await client.post("/v1/chat/completions", json={
+        "model": "mock",
+        "messages": [{"role": "user", "content": "w1"}],
+        "max_tokens": 2,
+    })
+    assert (await r2.json())["choices"][0]["logprobs"] is None
+    await client.close()
+
+
+async def test_http_completions_logprobs_unary():
+    svc = make_mock_service()
+    client = TestClient(TestServer(svc.app))
+    await client.start_server()
+    r = await client.post("/v1/completions", json={
+        "model": "mock",
+        "prompt": "w1 w2 w3",
+        "max_tokens": 3,
+        "logprobs": 2,
+    })
+    assert r.status == 200
+    body = await r.json()
+    lp = body["choices"][0]["logprobs"]
+    assert lp is not None
+    assert len(lp["tokens"]) == 3
+    assert len(lp["token_logprobs"]) == 3
+    assert all(v <= 0 for v in lp["token_logprobs"])
+    assert len(lp["top_logprobs"]) == 3
+    assert all(isinstance(d, dict) and len(d) == 2 for d in lp["top_logprobs"])
+    await client.close()
+
+
+async def test_http_chat_logprobs_streaming():
+    svc = make_mock_service()
+    client = TestClient(TestServer(svc.app))
+    await client.start_server()
+    r = await client.post("/v1/chat/completions", json={
+        "model": "mock",
+        "messages": [{"role": "user", "content": "w1 w2 w3"}],
+        "max_tokens": 4,
+        "logprobs": True,
+        "top_logprobs": 1,
+        "stream": True,
+    })
+    assert r.status == 200
+    dec = SseDecoder()
+    entries = []
+    for ev in dec.feed(await r.read()):
+        if ev.is_done:
+            continue
+        chunk = json.loads(ev.data)
+        for choice in chunk.get("choices", []):
+            if choice.get("logprobs"):
+                entries.extend(choice["logprobs"]["content"])
+    assert len(entries) == 4
+    assert all(e["logprob"] <= 0 and len(e["top_logprobs"]) == 1
+               for e in entries)
+    await client.close()

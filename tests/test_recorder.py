@@ -1,0 +1,78 @@
+"""Recorder record/replay tests (reference recorder.rs:447-511 round-trip
+test strategy). Keystone: record the KV-event stream of a live mocker run,
+replay it into a fresh indexer, and get IDENTICAL overlap scores."""
+import asyncio
+import os
+
+from dynamo_tpu.kv_router.indexer import KvIndexer
+from dynamo_tpu.mocker import MockerArgs, MockerEngine
+from dynamo_tpu.protocols.common import PreprocessedRequest, StopConditions
+from dynamo_tpu.recorder import KvRecorder, Recorder
+from dynamo_tpu.tokens import compute_block_hashes
+
+BS = 4
+
+
+def test_recorder_rotation(tmp_path):
+    path = str(tmp_path / "ev.jsonl")
+    rec = Recorder(path, max_lines=3, max_files=3)
+    for i in range(8):
+        rec.record({"i": i})
+    rec.close()
+    # 8 events, 3/file: current has 2 (6,7), .1 has 3 (3,4,5), .2 has (0,1,2)
+    assert [e["i"] for _, e in Recorder.iter_events(path)] == [6, 7]
+    assert [e["i"] for _, e in Recorder.iter_events(path + ".1")] == [3, 4, 5]
+    assert [e["i"] for _, e in Recorder.iter_events(path + ".2")] == [0, 1, 2]
+
+
+def test_recorder_skips_corrupt_lines(tmp_path):
+    path = str(tmp_path / "ev.jsonl")
+    rec = Recorder(path)
+    rec.record({"ok": 1})
+    rec.close()
+    with open(path, "a") as f:
+        f.write("not json at all\n")
+    rec2 = Recorder(path)
+    rec2.record({"ok": 2})
+    rec2.close()
+    events = [e for _, e in Recorder.iter_events(path)]
+    assert events == [{"ok": 1}, {"ok": 2}]
+
+
+async def test_kv_record_replay_identical_scores(tmp_path):
+    path = str(tmp_path / "kv.jsonl")
+    recorder = KvRecorder(path)
+    live = KvIndexer(BS)
+
+    def tee(ev):
+        recorder(ev)
+        live.apply_event(ev)
+
+    eng = MockerEngine(
+        MockerArgs(speedup_ratio=100.0, page_size=BS, num_pages=32,
+                   worker_id="w0"),
+        on_kv_event=tee,
+    )
+    prompts = [
+        list(range(1, 30)),
+        list(range(1, 18)) + [99, 98],      # shared prefix, divergent tail
+        list(range(50, 75)),
+    ]
+    for p in prompts:
+        async for _ in eng.generate(PreprocessedRequest(
+            token_ids=list(p),
+            stop_conditions=StopConditions(max_tokens=6, ignore_eos=True),
+        )):
+            pass
+    await eng.stop()
+    recorder.close()
+    assert recorder.recorder.recorded > 0
+
+    # replay into a FRESH indexer: identical overlap scores for any query
+    replayed = KvIndexer(BS)
+    n = KvRecorder.replay(path, replayed)
+    assert n == recorder.recorder.recorded
+    for p in prompts + [list(range(1, 12)), list(range(60, 80))]:
+        hashes = compute_block_hashes(p, BS)
+        assert replayed.find_matches(hashes).scores == \
+            live.find_matches(hashes).scores
