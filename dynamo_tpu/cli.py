@@ -7,6 +7,13 @@ Subcommands:
          in=endpoint, frontends in=http discover models dynamically)
   cp    run the control-plane store (native dcp-server if built, else the
         wire-compatible Python fallback): cp --port 7111
+  serve    launch a whole serving graph (store+workers+frontend) from a
+        YAML/JSON file with restart-on-exit + graceful drain
+        (reference `dynamo serve`): serve graph.yaml
+  metrics  standalone Prometheus re-exporter of the worker load plane
+        (reference components/metrics): metrics --control-plane HOST:PORT
+  planner  load-based autoscaler managing a local worker pool
+        (reference components/planner): planner --control-plane HOST:PORT
 """
 from __future__ import annotations
 
@@ -28,7 +35,10 @@ def _run_cp(rest: list[str]) -> int:
         os.path.dirname(__file__), "native", "build", "dcp-server"
     )
     if not args.python and os.path.exists(native):
-        return subprocess.call([native, str(args.port)])
+        # exec (not subprocess): signals sent to this process must reach
+        # the actual server — a supervisor's SIGTERM would otherwise kill
+        # only the wrapper and orphan the store
+        os.execv(native, [native, str(args.port)])
 
     import asyncio
 
@@ -59,8 +69,65 @@ def main(argv: list[str] | None = None) -> int:
         return run_cli(rest)
     if cmd == "cp":
         return _run_cp(rest)
+    if cmd == "serve":
+        import asyncio
+
+        if not rest:
+            print("usage: dynamo-tpu serve <graph.yaml>", file=sys.stderr)
+            return 2
+        from dynamo_tpu.launch.serve import serve_main
+
+        try:
+            return asyncio.run(serve_main(rest[0]))
+        except KeyboardInterrupt:
+            return 0
+    if cmd == "metrics":
+        return _run_metrics(rest)
+    if cmd == "planner":
+        return _run_planner(rest)
     print(f"dynamo-tpu: unknown subcommand {cmd!r}", file=sys.stderr)
     return 2
+
+
+def _run_metrics(rest: list[str]) -> int:
+    import argparse
+    import asyncio
+
+    p = argparse.ArgumentParser(prog="dynamo-tpu metrics")
+    p.add_argument("--control-plane", required=True, metavar="HOST:PORT")
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=9090)
+    args = p.parse_args(rest)
+    from dynamo_tpu.metrics_exporter import run_exporter
+
+    try:
+        asyncio.run(run_exporter(args))
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+def _run_planner(rest: list[str]) -> int:
+    import argparse
+    import asyncio
+
+    p = argparse.ArgumentParser(prog="dynamo-tpu planner")
+    p.add_argument("--control-plane", required=True, metavar="HOST:PORT")
+    p.add_argument("--engine", default="mocker",
+                   help="worker engine for spawned replicas")
+    p.add_argument("--model-name", default="model")
+    p.add_argument("--namespace", default="dynamo")
+    p.add_argument("--adjustment-interval", type=float, default=10.0)
+    p.add_argument("--min-replicas", type=int, default=1)
+    p.add_argument("--max-replicas", type=int, default=8)
+    args = p.parse_args(rest)
+    from dynamo_tpu.planner import run_planner
+
+    try:
+        asyncio.run(run_planner(args))
+    except KeyboardInterrupt:
+        pass
+    return 0
 
 
 if __name__ == "__main__":

@@ -322,6 +322,14 @@ class DisaggDecodeEngine:
     def allocator(self):
         return self.engine.allocator
 
+    @property
+    def on_metrics(self):
+        return self.engine.on_metrics
+
+    @on_metrics.setter
+    def on_metrics(self, sink):
+        self.engine.on_metrics = sink
+
     def start(self) -> None:
         start = getattr(self.engine, "start", None)
         if start is not None:

@@ -95,6 +95,14 @@ async def register_llm(
         allocator.worker_id = str(served.lease_id)
         allocator.on_event = pub
         served.kv_publisher = pub
+    # load-metrics plane (planner + standalone exporter consume this)
+    if hasattr(engine, "on_metrics"):
+        from dynamo_tpu.runtime.publisher import WorkerMetricsPublisher
+
+        mpub = WorkerMetricsPublisher(rt.kv, str(served.lease_id))
+        mpub.start()
+        engine.on_metrics = mpub
+        served.metrics_publisher = mpub
     return served
 
 

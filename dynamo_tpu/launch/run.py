@@ -464,7 +464,9 @@ async def _serve_http_dynamic(args) -> None:
 
 
 def run_cli(argv: list[str]) -> int:
-    args = build_parser().parse_args(argv)
+    # intermixed: in=/out= positionals may appear between/after flags
+    # (graph files and scripts compose argv in any order)
+    args = build_parser().parse_intermixed_args(argv)
     inp, _ = _parse_io(args.io)
     try:
         if inp == "http" and args.control_plane:

@@ -1,0 +1,202 @@
+"""Load-based planner v0: observe worker load, scale the fleet.
+
+Parity: reference components/planner load-based mode
+(utils/planner_core.py:51,131-168): a control loop that every
+``adjustment_interval_s`` observes aggregated worker metrics, decides a
+replica count against KV-usage and queue-depth thresholds, and asks a
+connector to realize it — LocalConnector spawns/retires ``in=endpoint``
+worker subprocesses (the circus-watcher equivalent,
+local_connector.py:310); a k8s connector would patch replicas instead.
+
+Scale-up when (avg KV usage > kv_usage_scale_up) OR (total waiting >
+waiting_scale_up); scale-down when BOTH avg usage < kv_usage_scale_down
+AND waiting == 0. One step per interval, clamped to [min, max]; downscale
+requires ``stable_intervals`` consecutive low observations so transient
+dips don't flap the fleet.
+"""
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import subprocess
+import sys
+import time
+from dataclasses import dataclass, field
+from typing import Any, Optional, Protocol
+
+from dynamo_tpu.kv_router.metrics_aggregator import MetricsAggregator
+from dynamo_tpu.kv_router.protocols import ForwardPassMetrics
+from dynamo_tpu.runtime.client import KvClient
+from dynamo_tpu.runtime.publisher import METRICS_TOPIC
+
+log = logging.getLogger(__name__)
+
+
+@dataclass
+class PlannerConfig:
+    adjustment_interval_s: float = 10.0
+    kv_usage_scale_up: float = 0.8
+    kv_usage_scale_down: float = 0.3
+    waiting_scale_up: int = 4
+    min_replicas: int = 1
+    max_replicas: int = 8
+    stable_intervals: int = 2    # consecutive low loads before downscale
+    metrics_stale_after_s: float = 15.0
+
+
+class Connector(Protocol):
+    """Realizes a replica count (LocalConnector / KubernetesConnector)."""
+
+    def current_replicas(self) -> int: ...
+
+    async def set_replicas(self, n: int) -> None: ...
+
+
+class LocalConnector:
+    """Worker pool as local subprocesses of the dynamo-tpu CLI (circus-
+    arbiter equivalent). Retirement is newest-first SIGTERM — the worker's
+    lease revocation deregisters it and in-flight requests drain."""
+
+    def __init__(self, worker_cmd: list[str]):
+        # e.g. [sys.executable, "-m", "dynamo_tpu.cli", "run",
+        #       "in=endpoint", "out=mocker", "--control-plane", addr, ...]
+        self.worker_cmd = list(worker_cmd)
+        self.procs: list[subprocess.Popen] = []
+
+    def current_replicas(self) -> int:
+        self.procs = [p for p in self.procs if p.poll() is None]
+        return len(self.procs)
+
+    async def set_replicas(self, n: int) -> None:
+        self.current_replicas()  # reap exited
+        while len(self.procs) < n:
+            proc = subprocess.Popen(
+                self.worker_cmd,
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+                start_new_session=True,
+            )
+            self.procs.append(proc)
+            log.info("planner: spawned worker pid %d", proc.pid)
+        while len(self.procs) > n:
+            proc = self.procs.pop()
+            log.info("planner: retiring worker pid %d", proc.pid)
+            proc.terminate()
+
+    async def shutdown(self) -> None:
+        procs = list(self.procs)  # set_replicas(0) empties self.procs
+        await self.set_replicas(0)
+        for p in procs:
+            if p.poll() is None:
+                p.kill()  # backstop for workers ignoring SIGTERM
+
+
+class Planner:
+    """The observe -> decide -> scale loop (planner_core.py:131-168)."""
+
+    def __init__(
+        self,
+        kv: KvClient,
+        connector: Connector,
+        config: Optional[PlannerConfig] = None,
+    ):
+        self.kv = kv
+        self.connector = connector
+        self.config = config or PlannerConfig()
+        self.aggregator = MetricsAggregator(
+            stale_after_s=self.config.metrics_stale_after_s
+        )
+        self.decisions: list[tuple[float, int]] = []  # (ts, target) history
+        self._low_streak = 0
+        self._task: Optional[asyncio.Task] = None
+        self._sub_task: Optional[asyncio.Task] = None
+
+    async def start(self) -> "Planner":
+        sub = await self.kv.subscribe(f"{METRICS_TOPIC}.>")
+        self._sub_task = asyncio.get_running_loop().create_task(
+            self._follow(sub)
+        )
+        self._task = asyncio.get_running_loop().create_task(self._loop())
+        return self
+
+    async def stop(self) -> None:
+        for t in (self._task, self._sub_task):
+            if t is not None:
+                t.cancel()
+        self._task = self._sub_task = None
+
+    async def _follow(self, sub) -> None:
+        async for ev in sub:
+            try:
+                m = ForwardPassMetrics.from_dict(json.loads(ev["value"]))
+            except (KeyError, ValueError, TypeError):
+                continue
+            self.aggregator.update(m)
+
+    async def _loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.config.adjustment_interval_s)
+            try:
+                await self.adjust()
+            except Exception:  # noqa: BLE001 — the loop must survive
+                log.exception("planner adjustment failed")
+
+    def decide(self) -> int:
+        """Pure decision from the current snapshot (unit-testable)."""
+        c = self.config
+        snap = self.aggregator.snapshot()
+        current = self.connector.current_replicas()
+        usage = snap.load_avg()
+        waiting = sum(
+            m.worker_stats.num_requests_waiting
+            for m in snap.metrics.values()
+        )
+        target = current
+        if usage > c.kv_usage_scale_up or waiting > c.waiting_scale_up:
+            target = current + 1
+            self._low_streak = 0
+        elif usage < c.kv_usage_scale_down and waiting == 0:
+            self._low_streak += 1
+            if self._low_streak >= c.stable_intervals:
+                target = current - 1
+                self._low_streak = 0
+        else:
+            self._low_streak = 0
+        return max(c.min_replicas, min(c.max_replicas, target))
+
+    async def adjust(self) -> int:
+        target = self.decide()
+        current = self.connector.current_replicas()
+        if target != current:
+            log.info("planner: scaling %d -> %d", current, target)
+            await self.connector.set_replicas(target)
+        self.decisions.append((time.monotonic(), target))
+        return target
+
+
+async def run_planner(args) -> None:
+    """CLI entry: planner over a local worker pool."""
+    host, _, port = args.control_plane.partition(":")
+    kv = await KvClient(host or "127.0.0.1", int(port or 7111)).connect()
+    worker_cmd = [sys.executable, "-m", "dynamo_tpu.cli", "run",
+                  "in=endpoint", f"out={args.engine}",
+                  "--control-plane", args.control_plane,
+                  "--model-name", args.model_name,
+                  "--namespace", args.namespace]
+    connector = LocalConnector(worker_cmd)
+    cfg = PlannerConfig(
+        adjustment_interval_s=args.adjustment_interval,
+        min_replicas=args.min_replicas,
+        max_replicas=args.max_replicas,
+    )
+    await connector.set_replicas(cfg.min_replicas)
+    planner = await Planner(kv, connector, cfg).start()
+    print(f"planner managing '{args.model_name}' workers "
+          f"[{cfg.min_replicas}, {cfg.max_replicas}]")
+    try:
+        while True:
+            await asyncio.sleep(3600)
+    finally:
+        await planner.stop()
+        await connector.shutdown()
+        await kv.close()

@@ -89,12 +89,55 @@ class KvEventPublisher(_TopicPublisher):
 
 
 class WorkerMetricsPublisher(_TopicPublisher):
-    """Callable sink for engine on_metrics (publisher.rs:463)."""
+    """Callable sink for engine on_metrics (publisher.rs:463). Engines
+    fire per scheduling round; publishes are throttled to min_interval_s
+    so the event plane carries load snapshots, not a per-round firehose."""
 
-    def __init__(self, kv: KvClient, worker_id: str):
+    def __init__(self, kv: KvClient, worker_id: str,
+                 min_interval_s: float = 0.25):
         super().__init__(kv, f"{METRICS_TOPIC}.{worker_id}")
         self.worker_id = worker_id
+        self.min_interval_s = min_interval_s
+        self._last = 0.0
+        self._pending: Optional[dict] = None
+        self._flush_task: Optional[asyncio.Task] = None
+
+    def start(self) -> None:
+        super().start()
+        if self._flush_task is None:
+            self._flush_task = self._loop.create_task(self._flush_pending())
+
+    async def stop(self) -> None:
+        if self._flush_task is not None:
+            self._flush_task.cancel()
+            self._flush_task = None
+        await super().stop()
 
     def __call__(self, metrics: ForwardPassMetrics) -> None:
+        import time
+
         metrics.worker_id = self.worker_id
-        self.offer(metrics.to_dict())
+        payload = metrics.to_dict()
+        now = time.monotonic()
+        if now - self._last < self.min_interval_s and self.min_interval_s > 0:
+            # trailing sample: remembered and flushed by the timer — the
+            # LAST snapshot (e.g. "now idle") must eventually publish even
+            # if the engine goes quiet right after it
+            self._pending = payload
+            return
+        self._last = now
+        self._pending = None
+        self.offer(payload)
+
+    async def _flush_pending(self) -> None:
+        import time
+
+        while True:
+            await asyncio.sleep(max(self.min_interval_s, 0.05))
+            p = self._pending
+            if p is not None and (
+                time.monotonic() - self._last >= self.min_interval_s
+            ):
+                self._pending = None
+                self._last = time.monotonic()
+                self.offer(p)

@@ -34,6 +34,9 @@ jax.config.update("jax_default_matmul_precision", "highest")
 
 def pytest_configure(config):
     config.addinivalue_line("markers", "asyncio: async test")
+    config.addinivalue_line(
+        "markers", "asyncio_timeout(seconds): override the 120s default"
+    )
 
 
 def pytest_collection_modifyitems(items):
@@ -51,6 +54,8 @@ def pytest_pyfunc_call(pyfuncitem):
             name: pyfuncitem.funcargs[name]
             for name in pyfuncitem._fixtureinfo.argnames
         }
-        asyncio.run(asyncio.wait_for(fn(**kwargs), timeout=120))
+        marker = pyfuncitem.get_closest_marker("asyncio_timeout")
+        timeout = marker.args[0] if marker else 120
+        asyncio.run(asyncio.wait_for(fn(**kwargs), timeout=timeout))
         return True
     return None
