@@ -58,6 +58,9 @@ def _run_cp(rest: list[str]) -> int:
 
 
 def main(argv: list[str] | None = None) -> int:
+    from dynamo_tpu.config import init_logging
+
+    init_logging()
     argv = list(sys.argv[1:] if argv is None else argv)
     if not argv or argv[0] in ("-h", "--help"):
         print(__doc__)

@@ -1,0 +1,156 @@
+"""Runtime configuration + logging initialization.
+
+Parity: reference lib/runtime/src/config.rs:44,103-127 — figment layering
+(defaults <- TOML file <- ``DYN_RUNTIME_*`` env) — and logging.rs:24-62 —
+``DYN_LOG`` level filter, ``DYN_LOGGING_JSONL`` structured mode.
+
+Here: dataclass defaults <- TOML file (``DYNTPU_CONFIG`` or ./dynamo_tpu
+.toml) <- ``DYNTPU_*`` environment variables. Logging:
+
+    DYNTPU_LOG=debug            root level (or "pkg=debug,other=info")
+    DYNTPU_LOGGING_JSONL=1      one JSON object per line
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import os
+import sys
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+log = logging.getLogger(__name__)
+
+ENV_PREFIX = "DYNTPU_"
+
+
+@dataclass
+class RuntimeConfig:
+    """Process-wide runtime knobs (RuntimeConfig, config.rs:44).
+
+    ``control_plane`` is None unless a file/env layer sets it — it doubles
+    as the discovery-mode opt-in, so a baked-in default would silently
+    flip every invocation into distributed mode."""
+
+    control_plane: Optional[str] = None
+    namespace: str = "dynamo"
+    http_host: str = "0.0.0.0"
+    http_port: int = 8080
+    # worker defaults
+    page_size: int = 64
+    num_pages: int = 512
+    max_decode_slots: int = 8
+    cache_dtype: str = "bfloat16"
+    host_offload_pages: int = 0
+
+    @property
+    def store_host_port(self) -> tuple[str, int]:
+        host, _, port = (self.control_plane or "").partition(":")
+        return host or "127.0.0.1", int(port or 7111)
+
+
+def _coerce(value: str, target_type) -> Any:
+    if target_type is bool:
+        return value.lower() in ("1", "true", "yes", "on")
+    if target_type is int:
+        return int(value)
+    if target_type is float:
+        return float(value)
+    return value
+
+
+def load_config(
+    path: Optional[str] = None, env: Optional[dict[str, str]] = None
+) -> RuntimeConfig:
+    """defaults <- TOML file <- DYNTPU_* env (later layers win). The cwd
+    fallback file (./dynamo_tpu.toml) applies only under the real process
+    environment — an explicit ``env`` asks for isolation."""
+    from_process_env = env is None
+    env = os.environ if env is None else env
+    values: dict[str, Any] = {}
+
+    path = path or env.get(ENV_PREFIX + "CONFIG")
+    if path is None and from_process_env and os.path.exists("dynamo_tpu.toml"):
+        path = "dynamo_tpu.toml"
+    if path:
+        import tomllib
+
+        with open(path, "rb") as f:
+            data = tomllib.load(f)
+        section = data.get("runtime", data)  # [runtime] table or flat
+        for f_ in dataclasses.fields(RuntimeConfig):
+            if f_.name in section:
+                values[f_.name] = section[f_.name]
+
+    for f_ in dataclasses.fields(RuntimeConfig):
+        key = ENV_PREFIX + f_.name.upper()
+        if key in env:
+            # field types are stringified (future annotations); the
+            # default value's concrete type is the coercion target
+            try:
+                values[f_.name] = _coerce(env[key], type(f_.default))
+            except ValueError:
+                log.warning("ignoring invalid %s=%r", key, env[key])
+    return RuntimeConfig(**values)
+
+
+# ---------------------------------------------------------------------------
+# logging (logging.rs:24-62)
+
+
+class JsonlFormatter(logging.Formatter):
+    def format(self, record: logging.LogRecord) -> str:
+        out = {
+            "ts": self.formatTime(record, "%Y-%m-%dT%H:%M:%S"),
+            "level": record.levelname,
+            "logger": record.name,
+            "msg": record.getMessage(),
+        }
+        if record.exc_info:
+            out["exc"] = self.formatException(record.exc_info)
+        return json.dumps(out, separators=(",", ":"))
+
+
+def init_logging(env: Optional[dict[str, str]] = None) -> None:
+    """Configure root logging from DYNTPU_LOG / DYNTPU_LOGGING_JSONL.
+    Idempotent; a pre-configured root (tests, embedders) is respected."""
+    env = os.environ if env is None else env
+    root = logging.getLogger()
+    if root.handlers:
+        _apply_filters(env.get(ENV_PREFIX + "LOG", ""), root)
+        return
+
+    handler = logging.StreamHandler(sys.stderr)
+    if env.get(ENV_PREFIX + "LOGGING_JSONL", "").lower() in ("1", "true"):
+        handler.setFormatter(JsonlFormatter())
+    else:
+        handler.setFormatter(logging.Formatter(
+            "%(asctime)s %(levelname)s %(name)s %(message)s"
+        ))
+    root.addHandler(handler)
+    root.setLevel(logging.INFO)
+    _apply_filters(env.get(ENV_PREFIX + "LOG", ""), root)
+    # jax is chatty at INFO in some builds
+    logging.getLogger("jax").setLevel(logging.WARNING)
+
+
+def _apply_filters(spec: str, root: logging.Logger) -> None:
+    """'debug' or 'dynamo_tpu=debug,aiohttp=warning' (DYN_LOG shape)."""
+    if not spec:
+        return
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        try:
+            if "=" in part:
+                name, _, level = part.partition("=")
+                logging.getLogger(name.strip()).setLevel(
+                    level.strip().upper()
+                )
+            else:
+                root.setLevel(part.upper())
+        except ValueError:
+            # a typo'd level must not crash every CLI invocation
+            log.warning("ignoring invalid log filter %r", part)

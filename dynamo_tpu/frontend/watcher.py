@@ -15,6 +15,7 @@ from __future__ import annotations
 import asyncio
 import json
 import logging
+from collections import deque
 from dataclasses import asdict, dataclass, field
 from typing import Any, Optional
 
@@ -89,6 +90,10 @@ async def register_llm(
     await rt.kv.put(key, entry.to_json(), lease=served.lease_id)
 
     allocator = getattr(engine, "allocator", None)
+    if entry.router_mode != "kv":
+        # only KV-routed models have indexers consuming these events;
+        # publishing for others just pollutes the event plane
+        publish_kv_events = False
     if publish_kv_events and allocator is not None:
         pub = KvEventPublisher(rt.kv, str(served.lease_id))
         pub.start()
@@ -127,6 +132,8 @@ class ModelWatcher:
         self._chains: dict[str, Any] = {}
         self._kv_sub_task: Optional[asyncio.Task] = None
         self._routers: dict[str, KvPushRouter] = {}
+        # KV events that raced worker discovery, replayed on sync
+        self._unclaimed_events: deque = deque(maxlen=4096)
 
     async def start(self) -> "ModelWatcher":
         prefix = f"dynamo://{self.namespace}/{MODEL_PREFIX}"
@@ -153,8 +160,11 @@ class ModelWatcher:
                 log.exception("model watcher failed applying %s", ev)
 
     async def _follow_kv_events(self) -> None:
-        """Feed worker KV events into every kv-router's indexer
-        (reference: NATS kv_events subject -> KvIndexer)."""
+        """Feed worker KV events into the indexer of the router that OWNS
+        that worker (reference: NATS kv_events subject -> KvIndexer).
+        Broadcast-to-all would accumulate unbounded foreign-worker state in
+        every model's indexer; events for a not-yet-discovered worker wait
+        in a bounded buffer and are replayed when the worker appears."""
         sub = await self.rt.kv.subscribe(f"{KV_EVENTS_TOPIC}.>")
         async for ev in sub:
             try:
@@ -168,8 +178,42 @@ class ModelWatcher:
                     # never take down routing; disable and keep going
                     log.exception("kv recorder failed; disabling recording")
                     self.kv_recorder = None
-            for router in self._routers.values():
+            self._route_kv_event(event)
+
+    def _route_kv_event(self, event: KvCacheEvent, *,
+                        buffer_unclaimed: bool = True) -> bool:
+        """Apply to EVERY router owning the worker (a legacy untagged
+        instance can be in several models' routers). Returns claimed."""
+        claimed = False
+        for router in self._routers.values():
+            if event.worker_id in router.workers:
                 router.router.indexer.apply_event(event)
+                claimed = True
+        if not claimed and buffer_unclaimed:
+            # worker not discovered yet (event raced registration): buffer
+            import time as _time
+
+            self._unclaimed_events.append((_time.monotonic(), event))
+        return claimed
+
+    def _replay_unclaimed(self) -> None:
+        """Called after a router gains workers: re-route buffered events.
+        Entries older than the TTL are dropped — they belong to workers
+        that will never be claimed (departed, or non-kv models), and must
+        not evict genuinely raced events."""
+        if not self._unclaimed_events:
+            return
+        import time as _time
+
+        now = _time.monotonic()
+        pending, self._unclaimed_events = self._unclaimed_events, deque(
+            maxlen=self._unclaimed_events.maxlen
+        )
+        for ts, event in pending:
+            if now - ts > 30.0:
+                continue
+            if not self._route_kv_event(event, buffer_unclaimed=False):
+                self._unclaimed_events.append((ts, event))
 
     async def _apply(self, event: str, key: str, value: Optional[str]) -> None:
         # key: dynamo://{ns}/_models/{name}/{lease_id}
@@ -217,12 +261,16 @@ class ModelWatcher:
                 for wid in list(push.workers):
                     if wid not in current:
                         push.remove_worker(wid)
+                added = False
                 for inst in instances:
                     wid = str(inst.id)
                     if wid not in push.workers:
                         push.add_worker(
                             wid, RemoteWorkerEngine(client, inst.id)
                         )
+                        added = True
+                if added:
+                    self._replay_unclaimed()
 
             client.on_change = sync_workers
             sync_workers(list(client.instances.values()))

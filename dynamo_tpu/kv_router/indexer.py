@@ -70,6 +70,10 @@ class KvIndexer:
         elif event.kind == KvEventKind.CLEARED:
             self.remove_worker(w)
 
+    def total_blocks(self) -> int:
+        """Distinct block hashes currently indexed (observability)."""
+        return len(self._workers)
+
     def remove_worker(self, worker_id: WorkerId) -> None:
         """Worker left (lease expired) — drop all its blocks
         (indexer.rs remove_worker)."""

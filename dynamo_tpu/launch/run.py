@@ -26,6 +26,11 @@ from typing import Any, Optional
 
 
 def build_parser() -> argparse.ArgumentParser:
+    # layered defaults: dataclass <- TOML <- DYNTPU_* env <- CLI flags
+    # (reference figment layering, config.rs:103-127)
+    from dynamo_tpu.config import load_config
+
+    cfg = load_config()
     p = argparse.ArgumentParser(
         prog="dynamo-tpu run",
         description="Run a dynamo-tpu serving graph",
@@ -35,22 +40,28 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--model-name", default=None, help="served model name")
     p.add_argument("--model-config", default=None,
                    help="canned config (tiny|llama3_1b|llama3_8b|llama3_70b) for random-weight serving")
-    p.add_argument("--http-host", default="0.0.0.0")
-    p.add_argument("--http-port", type=int, default=8080)
+    p.add_argument("--http-host", default=cfg.http_host)
+    p.add_argument("--http-port", type=int, default=cfg.http_port)
     p.add_argument("--prompt", default=None, help="prompt for in=text")
     p.add_argument("--max-tokens", type=int, default=64)
     p.add_argument("--tensor-parallel-size", type=int, default=1)
-    p.add_argument("--num-pages", type=int, default=512)
-    p.add_argument("--page-size", type=int, default=64)
-    p.add_argument("--max-decode-slots", type=int, default=8)
-    p.add_argument("--cache-dtype", default="bfloat16")
-    p.add_argument("--host-offload-pages", type=int, default=0,
+    p.add_argument("--num-pages", type=int, default=cfg.num_pages)
+    p.add_argument("--page-size", type=int, default=cfg.page_size)
+    p.add_argument("--max-decode-slots", type=int,
+                   default=cfg.max_decode_slots)
+    p.add_argument("--cache-dtype", default=cfg.cache_dtype)
+    p.add_argument("--host-offload-pages", type=int,
+                   default=cfg.host_offload_pages,
                    help="host-DRAM KV offload tier capacity in pages "
                         "(KVBM G2); 0 disables")
-    # distributed mode (reference: etcd/NATS endpoints; here the dcp store)
-    p.add_argument("--control-plane", default=None, metavar="HOST:PORT",
+    # distributed mode (reference: etcd/NATS endpoints; here the dcp store).
+    # --control-plane default stays None (it's the discovery-mode switch);
+    # RuntimeConfig.control_plane is None unless the config file or
+    # DYNTPU_CONTROL_PLANE opted in explicitly.
+    p.add_argument("--control-plane", default=cfg.control_plane,
+                   metavar="HOST:PORT",
                    help="control-plane store address; enables discovery")
-    p.add_argument("--namespace", default="dynamo")
+    p.add_argument("--namespace", default=cfg.namespace)
     p.add_argument("--component", default="backend")
     p.add_argument("--endpoint-name", default="generate")
     p.add_argument("--router-mode", default="kv",

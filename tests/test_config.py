@@ -1,0 +1,62 @@
+"""Config layering + logging init tests (reference config.rs figment
+layering and logging.rs DYN_LOG filters)."""
+import json
+import logging
+
+from dynamo_tpu.config import (
+    JsonlFormatter,
+    RuntimeConfig,
+    _apply_filters,
+    load_config,
+)
+
+
+def test_defaults():
+    cfg = load_config(env={})
+    assert cfg == RuntimeConfig()
+    assert cfg.store_host_port == ("127.0.0.1", 7111)
+
+
+def test_toml_layer(tmp_path):
+    p = tmp_path / "conf.toml"
+    p.write_text("""
+[runtime]
+control_plane = "10.0.0.9:7222"
+page_size = 32
+""")
+    cfg = load_config(path=str(p), env={})
+    assert cfg.control_plane == "10.0.0.9:7222"
+    assert cfg.page_size == 32
+    assert cfg.num_pages == 512  # untouched default
+
+
+def test_env_overrides_toml(tmp_path):
+    p = tmp_path / "conf.toml"
+    p.write_text('[runtime]\npage_size = 32\nnamespace = "from-toml"\n')
+    cfg = load_config(env={
+        "DYNTPU_CONFIG": str(p),
+        "DYNTPU_PAGE_SIZE": "128",
+        "DYNTPU_HOST_OFFLOAD_PAGES": "64",
+    })
+    assert cfg.page_size == 128          # env wins over toml
+    assert cfg.namespace == "from-toml"  # toml wins over default
+    assert cfg.host_offload_pages == 64
+
+
+def test_log_filter_spec():
+    root = logging.getLogger("test-root-sentinel")
+    _apply_filters("debug", root)
+    assert root.level == logging.DEBUG
+    _apply_filters("dynamo_tpu.x=warning, other.y=error", root)
+    assert logging.getLogger("dynamo_tpu.x").level == logging.WARNING
+    assert logging.getLogger("other.y").level == logging.ERROR
+
+
+def test_jsonl_formatter():
+    rec = logging.LogRecord(
+        "pkg.mod", logging.WARNING, "f.py", 1, "something %s", ("bad",), None
+    )
+    out = json.loads(JsonlFormatter().format(rec))
+    assert out["level"] == "WARNING"
+    assert out["logger"] == "pkg.mod"
+    assert out["msg"] == "something bad"
