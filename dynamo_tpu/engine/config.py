@@ -30,6 +30,10 @@ class EngineConfig:
     # these only when D2H latency is high relative to step time.
     flush_every: int = 4
     max_inflight_rounds: int = 2
+    # prefill chunks dispatched per scheduling round: bounds how long a
+    # round can stall decode behind prompt processing (the ITL-interference
+    # problem disagg solves globally; this bounds it locally)
+    prefill_chunks_per_round: int = 2
 
     # sampling
     max_top_k: int = 64           # static top-k width for top-p/top-k sampling

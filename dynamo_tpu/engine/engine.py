@@ -93,6 +93,10 @@ class _Request:
     tokens: list[int] = field(default_factory=list)
     pages: list[int] = field(default_factory=list)
     matched_blocks: int = 0
+    # chunked-prefill progress: tokens already in cache (-1 = not started).
+    # Prefill runs ONE chunk per scheduling round so decode rounds
+    # interleave with long prompts instead of stalling behind them.
+    prefill_pos: int = -1
     slot: int = -1
     produced: int = 0
     last_token: int = -1          # newest processed token, not yet in seq
@@ -245,41 +249,78 @@ class TpuEngine:
 
         max_logprobs = e.max_logprobs
 
-        @functools.partial(jax.jit, donate_argnums=(2, 3),
-                           static_argnums=(7,))
-        def engine_step(params, cache, ring, dev, pt, ring_base, ring_pos,
-                        want_lp):
-            # pt is width-bucketed [B, W] (W = pow2 cover of the widest
-            # active page table) — narrow tables shrink the attention
-            # kernel's page grid; one compile per W bucket. The page pool
-            # (cache) is read-only here: the new token's KV lands in ring
-            # slot ring_pos; llama.flush commits the ring to the pool at
-            # the round boundary. `want_lp` (static) adds the logprob
-            # computation — a separate compile used only for rounds where
-            # some request asked for logprobs.
-            ring, logits = llama.decode_step_impl(
-                c, params, cache, ring, dev["tokens"], pt, dev["ctx"],
-                ring_base, ring_pos,
+        @functools.partial(jax.jit, donate_argnums=(1, 2, 3),
+                           static_argnums=(6, 7))
+        def engine_round(params, cache, ring, dev, pt, ring_base,
+                         n_steps, want_lp):
+            """A FULL scheduling round in one program: n_steps fused
+            decode+sample steps via lax.fori_loop (body compiles once) and
+            the ring->pool flush — one dispatch + one result fetch per
+            round instead of n_steps+2, the single biggest lever on
+            per-step host overhead. pt is width-bucketed [B, W] (one
+            compile per (W, n_steps, want_lp)); `want_lp` adds the logprob
+            computation only for rounds that asked for it.
+
+            Flush contract: pt must cover every position written this
+            round (the scheduler's _ensure_coverage guarantees it), so the
+            bucketed table doubles as the flush table."""
+            B = dev["tokens"].shape[0]
+            toks_out = jnp.zeros((n_steps, B), jnp.int32)
+            lp_out = (
+                (jnp.zeros((n_steps, B), jnp.float32),
+                 jnp.zeros((n_steps, B, max_logprobs), jnp.int32),
+                 jnp.zeros((n_steps, B, max_logprobs), jnp.float32))
+                if want_lp else None
             )
             sp = sampling.SamplingParams(
                 temperature=dev["temp"], top_k=dev["top_k"], top_p=dev["top_p"],
                 frequency_penalty=dev["freq"], presence_penalty=dev["pres"],
                 repetition_penalty=dev["rep"],
             )
-            toks, st = sampling.sample_step_impl(
-                logits, sampling.SamplerState(dev["keys"], dev["counts"]),
-                sp, max_top_k,
+
+            def body(s, carry):
+                ring, dev, toks_out, lp_out = carry
+                ring, logits = llama.decode_step_impl(
+                    c, params, cache, ring, dev["tokens"], pt, dev["ctx"],
+                    ring_base, s,
+                )
+                toks, st = sampling.sample_step_impl(
+                    logits, sampling.SamplerState(dev["keys"], dev["counts"]),
+                    sp, max_top_k,
+                )
+                toks_out = jax.lax.dynamic_update_index_in_dim(
+                    toks_out, toks, s, 0
+                )
+                if want_lp:
+                    chosen, ids, lps = sampling.compute_logprobs(
+                        logits, toks, max_logprobs
+                    )
+                    lp_out = (
+                        jax.lax.dynamic_update_index_in_dim(
+                            lp_out[0], chosen, s, 0),
+                        jax.lax.dynamic_update_index_in_dim(
+                            lp_out[1], ids, s, 0),
+                        jax.lax.dynamic_update_index_in_dim(
+                            lp_out[2], lps, s, 0),
+                    )
+                dev = dict(
+                    dev,
+                    tokens=toks,
+                    ctx=jnp.minimum(dev["ctx"] + 1, dev["cap"]),
+                    keys=st.keys,
+                    counts=st.counts,
+                )
+                return ring, dev, toks_out, lp_out
+
+            ring, dev, toks_out, lp_out = jax.lax.fori_loop(
+                0, n_steps, body, (ring, dev, toks_out, lp_out)
             )
-            lp = (sampling.compute_logprobs(logits, toks, max_logprobs)
-                  if want_lp else None)
-            dev = dict(
-                dev,
-                tokens=toks,
-                ctx=jnp.minimum(dev["ctx"] + 1, dev["cap"]),
-                keys=st.keys,
-                counts=st.counts,
+            # round boundary: scatter the ring into the pool in-program
+            valid = jnp.minimum(
+                jnp.int32(n_steps), dev["cap"] - ring_base
             )
-            return ring, dev, toks, lp
+            cache = llama.flush_impl(c, cache, ring, pt, ring_base, valid)
+            return cache, ring, dev, toks_out, lp_out
 
         @functools.partial(jax.jit, donate_argnums=(0,))
         def patch(
@@ -323,12 +364,9 @@ class TpuEngine:
                   if want_lp else None)
             return toks, lp  # [1] i32, optional ([1], [1,K], [1,K])
 
-        stack = jax.jit(lambda *ts: jnp.stack(ts))
-
-        self._engine_step = engine_step
+        self._engine_round = engine_round
         self._patch = patch
         self._sample_first = sample_first
-        self._stack = stack
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -475,6 +513,28 @@ class TpuEngine:
             finally:
                 done.set()
 
+    def embed(self, token_ids: list[int]) -> list[float]:
+        """Mean-pooled normalized embedding of a prompt (the /v1/embeddings
+        surface). Cache-free encoder pass over read-only params — safe to
+        call from any thread, concurrent with serving. Bounded by
+        max_context: the O(T^2) one-shot attention would otherwise let one
+        long input OOM the device serving everyone."""
+        if not token_ids:
+            raise ValueError("empty input")
+        if len(token_ids) > self.ecfg.max_context:
+            raise ValueError(
+                f"input length {len(token_ids)} exceeds max context "
+                f"{self.ecfg.max_context}"
+            )
+        T = pow2_cover(max(len(token_ids), 8))
+        toks = np.zeros(T, np.int32)
+        toks[: len(token_ids)] = token_ids
+        out = llama.encode(
+            self.config, self.params, jnp.asarray(toks),
+            jnp.int32(len(token_ids)),
+        )
+        return np.asarray(out, np.float32).tolist()
+
     def metrics(self) -> ForwardPassMetrics:
         a = self.allocator
         return ForwardPassMetrics(
@@ -583,34 +643,17 @@ class TpuEngine:
             and self._slots[i].req.output_options.logprobs is not None
             for i in active
         )
-        handles = []
-        lp_handles: list[tuple] = []
-        for s in range(n):
-            self.ring, self._dev, toks, lp = self._engine_step(
+        # one fused program: n decode+sample steps + flush (see engine_round)
+        self.cache, self.ring, self._dev, stacked, lp_stacked = (
+            self._engine_round(
                 self.params, self.cache, self.ring, self._dev, pt_dev,
-                ring_base, jnp.int32(s), want_lp,
+                ring_base, n, want_lp,
             )
-            handles.append(toks)
-            if lp is not None:
-                lp_handles.append(lp)
-            self._ctx_disp = np.minimum(self._ctx_disp + 1, self._cap_disp)
-            self.step_count += 1
-        # round boundary: batch-scatter the ring into the page pool. Ring
-        # entries past a slot's context cap repeat the clamped position —
-        # only the first cap-ring_base entries are real. flush takes the
-        # FULL-width table (its contract): one compile, no width clipping.
-        valid = np.minimum(n, self._cap_disp - ring_base_np).astype(np.int32)
-        self.cache = llama.flush(
-            self.config, self.cache, self.ring, jnp.asarray(self._pt_disp),
-            ring_base, jnp.asarray(valid),
         )
-        stacked = self._stack(*handles)
+        self._ctx_disp = np.minimum(self._ctx_disp + n, self._cap_disp)
+        self.step_count += n
         stacked.copy_to_host_async()
-        lp_stacked: Optional[tuple] = None
-        if lp_handles:
-            lp_stacked = tuple(
-                self._stack(*[h[j] for h in lp_handles]) for j in range(3)
-            )
+        if lp_stacked is not None:
             for arr in lp_stacked:
                 arr.copy_to_host_async()
         self._entries.append(
@@ -745,66 +788,82 @@ class TpuEngine:
     # ---- admission / prefill ----
 
     def _admit(self) -> None:
-        self._waiting = [r for r in self._waiting if not r.cancelled]
-        while self._waiting and None in self._slots:
+        kept = []
+        for r in self._waiting:
+            if r.cancelled:
+                if r.pages:  # half-prefilled head: release its pages
+                    self.allocator.free(r.pages)
+                    r.pages = []
+            else:
+                kept.append(r)
+        self._waiting = kept
+        # bounded prefill budget per round: a long prompt advances one
+        # chunk at a time with decode rounds in between (ITL isolation,
+        # the local form of what disagg provides globally)
+        budget = max(1, self.ecfg.prefill_chunks_per_round)
+        while budget > 0 and self._waiting and None in self._slots:
             r = self._waiting[0]
-            if not self._try_prefill(r):
+            status = self._prefill_step(r)
+            budget -= 1
+            if status == "blocked":
                 return  # head-of-line blocks until pages free up
-            self._waiting.pop(0)
+            if status in ("done", "failed"):
+                self._waiting.pop(0)
 
-    def _try_prefill(self, r: _Request) -> bool:
-        """Prefill + on-device first-token sample + admission patch.
-        Returns False only when pages are unavailable."""
+    def _prefill_step(self, r: _Request) -> str:
+        """Advance one prefill chunk; on the final chunk, sample the first
+        token on device and assign a slot. Returns blocked | progress |
+        done | failed."""
         e = self.ecfg
         ps = e.page_size
         prompt = r.tokens
-        hashes = r.seq.block_hashes()
-        matchable = hashes[: max(0, (len(prompt) - 1) // ps)]
-        matched_pages = self.allocator.match_prefix(matchable)
-        # blocks evicted from HBM may still live in the host tier
-        matched_pages = self._onboard_from_host(matchable, matched_pages)
-        n_cached = len(matched_pages) * ps
-        n_total_pages = (len(prompt) + ps - 1) // ps
-        fresh = self.allocator.allocate(n_total_pages - len(matched_pages))
-        if fresh is None:
-            self.allocator.free(matched_pages)
-            return False
-        r.pages = matched_pages + fresh
-        r.matched_blocks = len(matched_pages)
 
-        if n_total_pages > e.max_pages_per_seq:
-            self.allocator.free(r.pages)
-            r.pages = []
-            r.emit(ValueError("prompt does not fit page table"))
-            return True
-
-        # chunked prefill: prompts longer than the largest bucket run as a
-        # sequence of page-aligned continuation chunks (q_start advances);
-        # only the final chunk's logits matter
-        max_chunk = (
-            (e.prefill_buckets[-1] + ps - 1) // ps
-        ) * ps
-        logits = None
-        start = n_cached
-        while start < len(prompt):
-            chunk = prompt[start : start + max_chunk]
-            pad_t = e.bucket_for(len(chunk)) or max_chunk
-            pad_t = ((pad_t + ps - 1) // ps) * ps
-            toks = np.zeros(pad_t, np.int32)
-            toks[: len(chunk)] = chunk
-            # width-bucketed table (pow2 cover of pages in play); one
-            # compile per (bucket, width) pair
-            w = min(pow2_cover(start // ps + pad_t // ps, lo=2),
-                    e.max_pages_per_seq)
-            table = np.zeros(w, np.int32)
-            table[: len(r.pages)] = r.pages[:w]
-            self.cache, logits = llama.prefill(
-                self.config, self.params, self.cache,
-                jnp.asarray(toks), jnp.asarray(table),
-                jnp.int32(start), jnp.int32(start + len(chunk)),
+        if r.prefill_pos < 0:
+            # start: prefix match (HBM, then host tier) + full allocation
+            hashes = r.seq.block_hashes()
+            matchable = hashes[: max(0, (len(prompt) - 1) // ps)]
+            matched_pages = self.allocator.match_prefix(matchable)
+            matched_pages = self._onboard_from_host(matchable, matched_pages)
+            n_total_pages = (len(prompt) + ps - 1) // ps
+            if n_total_pages > e.max_pages_per_seq:
+                self.allocator.free(matched_pages)
+                r.emit(ValueError("prompt does not fit page table"))
+                return "failed"
+            fresh = self.allocator.allocate(
+                n_total_pages - len(matched_pages)
             )
-            start += len(chunk)
-        # commit complete prompt blocks beyond the matched prefix
+            if fresh is None:
+                self.allocator.free(matched_pages)
+                return "blocked"
+            r.pages = matched_pages + fresh
+            r.matched_blocks = len(matched_pages)
+            r.prefill_pos = len(matched_pages) * ps
+
+        # one page-aligned continuation chunk (q_start advances); only the
+        # final chunk's logits matter
+        max_chunk = ((e.prefill_buckets[-1] + ps - 1) // ps) * ps
+        start = r.prefill_pos
+        chunk = prompt[start : start + max_chunk]
+        pad_t = e.bucket_for(len(chunk)) or max_chunk
+        pad_t = ((pad_t + ps - 1) // ps) * ps
+        toks = np.zeros(pad_t, np.int32)
+        toks[: len(chunk)] = chunk
+        # width-bucketed table (pow2 cover of pages in play); one
+        # compile per (bucket, width) pair
+        w = min(pow2_cover(start // ps + pad_t // ps, lo=2),
+                e.max_pages_per_seq)
+        table = np.zeros(w, np.int32)
+        table[: len(r.pages)] = r.pages[:w]
+        self.cache, logits = llama.prefill(
+            self.config, self.params, self.cache,
+            jnp.asarray(toks), jnp.asarray(table),
+            jnp.int32(start), jnp.int32(start + len(chunk)),
+        )
+        r.prefill_pos = start + len(chunk)
+        if r.prefill_pos < len(prompt):
+            return "progress"  # decode rounds run before the next chunk
+
+        # final chunk: commit complete prompt blocks beyond the match
         for blk in r.seq.blocks[r.matched_blocks:]:
             self.allocator.commit(
                 r.pages[blk.position], blk.block_hash, blk.parent_hash
@@ -865,7 +924,7 @@ class TpuEngine:
         self._entries.append(_Entry(
             kind="first", handle=first_tok, request=r, lp_handle=first_lp
         ))
-        return True
+        return "done"
 
     # ---- processing side (lagged results) ----
 
@@ -1032,13 +1091,20 @@ class TpuEngine:
         )
         victim.last_token = -1
         victim.matched_blocks = 0
+        victim.prefill_pos = -1  # restart prefill from scratch
         self._slots[slot] = None
         self._pt_disp[slot] = 0
         self._ctx_disp[slot] = 1
         self._cap_disp[slot] = self.ecfg.page_size
         victim.slot = -1
         self._dispatch_patch(clear_slots=[slot])
-        self._waiting.insert(0, victim)
+        # never jump AHEAD of a half-prefilled head: it already holds its
+        # full page allocation and only needs budget (and the slot this
+        # preemption just freed) to finish — queueing the victim in front
+        # would deadlock (victim can't allocate, head can't reach budget)
+        pos = 1 if (self._waiting
+                    and self._waiting[0].prefill_pos >= 0) else 0
+        self._waiting.insert(pos, victim)
         log.info("preempted request %s", victim.req.request_id)
 
     def _fail_all(self, err: Exception) -> None:
@@ -1051,6 +1117,9 @@ class TpuEngine:
         self._slots = [None] * self._B
         for r in self._waiting:
             r.emit(err)
+            if r.pages:  # half-prefilled head holds pages
+                self.allocator.free(r.pages)
+                r.pages = []
         self._waiting = []
         self._entries = []
 

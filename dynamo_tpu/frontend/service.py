@@ -106,6 +106,7 @@ class HttpService:
             [
                 web.post("/v1/chat/completions", self.handle_chat),
                 web.post("/v1/completions", self.handle_completion),
+                web.post("/v1/embeddings", self.handle_embeddings),
                 web.get("/v1/models", self.handle_models),
                 web.get("/health", self.handle_health),
                 web.get("/live", self.handle_health),
@@ -162,6 +163,56 @@ class HttpService:
                     await res
                 cleared.append(name)
         return web.json_response({"cleared": cleared})
+
+    async def handle_embeddings(self, request: web.Request) -> web.Response:
+        """OpenAI /v1/embeddings over any engine exposing `embed`
+        (reference protocols/openai embeddings surface)."""
+        from dynamo_tpu.protocols.openai import (
+            EmbeddingRequest,
+            embedding_response,
+        )
+
+        try:
+            body = await request.json()
+        except Exception:
+            return _error(400, "invalid JSON body")
+        try:
+            req = EmbeddingRequest(**body)
+        except ValidationError as e:
+            return _error(400, e.errors()[0].get("msg", "invalid request"))
+        try:
+            chain = self.manager.get(req.model)
+        except ModelNotFound:
+            return _error(404, f"model '{req.model}' not found",
+                          "not_found_error")
+        embed = getattr(chain.engine, "embed", None)
+        if embed is None:
+            return _error(400, f"model '{req.model}' does not serve "
+                               "embeddings")
+        # normalize input to a list of token-id lists
+        raw = req.input
+        if isinstance(raw, str):
+            raw = [raw]
+        elif raw and isinstance(raw[0], int):
+            raw = [raw]
+        token_lists = [
+            chain.preprocessor.tokenizer.encode(item)
+            if isinstance(item, str) else list(item)
+            for item in raw
+        ]
+        if any(not t for t in token_lists):
+            return _error(400, "empty input")
+        try:
+            vectors = await asyncio.gather(*[
+                asyncio.to_thread(embed, toks) for toks in token_lists
+            ])
+        except ValueError as e:  # engine-side input bound
+            return _error(400, str(e))
+        return web.json_response(embedding_response(
+            req.model, list(vectors),
+            prompt_tokens=sum(len(t) for t in token_lists),
+            encoding_format=req.encoding_format,
+        ))
 
     async def handle_chat(self, request: web.Request) -> web.StreamResponse:
         return await self._handle_openai(request, chat=True)

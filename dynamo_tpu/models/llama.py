@@ -335,7 +335,8 @@ def flush_impl(
     config: ModelConfig,
     cache: Cache,
     ring: Cache,
-    page_tables: jnp.ndarray,  # [B, max_pages_full] int32 (FULL width)
+    page_tables: jnp.ndarray,  # [B, W] int32 — MUST cover every position
+                               # written this round (see contract below)
     ring_base: jnp.ndarray,    # [B] int32
     valid_len: jnp.ndarray,    # [B] int32 — #real tokens in the ring per slot
 ) -> Cache:
@@ -345,15 +346,26 @@ def flush_impl(
     page_tables[b, pos//ps] at offset pos%ps; entries with r >= valid_len[b]
     (garbage beyond a finished/clamped slot) are redirected to scratch page
     0. This is the only writer of the pool besides prefill.
+
+    CONTRACT: the table may be width-bucketed, but every position in
+    [ring_base, ring_base+valid_len) must map inside it — the engine's
+    _ensure_coverage guarantees this. Positions falling OUTSIDE the table
+    width are routed to scratch page 0 (dropped KV -> visibly wrong
+    output), never clamped into another sequence's page (silent KV
+    corruption).
     """
     c = config
     ps = cache["k"].shape[3]
     L, kvh, B, R, hd = ring["k"].shape
     r_idx = jnp.arange(R, dtype=jnp.int32)[None, :]          # [1, R]
     pos = ring_base[:, None] + r_idx                          # [B, R]
-    page_slot = jnp.clip(pos // ps, 0, page_tables.shape[1] - 1)
-    page = jnp.take_along_axis(page_tables, page_slot, axis=1)  # [B, R]
-    valid = r_idx < valid_len[:, None]
+    page_slot = pos // ps
+    W = page_tables.shape[1]
+    in_range = page_slot < W
+    page = jnp.take_along_axis(
+        page_tables, jnp.clip(page_slot, 0, W - 1), axis=1
+    )  # [B, R]
+    valid = (r_idx < valid_len[:, None]) & in_range
     page = jnp.where(valid, page, 0)
     offset = pos % ps
     pflat = page.reshape(-1)       # [B*R]
@@ -371,6 +383,61 @@ def flush_impl(
 
 
 flush = jax.jit(flush_impl, static_argnums=(0,), donate_argnums=(1,))
+
+
+# ---------------------------------------------------------------------------
+# Encoder path (embeddings API): full self-attention over the prompt with
+# no KV cache — the /v1/embeddings endpoint pools the final hidden states
+# (reference protocols/openai embeddings surface; the reference delegates
+# embedding models to its engines)
+
+def encode_impl(
+    config: ModelConfig,
+    params: Params,
+    tokens: jnp.ndarray,   # [T] int32, padded
+    seq_len: jnp.ndarray,  # scalar int32: valid length
+) -> jnp.ndarray:
+    """Mean-pooled, L2-normalized final hidden state [H] over the valid
+    tokens. Cache-free causal attention (prompt-sized, one shot)."""
+    c = config
+    T = tokens.shape[0]
+    inv_freq = jnp.asarray(
+        rope_inv_freq(c.head_dim, c.rope_theta, c.rope_scaling_dict)
+    )
+    positions = jnp.arange(T, dtype=jnp.int32)
+    cos, sin = rope_cos_sin(positions, inv_freq)
+    h = params["embed"][tokens].astype(jnp.dtype(c.dtype))
+    valid = positions < seq_len                                   # [T]
+    causal = (positions[None, :] <= positions[:, None]) & valid[None, :]
+
+    def attend(q, kv):
+        k, v = kv
+        # GQA: repeat kv heads to match q heads
+        rep = c.num_heads // c.num_kv_heads
+        k = jnp.repeat(k, rep, axis=1)
+        v = jnp.repeat(v, rep, axis=1)
+        scores = jnp.einsum("qhd,khd->hqk", q, k) / np.sqrt(c.head_dim)
+        scores = jnp.where(causal[None], scores.astype(jnp.float32),
+                           -1e30)
+        w = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+        return jnp.einsum("hqk,khd->qhd", w, v)
+
+    for l in range(c.num_layers):
+        lp = jax.tree.map(lambda x: x[l], params["layers"])
+        h, _ = _layer_body(
+            c, lp, h, cos, sin,
+            write_kv=lambda k, v: (k, v),
+            attend=attend,
+        )
+    h = rms_norm(h, params["norm_f"], c.rms_norm_eps)
+    maskf = valid.astype(jnp.float32)[:, None]
+    pooled = (h.astype(jnp.float32) * maskf).sum(0) / jnp.maximum(
+        maskf.sum(), 1.0
+    )
+    return pooled / jnp.maximum(jnp.linalg.norm(pooled), 1e-9)
+
+
+encode = jax.jit(encode_impl, static_argnums=(0,))
 
 
 # ---------------------------------------------------------------------------

@@ -180,6 +180,32 @@ def completion_response(
     }
 
 
+def embedding_response(
+    model: str, vectors: list[list[float]], prompt_tokens: int,
+    encoding_format: str = "float",
+) -> dict[str, Any]:
+    def enc(v: list[float]):
+        if encoding_format == "base64":
+            import base64
+            import struct as _struct
+
+            return base64.b64encode(
+                _struct.pack(f"<{len(v)}f", *v)
+            ).decode()
+        return v
+
+    return {
+        "object": "list",
+        "data": [
+            {"object": "embedding", "embedding": enc(v), "index": i}
+            for i, v in enumerate(vectors)
+        ],
+        "model": model,
+        "usage": {"prompt_tokens": prompt_tokens,
+                  "total_tokens": prompt_tokens},
+    }
+
+
 def model_list_response(models: list[str]) -> dict[str, Any]:
     now = int(time.time())
     return {

@@ -1,0 +1,149 @@
+"""Embeddings API tests (reference protocols/openai embeddings surface)."""
+import asyncio
+import base64
+import struct
+
+import numpy as np
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from dynamo_tpu.backend import Backend
+from dynamo_tpu.engines import EchoEngine
+from dynamo_tpu.frontend import HttpService, ModelChain, ModelManager
+from dynamo_tpu.models import llama
+from dynamo_tpu.models.config import ModelConfig
+from dynamo_tpu.preprocessor import OpenAIPreprocessor, PromptFormatter
+from dynamo_tpu.tokenizer import make_test_tokenizer
+
+WORDS = [f"w{i}" for i in range(100)]
+
+
+def test_encode_padding_invariant():
+    import jax.numpy as jnp
+
+    cfg = ModelConfig.tiny(dtype="float32")
+    params = llama.init_params(cfg, 0)
+    prompt = list(range(1, 12))
+
+    def run(pad_to):
+        toks = np.zeros(pad_to, np.int32)
+        toks[: len(prompt)] = prompt
+        return np.asarray(llama.encode(
+            cfg, params, jnp.asarray(toks), jnp.int32(len(prompt))
+        ))
+
+    a, b = run(16), run(32)
+    np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+    assert abs(float(np.linalg.norm(a)) - 1.0) < 1e-5
+    # different prompt -> different vector
+    toks = np.zeros(16, np.int32)
+    toks[:5] = [9, 8, 7, 6, 5]
+    import jax.numpy as jnp2
+
+    c = np.asarray(llama.encode(cfg, params, jnp2.asarray(toks),
+                                jnp2.int32(5)))
+    assert not np.allclose(a, c)
+
+
+async def test_tpu_engine_embed_while_serving():
+    import jax.numpy as jnp  # noqa: F401
+
+    from dynamo_tpu.engine.config import EngineConfig
+    from dynamo_tpu.engine.engine import TpuEngine
+    from dynamo_tpu.parallel.mesh import MeshConfig
+    from dynamo_tpu.protocols.common import (
+        PreprocessedRequest,
+        StopConditions,
+    )
+
+    cfg = ModelConfig.tiny(dtype="float32")
+    eng = TpuEngine(
+        cfg,
+        EngineConfig(num_pages=32, page_size=16, max_pages_per_seq=8,
+                     max_decode_slots=2, prefill_buckets=(32,),
+                     cache_dtype="float32"),
+        params=llama.init_params(cfg, 0),
+        mesh_config=MeshConfig(tp=1),
+    )
+
+    async def gen():
+        req = PreprocessedRequest(
+            token_ids=list(range(1, 20)),
+            stop_conditions=StopConditions(max_tokens=10, ignore_eos=True),
+        )
+        toks = []
+        async for out in eng.generate(req):
+            toks.extend(out.token_ids)
+        return toks
+
+    gen_task = asyncio.create_task(gen())
+    v1 = await asyncio.to_thread(eng.embed, [1, 2, 3, 4, 5])
+    v2 = await asyncio.to_thread(eng.embed, [1, 2, 3, 4, 5])
+    toks = await gen_task
+    assert len(toks) == 10
+    assert v1 == v2 and len(v1) == cfg.hidden_size
+    assert abs(sum(x * x for x in v1) - 1.0) < 1e-4
+    await eng.stop()
+
+
+def make_service():
+    tok = make_test_tokenizer(WORDS)
+    chain = ModelChain(
+        name="emb",
+        preprocessor=OpenAIPreprocessor(
+            tokenizer=tok, formatter=PromptFormatter(), model_name="emb"
+        ),
+        engine=EchoEngine(delay_s=0.0),
+        backend=Backend(tok),
+    )
+    manager = ModelManager()
+    manager.register(chain)
+    return HttpService(manager)
+
+
+async def test_http_embeddings():
+    svc = make_service()
+    client = TestClient(TestServer(svc.app))
+    await client.start_server()
+
+    r = await client.post("/v1/embeddings", json={
+        "model": "emb", "input": "w1 w2 w3",
+    })
+    assert r.status == 200
+    body = await r.json()
+    assert body["object"] == "list" and len(body["data"]) == 1
+    v = body["data"][0]["embedding"]
+    assert len(v) == 16 and abs(sum(x * x for x in v) - 1.0) < 1e-6
+    assert body["usage"]["prompt_tokens"] == 3
+
+    # batch input preserves order/index
+    r = await client.post("/v1/embeddings", json={
+        "model": "emb", "input": ["w1 w2", "w3 w4 w5"],
+    })
+    body = await r.json()
+    assert [d["index"] for d in body["data"]] == [0, 1]
+    assert body["data"][0]["embedding"] != body["data"][1]["embedding"]
+
+    # base64 encoding round-trips
+    r = await client.post("/v1/embeddings", json={
+        "model": "emb", "input": "w1 w2", "encoding_format": "base64",
+    })
+    blob = (await r.json())["data"][0]["embedding"]
+    decoded = struct.unpack("<16f", base64.b64decode(blob))
+    assert abs(sum(x * x for x in decoded) - 1.0) < 1e-5
+
+    # pre-tokenized input
+    r = await client.post("/v1/embeddings", json={
+        "model": "emb", "input": [3, 4, 5],
+    })
+    assert r.status == 200
+
+    # error paths
+    r = await client.post("/v1/embeddings", json={
+        "model": "nope", "input": "x",
+    })
+    assert r.status == 404
+    r = await client.post("/v1/embeddings", json={"model": "emb",
+                                                  "input": ""})
+    assert r.status == 400
+    await client.close()
