@@ -195,6 +195,8 @@ class HttpService:
             raw = [raw]
         elif raw and isinstance(raw[0], int):
             raw = [raw]
+        if not raw:
+            return _error(400, "empty input")
         token_lists = [
             chain.preprocessor.tokenizer.encode(item)
             if isinstance(item, str) else list(item)
@@ -208,8 +210,19 @@ class HttpService:
             ])
         except ValueError as e:  # engine-side input bound
             return _error(400, str(e))
+        vectors = list(vectors)
+        if req.dimensions:
+            # OpenAI contract: truncate then re-normalize
+            import math as _math
+
+            def shrink(v):
+                v = v[: req.dimensions]
+                norm = _math.sqrt(sum(x * x for x in v)) or 1.0
+                return [x / norm for x in v]
+
+            vectors = [shrink(v) for v in vectors]
         return web.json_response(embedding_response(
-            req.model, list(vectors),
+            req.model, vectors,
             prompt_tokens=sum(len(t) for t in token_lists),
             encoding_format=req.encoding_format,
         ))

@@ -386,6 +386,63 @@ flush = jax.jit(flush_impl, static_argnums=(0,), donate_argnums=(1,))
 
 
 # ---------------------------------------------------------------------------
+# Sequence-parallel (ring) prefill — long-context path (SURVEY §2.5 SP
+# row / §7.11: the reference has no sequence parallelism; this is the
+# TPU-native long-context answer). The prompt is sharded over the `sp`
+# mesh axis; every layer's attention runs as ring attention (KV blocks
+# rotate over ICI via ppermute) so per-device memory is O(T/sp).
+
+def sp_prefill(
+    config: ModelConfig,
+    params: Params,
+    tokens: jnp.ndarray,    # [T] int32, sp-sharded, T % sp == 0
+    seq_len: jnp.ndarray,   # scalar int32 — valid length
+    mesh: Mesh,
+    axis: str = "sp",
+) -> tuple[Cache, jnp.ndarray]:
+    """Returns (kv, logits[vocab]) where kv = {"k","v"}: [L, kvh, T, hd]
+    sp-sharded on the T axis (callers page/commit it as needed) and the
+    logits are for position seq_len-1. Weights are replicated over sp;
+    only KV blocks move (one ICI hop per ring step)."""
+    from dynamo_tpu.ops.ring_attention import ring_attention
+
+    c = config
+    T = int(tokens.shape[0])
+    inv_freq = jnp.asarray(
+        rope_inv_freq(c.head_dim, c.rope_theta, c.rope_scaling_dict)
+    )
+    positions = jnp.arange(T, dtype=jnp.int32)
+    cos, sin = rope_cos_sin(positions, inv_freq)
+    h = params["embed"][tokens].astype(jnp.dtype(c.dtype))
+
+    ks, vs = [], []
+    rep = c.num_heads // c.num_kv_heads
+    for l in range(c.num_layers):
+        lp = jax.tree.map(lambda x: x[l], params["layers"])
+
+        def write_kv(k, v):
+            ks.append(k)
+            vs.append(v)
+            return (k, v)
+
+        def attend(q, kv):
+            k, v = kv
+            return ring_attention(
+                q, jnp.repeat(k, rep, axis=1),
+                jnp.repeat(v, rep, axis=1), mesh, axis,
+            )
+
+        h, _ = _layer_body(c, lp, h, cos, sin, write_kv, attend)
+
+    logits = _logits(c, params, h[seq_len - 1])
+    kv = {
+        "k": jnp.stack(ks).transpose(0, 2, 1, 3),  # [L, kvh, T, hd]
+        "v": jnp.stack(vs).transpose(0, 2, 1, 3),
+    }
+    return kv, logits
+
+
+# ---------------------------------------------------------------------------
 # Encoder path (embeddings API): full self-attention over the prompt with
 # no KV cache — the /v1/embeddings endpoint pools the final hidden states
 # (reference protocols/openai embeddings surface; the reference delegates

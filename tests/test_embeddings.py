@@ -147,3 +147,20 @@ async def test_http_embeddings():
                                                   "input": ""})
     assert r.status == 400
     await client.close()
+
+
+async def test_http_embeddings_dimensions_and_empty_list():
+    svc = make_service()
+    client = TestClient(TestServer(svc.app))
+    await client.start_server()
+    r = await client.post("/v1/embeddings", json={
+        "model": "emb", "input": "w1 w2 w3", "dimensions": 8,
+    })
+    v = (await r.json())["data"][0]["embedding"]
+    assert len(v) == 8
+    assert abs(sum(x * x for x in v) - 1.0) < 1e-6  # re-normalized
+    r = await client.post("/v1/embeddings", json={
+        "model": "emb", "input": [],
+    })
+    assert r.status == 400
+    await client.close()

@@ -1,0 +1,88 @@
+"""Ring-attention sequence parallelism tests (SURVEY §2.5 SP row — absent
+in the reference; our TPU-native long-context path). Run on the virtual
+8-device CPU mesh."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from dynamo_tpu.models import llama
+from dynamo_tpu.models.config import ModelConfig
+from dynamo_tpu.ops.ring_attention import ring_attention, sp_shard
+
+
+def sp_mesh(n):
+    return Mesh(np.array(jax.devices()[:n]), ("sp",))
+
+
+def full_causal(q, k, v):
+    """Single-device reference."""
+    H = q.shape[1]
+    qt = q.transpose(1, 0, 2)
+    kt = k.transpose(1, 0, 2)
+    vt = v.transpose(1, 0, 2)
+    s = jnp.einsum("htd,hsd->hts", qt, kt,
+                   preferred_element_type=jnp.float32)
+    s = s / np.sqrt(q.shape[-1])
+    T = q.shape[0]
+    mask = jnp.arange(T)[None, :] <= jnp.arange(T)[:, None]
+    s = jnp.where(mask[None], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("hts,hsd->thd", p, vt.astype(p.dtype)).astype(q.dtype)
+
+
+@pytest.mark.parametrize("sp", [2, 4, 8])
+def test_ring_matches_full_attention(sp):
+    rng = np.random.default_rng(0)
+    T, H, D = 64, 4, 16
+    q = jnp.asarray(rng.standard_normal((T, H, D)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((T, H, D)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((T, H, D)), jnp.float32)
+    ref = full_causal(q, k, v)
+
+    mesh = sp_mesh(sp)
+    out = ring_attention(
+        sp_shard(q, mesh), sp_shard(k, mesh), sp_shard(v, mesh), mesh
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_ring_rejects_indivisible_seq():
+    mesh = sp_mesh(4)
+    x = jnp.zeros((30, 2, 8), jnp.float32)
+    with pytest.raises(ValueError, match="not divisible"):
+        ring_attention(x, x, x, mesh)
+
+
+def test_sp_prefill_matches_single_device():
+    """Whole-transformer SP prefill: logits equal the paged single-device
+    prefill for the same prompt + params."""
+    cfg = ModelConfig.tiny(dtype="float32")
+    params = llama.init_params(cfg, 0)
+    prompt = list(range(1, 41))  # 40 valid tokens
+    T = 64                        # padded, divisible by sp=8
+    toks = np.zeros(T, np.int32)
+    toks[: len(prompt)] = prompt
+
+    # reference: single-device paged prefill (pages 1..4 cover 64 tokens)
+    ps = 16
+    cache = llama.init_cache(cfg, 8, ps, jnp.float32)
+    table = np.asarray([1, 2, 3, 4], np.int32)
+    _, ref_logits = llama.prefill(
+        cfg, params, cache, jnp.asarray(toks), jnp.asarray(table),
+        jnp.int32(0), jnp.int32(len(prompt)),
+    )
+
+    mesh = sp_mesh(8)
+    kv, logits = llama.sp_prefill(
+        cfg, params, sp_shard(jnp.asarray(toks), mesh),
+        jnp.int32(len(prompt)), mesh,
+    )
+    np.testing.assert_allclose(
+        np.asarray(logits), np.asarray(ref_logits), rtol=2e-4, atol=2e-4
+    )
+    # KV layout: [L, kvh, T, hd], valid positions match the paged pool
+    assert kv["k"].shape == (cfg.num_layers, cfg.num_kv_heads, T,
+                             cfg.head_dim)
