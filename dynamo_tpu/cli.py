@@ -14,6 +14,8 @@ Subcommands:
         (reference components/metrics): metrics --control-plane HOST:PORT
   planner  load-based autoscaler managing a local worker pool
         (reference components/planner): planner --control-plane HOST:PORT
+  llmctl   list/add/remove model registrations on the store
+        (reference launch/llmctl): llmctl --control-plane HOST:PORT list
 """
 from __future__ import annotations
 
@@ -88,8 +90,116 @@ def main(argv: list[str] | None = None) -> int:
         return _run_metrics(rest)
     if cmd == "planner":
         return _run_planner(rest)
+    if cmd == "llmctl":
+        return _run_llmctl(rest)
     print(f"dynamo-tpu: unknown subcommand {cmd!r}", file=sys.stderr)
     return 2
+
+
+def _run_llmctl(rest: list[str]) -> int:
+    """Inspect/manage model registrations on the store (reference
+    launch/llmctl main.rs:181-310: list/add/remove models)."""
+    import argparse
+    import asyncio
+    import json as _json
+
+    p = argparse.ArgumentParser(prog="dynamo-tpu llmctl")
+    p.add_argument("--control-plane", required=True, metavar="HOST:PORT")
+    p.add_argument("--namespace", default="dynamo")
+    sub = p.add_subparsers(dest="action", required=True)
+    sub.add_parser("list", help="list registered models + live instances")
+    padd = sub.add_parser(
+        "add", help="statically register a model entry (no lease — "
+                    "persists until removed; for externally-managed "
+                    "workers)")
+    padd.add_argument("name")
+    padd.add_argument("--component", default="backend")
+    padd.add_argument("--endpoint", default="generate")
+    padd.add_argument("--block-size", type=int, default=64)
+    padd.add_argument("--router-mode", default="kv",
+                      choices=["kv", "round_robin", "random"])
+    padd.add_argument("--model-path", default=None,
+                      help="local HF model dir; tokenizer/config artifacts "
+                           "are uploaded as the model card so frontends "
+                           "tokenize correctly")
+    padd.add_argument("--context-length", type=int, default=None)
+    prem = sub.add_parser("remove", help="remove a model's registrations "
+                                         "and card artifacts")
+    prem.add_argument("name")
+    args = p.parse_args(rest)
+
+    from dynamo_tpu.frontend.watcher import MODEL_PREFIX, ModelEntry
+    from dynamo_tpu.runtime.client import KvClient
+    from dynamo_tpu.runtime.component import instance_prefix
+
+    host, _, port = args.control_plane.partition(":")
+
+    async def run() -> int:
+        kv = await KvClient(host or "127.0.0.1",
+                            int(port or 7111)).connect()
+        prefix = f"dynamo://{args.namespace}/{MODEL_PREFIX}"
+        try:
+            if args.action == "list":
+                entries = await kv.get_prefix(prefix)
+                by_model: dict = {}
+                for k, v, lease in entries:
+                    e = ModelEntry.from_json(v)
+                    by_model.setdefault(e.name, []).append((e, lease))
+                if not by_model:
+                    print("no models registered")
+                for name, regs in sorted(by_model.items()):
+                    e = regs[0][0]
+                    inst = await kv.get_prefix(instance_prefix(
+                        e.namespace, e.component, e.endpoint
+                    ))
+                    # instances carry their model in metadata: don't count
+                    # another model's workers sharing the component
+                    mine = 0
+                    for _k, iv, _l in inst:
+                        try:
+                            meta = _json.loads(iv).get("metadata", {})
+                        except ValueError:
+                            meta = {}
+                        if meta.get("model", name) == name:
+                            mine += 1
+                    print(f"{name}: {len(regs)} registration(s), "
+                          f"{mine} instance(s) at "
+                          f"{e.component}/{e.endpoint} "
+                          f"[{e.router_mode}, block={e.block_size}]")
+            elif args.action == "add":
+                entry = ModelEntry(
+                    name=args.name, namespace=args.namespace,
+                    component=args.component, endpoint=args.endpoint,
+                    block_size=args.block_size,
+                    router_mode=args.router_mode,
+                    model_path=args.model_path,
+                    context_length=args.context_length,
+                )
+                if args.model_path:
+                    from dynamo_tpu.model_card import upload_card
+
+                    entry.card_ref = await upload_card(
+                        kv, args.namespace, args.name, args.model_path
+                    )
+                await kv.put(f"{prefix}{args.name}/static",
+                             entry.to_json())
+                print(f"registered {args.name} -> "
+                      f"{args.component}/{args.endpoint}"
+                      + (f" (card {entry.card_ref})"
+                         if entry.card_ref else ""))
+            elif args.action == "remove":
+                from dynamo_tpu.model_card import card_bucket, delete_card
+
+                n = await kv.delete_prefix(f"{prefix}{args.name}/")
+                await delete_card(
+                    kv, card_bucket(args.namespace, args.name)
+                )
+                print(f"removed {n} registration(s) for {args.name}")
+            return 0
+        finally:
+            await kv.close()
+
+    return asyncio.run(run())
 
 
 def _run_metrics(rest: list[str]) -> int:

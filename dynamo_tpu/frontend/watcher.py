@@ -15,6 +15,7 @@ from __future__ import annotations
 import asyncio
 import json
 import logging
+import os
 from collections import deque
 from dataclasses import asdict, dataclass, field
 from typing import Any, Optional
@@ -53,6 +54,9 @@ class ModelEntry:
     # minimal card payload: tokenizer/template source directory, context len
     model_path: Optional[str] = None
     context_length: Optional[int] = None
+    # object-store bucket holding the card artifacts (model_card.py) —
+    # lets a frontend with no shared filesystem load the real tokenizer
+    card_ref: Optional[str] = None
 
     def to_json(self) -> str:
         return json.dumps(asdict(self))
@@ -82,6 +86,18 @@ async def register_llm(
     ep = rt.namespace(entry.namespace).component(entry.component).endpoint(
         entry.endpoint
     )
+    # upload card artifacts so remote frontends can tokenize (model.rs:256)
+    if entry.model_path and entry.card_ref is None:
+        from dynamo_tpu.model_card import upload_card
+
+        try:
+            entry.card_ref = await upload_card(
+                rt.kv, entry.namespace, entry.name, entry.model_path
+            )
+        except (ConnectionError, OSError):
+            log.warning("card upload failed for %s; frontends must share "
+                        "the filesystem", entry.name)
+
     served = await serve_engine(
         ep, engine, worker_id=worker_id or entry.name, lease_ttl_s=lease_ttl_s,
         metadata={"model": entry.name},
@@ -134,12 +150,20 @@ class ModelWatcher:
         self._routers: dict[str, KvPushRouter] = {}
         # KV events that raced worker discovery, replayed on sync
         self._unclaimed_events: deque = deque(maxlen=4096)
+        # downloaded card artifacts, cached per card_ref: worker churn must
+        # not re-download or leak a tempdir per re-add
+        self._card_dirs: dict[str, str] = {}
 
     async def start(self) -> "ModelWatcher":
         prefix = f"dynamo://{self.namespace}/{MODEL_PREFIX}"
         watch = await self.rt.kv.watch_prefix(prefix)
         for k, v, _ in watch.initial:
-            await self._apply("put", k, v)
+            try:
+                await self._apply("put", k, v)
+            except Exception:  # noqa: BLE001 — one bad snapshot entry
+                # must not abort frontend startup (the _follow loop has
+                # the same protection for live events)
+                log.exception("model watcher failed applying snapshot %s", k)
         self._task = asyncio.get_running_loop().create_task(self._follow(watch))
         self._kv_sub_task = asyncio.get_running_loop().create_task(
             self._follow_kv_events()
@@ -224,7 +248,9 @@ class ModelWatcher:
         try:
             lease_id = int(lease_s)
         except ValueError:
-            return
+            if lease_s != "static":
+                return
+            lease_id = 0  # llmctl static registration (no lease)
         entries = self._models.setdefault(name, {})
         if event == "put" and value is not None:
             entries[lease_id] = ModelEntry.from_json(value)
@@ -284,12 +310,37 @@ class ModelWatcher:
                 mode="random" if entry.router_mode == "random" else "round_robin",
             )
 
-        if entry.model_path:
-            from dynamo_tpu.tokenizer import HfTokenizer
+        model_dir = entry.model_path
+        if (model_dir is None or not os.path.isdir(model_dir)) \
+                and entry.card_ref:
+            # no shared filesystem: pull the card artifacts (model.rs:305),
+            # cached per card_ref across worker churn
+            model_dir = self._card_dirs.get(entry.card_ref)
+            if model_dir is None:
+                from dynamo_tpu.model_card import download_card
 
-            tok = HfTokenizer.from_dir(entry.model_path)
-            fmt = PromptFormatter.from_dir(entry.model_path)
-        else:
+                try:
+                    model_dir = await download_card(
+                        self.rt.kv, entry.card_ref
+                    )
+                except (ConnectionError, OSError):
+                    log.exception("card download failed for %s", name)
+                    model_dir = None
+                if model_dir is not None:
+                    self._card_dirs[entry.card_ref] = model_dir
+        tok = fmt = None
+        if model_dir:
+            try:
+                from dynamo_tpu.tokenizer import HfTokenizer
+
+                tok = HfTokenizer.from_dir(model_dir)
+                fmt = PromptFormatter.from_dir(model_dir)
+            except Exception:  # noqa: BLE001 — a bad card/dir must not
+                # crash discovery; serve with the fallback tokenizer
+                log.exception("tokenizer load failed for %s (%s)",
+                              name, model_dir)
+                tok = fmt = None
+        if tok is None:
             from dynamo_tpu.tokenizer import make_test_tokenizer
 
             tok = make_test_tokenizer()
