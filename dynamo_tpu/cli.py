@@ -92,6 +92,8 @@ def main(argv: list[str] | None = None) -> int:
         return _run_planner(rest)
     if cmd == "llmctl":
         return _run_llmctl(rest)
+    if cmd == "profile":
+        return _run_profile(rest)
     print(f"dynamo-tpu: unknown subcommand {cmd!r}", file=sys.stderr)
     return 2
 
@@ -233,11 +235,45 @@ def _run_planner(rest: list[str]) -> int:
     p.add_argument("--adjustment-interval", type=float, default=10.0)
     p.add_argument("--min-replicas", type=int, default=1)
     p.add_argument("--max-replicas", type=int, default=8)
+    # SLA mode (reference planner_sla.py): consume a profiler table
+    p.add_argument("--sla-profile", default=None, metavar="PROFILE_JSON",
+                   help="profile from `dynamo-tpu profile`; enables SLA "
+                        "mode with --ttft-sla/--itl-sla")
+    p.add_argument("--ttft-sla", type=float, default=None,
+                   help="target TTFT seconds (p50)")
+    p.add_argument("--itl-sla", type=float, default=None,
+                   help="target inter-token latency seconds (p50)")
+    p.add_argument("--sla-config", default=None,
+                   help="which profiled config the deployed workers run "
+                        "(required when the profile has several)")
     args = p.parse_args(rest)
     from dynamo_tpu.planner import run_planner
 
     try:
         asyncio.run(run_planner(args))
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+def _run_profile(rest: list[str]) -> int:
+    import argparse
+    import asyncio
+
+    p = argparse.ArgumentParser(prog="dynamo-tpu profile")
+    p.add_argument("--engine", default="mocker", choices=["mocker", "tpu"])
+    p.add_argument("--model-config", default="tiny")
+    p.add_argument("--slots", type=int, nargs="+", default=[4, 8, 16])
+    p.add_argument("--concurrency", type=int, nargs="+",
+                   default=[1, 2, 4, 8])
+    p.add_argument("--isl", type=int, default=64)
+    p.add_argument("--osl", type=int, default=32)
+    p.add_argument("--output", default="profile.json")
+    args = p.parse_args(rest)
+    from dynamo_tpu.profiler import run_profile
+
+    try:
+        asyncio.run(run_profile(args))
     except KeyboardInterrupt:
         pass
     return 0

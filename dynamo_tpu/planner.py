@@ -99,10 +99,12 @@ class Planner:
         kv: KvClient,
         connector: Connector,
         config: Optional[PlannerConfig] = None,
+        sla: Optional[Any] = None,  # profiler.SlaCapacity -> SLA mode
     ):
         self.kv = kv
         self.connector = connector
         self.config = config or PlannerConfig()
+        self.sla = sla
         self.aggregator = MetricsAggregator(
             stale_after_s=self.config.metrics_stale_after_s
         )
@@ -146,6 +148,27 @@ class Planner:
         c = self.config
         snap = self.aggregator.snapshot()
         current = self.connector.current_replicas()
+        if self.sla is not None:
+            # SLA mode (reference planner_sla.py): size the fleet so the
+            # observed stream count fits within profiled SLA capacity.
+            # Scale-up is immediate (SLA protection); scale-down steps one
+            # replica per stable_intervals of consistently-lower targets so
+            # a stale/empty metrics snapshot can't collapse the fleet.
+            streams = sum(
+                m.worker_stats.request_active_slots
+                + m.worker_stats.num_requests_waiting
+                for m in snap.metrics.values()
+            )
+            target = min(c.max_replicas,
+                         self.sla.replicas_for(streams, c.min_replicas))
+            if target >= current:
+                self._low_streak = 0
+                return target
+            self._low_streak += 1
+            if self._low_streak >= c.stable_intervals:
+                self._low_streak = 0
+                return current - 1
+            return current
         usage = snap.load_avg()
         waiting = sum(
             m.worker_stats.num_requests_waiting
@@ -175,7 +198,9 @@ class Planner:
 
 
 async def run_planner(args) -> None:
-    """CLI entry: planner over a local worker pool."""
+    """CLI entry: planner over a local worker pool. SLA flags validate
+    BEFORE connecting so misconfiguration fails fast."""
+    sla = _build_sla(args)
     host, _, port = args.control_plane.partition(":")
     kv = await KvClient(host or "127.0.0.1", int(port or 7111)).connect()
     worker_cmd = [sys.executable, "-m", "dynamo_tpu.cli", "run",
@@ -190,8 +215,9 @@ async def run_planner(args) -> None:
         max_replicas=args.max_replicas,
     )
     await connector.set_replicas(cfg.min_replicas)
-    planner = await Planner(kv, connector, cfg).start()
-    print(f"planner managing '{args.model_name}' workers "
+    planner = await Planner(kv, connector, cfg, sla=sla).start()
+    mode = "sla" if sla else "load"
+    print(f"planner ({mode}) managing '{args.model_name}' workers "
           f"[{cfg.min_replicas}, {cfg.max_replicas}]")
     try:
         while True:
@@ -200,3 +226,39 @@ async def run_planner(args) -> None:
         await planner.stop()
         await connector.shutdown()
         await kv.close()
+
+
+def _build_sla(args):
+    sla = None
+    if getattr(args, "sla_profile", None):
+        from dynamo_tpu.profiler import SlaCapacity
+
+        if args.ttft_sla is None and args.itl_sla is None:
+            raise SystemExit(
+                "--sla-profile requires --ttft-sla and/or --itl-sla "
+                "(otherwise no SLA would be enforced)"
+            )
+        with open(args.sla_profile) as f:
+            profile = json.load(f)
+        names = [c.get("name") for c in profile.get("configs", [])]
+        config_name = getattr(args, "sla_config", None)
+        if config_name is None:
+            if len(names) != 1:
+                raise SystemExit(
+                    f"profile has configs {names}; pass --sla-config to "
+                    "pick the one your deployed workers actually run"
+                )
+            config_name = names[0]
+        elif config_name not in names:
+            raise SystemExit(
+                f"--sla-config {config_name!r} not in profile ({names})"
+            )
+        sla = SlaCapacity(
+            profile=profile,
+            ttft_sla_s=args.ttft_sla,
+            itl_sla_s=args.itl_sla,
+            config_name=config_name,
+        )
+    elif args.ttft_sla is not None or args.itl_sla is not None:
+        raise SystemExit("--ttft-sla/--itl-sla need --sla-profile")
+    return sla

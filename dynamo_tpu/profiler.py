@@ -1,0 +1,229 @@
+"""Pre-deployment SLA profiler: sweep serving configs, measure TTFT/ITL.
+
+Parity: reference benchmarks/profiler/profile_sla.py — before deploying,
+sweep engine parallelism/config against genai-perf load to find the
+cheapest config meeting TTFT/ITL SLAs, emitting interpolation tables the
+SLA planner consumes (docs/architecture/load_planner.md:40-60). Here the
+load generator is built in (no genai-perf): for each config and each
+concurrency level it drives the engine with synthetic prompts and records
+TTFT p50/p99, ITL p50/p99, and throughput.
+
+Output (JSON): {"configs": [{"name", "config", "points": [{"concurrency",
+"ttft_p50_s", "ttft_p99_s", "itl_p50_s", "itl_p99_s", "tok_s"}]}]}
+The SLA planner (planner.py SlaCapacity) reads this to answer "how many
+concurrent streams can one replica hold within SLA?".
+"""
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+from dataclasses import dataclass
+from typing import Any, Callable, Optional
+
+from dynamo_tpu.protocols.common import PreprocessedRequest, StopConditions
+
+
+def _pct(sorted_vals: list[float], q: float) -> Optional[float]:
+    if not sorted_vals:
+        return None
+    return sorted_vals[min(len(sorted_vals) - 1, int(len(sorted_vals) * q))]
+
+
+@dataclass
+class ProfilePoint:
+    concurrency: int
+    ttft_p50_s: float
+    ttft_p99_s: float
+    itl_p50_s: float
+    itl_p99_s: float
+    tok_s: float
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "concurrency": self.concurrency,
+            "ttft_p50_s": round(self.ttft_p50_s, 5),
+            "ttft_p99_s": round(self.ttft_p99_s, 5),
+            "itl_p50_s": round(self.itl_p50_s, 5),
+            "itl_p99_s": round(self.itl_p99_s, 5),
+            "tok_s": round(self.tok_s, 2),
+        }
+
+
+async def measure_point(
+    engine: Any,
+    *,
+    concurrency: int,
+    isl: int,
+    osl: int,
+    rounds: int = 2,
+    vocab: int = 250,
+) -> ProfilePoint:
+    """Drive `concurrency` simultaneous streams through the engine and
+    measure TTFT/ITL/throughput over `rounds` waves."""
+    import numpy as np
+
+    rng = np.random.RandomState(7)
+    ttfts: list[float] = []
+    itls: list[float] = []
+    total_tokens = 0
+    t_start = time.monotonic()
+
+    async def one() -> None:
+        nonlocal total_tokens
+        req = PreprocessedRequest(
+            token_ids=rng.randint(1, vocab, size=isl).tolist(),
+            stop_conditions=StopConditions(max_tokens=osl, ignore_eos=True),
+        )
+        t0 = time.monotonic()
+        prev = None
+        async for out in engine.generate(req):
+            now = time.monotonic()
+            for _ in out.token_ids:
+                if prev is None:
+                    ttfts.append(now - t0)
+                else:
+                    itls.append(now - prev)
+                prev = now
+                total_tokens += 1
+
+    for _ in range(rounds):
+        await asyncio.gather(*[one() for _ in range(concurrency)])
+    wall = time.monotonic() - t_start
+    ttfts.sort()
+    itls.sort()
+    return ProfilePoint(
+        concurrency=concurrency,
+        ttft_p50_s=_pct(ttfts, 0.5) or 0.0,
+        ttft_p99_s=_pct(ttfts, 0.99) or 0.0,
+        itl_p50_s=_pct(itls, 0.5) or 0.0,
+        itl_p99_s=_pct(itls, 0.99) or 0.0,
+        tok_s=total_tokens / wall if wall else 0.0,
+    )
+
+
+async def profile_engine(
+    make_engine: Callable[[dict], Any],
+    configs: list[dict],
+    *,
+    concurrencies: tuple[int, ...] = (1, 2, 4, 8),
+    isl: int = 64,
+    osl: int = 32,
+    rounds: int = 2,
+) -> dict[str, Any]:
+    """Sweep configs × concurrency levels; returns the profile table."""
+    out: list[dict[str, Any]] = []
+    for cfg in configs:
+        engine = make_engine(cfg)
+        start = getattr(engine, "start", None)
+        if start:
+            start()
+        points = []
+        try:
+            # warmup at the lowest concurrency to absorb compiles
+            await measure_point(engine, concurrency=1, isl=isl, osl=4,
+                                rounds=1)
+            for c in concurrencies:
+                pt = await measure_point(
+                    engine, concurrency=c, isl=isl, osl=osl, rounds=rounds
+                )
+                points.append(pt.to_dict())
+        finally:
+            stop = getattr(engine, "stop", None)
+            if stop:
+                res = stop()
+                if asyncio.iscoroutine(res):
+                    await res
+        out.append({
+            "name": cfg.get("name", "config"),
+            "config": {k: v for k, v in cfg.items() if k != "name"},
+            "points": points,
+        })
+    return {"isl": isl, "osl": osl, "configs": out}
+
+
+@dataclass
+class SlaCapacity:
+    """Answers 'how many concurrent streams fit one replica within SLA?'
+    from a profile table (the planner-side consumer,
+    reference utils/perf_interpolation.py)."""
+
+    profile: dict[str, Any]
+    ttft_sla_s: Optional[float] = None
+    itl_sla_s: Optional[float] = None
+    config_name: Optional[str] = None
+    percentile: str = "p50"  # p50 | p99
+
+    def max_concurrency(self) -> int:
+        """Highest profiled concurrency whose latencies meet the SLA
+        (0 if even concurrency 1 violates it)."""
+        cfgs = self.profile.get("configs", [])
+        if self.config_name is not None:
+            cfgs = [c for c in cfgs if c.get("name") == self.config_name]
+        best = 0
+        for cfg in cfgs:
+            for pt in cfg.get("points", []):
+                ttft = pt.get(f"ttft_{self.percentile}_s")
+                itl = pt.get(f"itl_{self.percentile}_s")
+                ok = True
+                if self.ttft_sla_s is not None and ttft is not None:
+                    ok = ok and ttft <= self.ttft_sla_s
+                if self.itl_sla_s is not None and itl is not None:
+                    ok = ok and itl <= self.itl_sla_s
+                if ok:
+                    best = max(best, int(pt["concurrency"]))
+        return best
+
+    def replicas_for(self, concurrent_streams: int,
+                     min_replicas: int = 1) -> int:
+        cap = self.max_concurrency()
+        if cap <= 0:
+            return max(min_replicas, 1)
+        import math
+
+        return max(min_replicas, math.ceil(concurrent_streams / cap))
+
+
+async def run_profile(args) -> None:
+    """CLI entry: profile the mocker (CPU) or a tiny/real TPU engine."""
+    def make(cfg: dict):
+        if args.engine == "mocker":
+            from dynamo_tpu.mocker import MockerArgs, MockerEngine
+
+            return MockerEngine(MockerArgs(
+                speedup_ratio=cfg.get("speedup_ratio", 1.0),
+                max_decode_slots=cfg.get("max_decode_slots", 8),
+                page_size=cfg.get("page_size", 16),
+                num_pages=cfg.get("num_pages", 512),
+            ))
+        from dynamo_tpu.engine.config import EngineConfig
+        from dynamo_tpu.engine.engine import TpuEngine
+        from dynamo_tpu.models.config import ModelConfig
+        from dynamo_tpu.parallel.mesh import MeshConfig
+
+        mc = getattr(ModelConfig, args.model_config)()
+        return TpuEngine(
+            mc,
+            EngineConfig(
+                num_pages=cfg.get("num_pages", 512),
+                page_size=cfg.get("page_size", 64),
+                max_decode_slots=cfg.get("max_decode_slots", 8),
+                prefill_buckets=(128,),
+                cache_dtype=cfg.get("cache_dtype", "bfloat16"),
+            ),
+            mesh_config=MeshConfig(tp=cfg.get("tp", 1)),
+        )
+
+    configs = [
+        {"name": f"slots{s}", "max_decode_slots": s}
+        for s in args.slots
+    ]
+    table = await profile_engine(
+        make, configs,
+        concurrencies=tuple(args.concurrency),
+        isl=args.isl, osl=args.osl,
+    )
+    with open(args.output, "w") as f:
+        json.dump(table, f, indent=1)
+    print(f"profile written to {args.output} "
+          f"({len(configs)} configs x {len(args.concurrency)} points)")

@@ -1,0 +1,116 @@
+"""SLA profiler tests (reference benchmarks/profiler/profile_sla.py +
+utils/perf_interpolation.py consumer)."""
+import asyncio
+
+from dynamo_tpu.mocker import MockerArgs, MockerEngine
+from dynamo_tpu.profiler import SlaCapacity, measure_point, profile_engine
+
+
+def make_mocker(cfg: dict):
+    return MockerEngine(MockerArgs(
+        speedup_ratio=cfg.get("speedup_ratio", 50.0),
+        max_decode_slots=cfg.get("max_decode_slots", 4),
+        page_size=8, num_pages=256,
+    ))
+
+
+async def test_measure_point_shapes():
+    eng = make_mocker({})
+    pt = await measure_point(eng, concurrency=2, isl=16, osl=8, rounds=1)
+    await eng.stop()
+    assert pt.concurrency == 2
+    assert pt.tok_s > 0
+    assert pt.ttft_p50_s >= 0 and pt.ttft_p99_s >= pt.ttft_p50_s
+    assert pt.itl_p50_s >= 0
+
+
+async def test_profile_engine_sweeps_and_degrades():
+    """More concurrency than slots must show worse (or equal) latency —
+    the monotonicity the SLA capacity lookup depends on."""
+    table = await profile_engine(
+        make_mocker,
+        [{"name": "slots2", "max_decode_slots": 2, "speedup_ratio": 5.0}],
+        concurrencies=(1, 8),
+        isl=16, osl=16, rounds=1,
+    )
+    pts = table["configs"][0]["points"]
+    assert [p["concurrency"] for p in pts] == [1, 8]
+    # 8 concurrent streams on 2 slots queue: TTFT must grow
+    assert pts[1]["ttft_p50_s"] > pts[0]["ttft_p50_s"]
+
+
+def test_sla_capacity_lookup():
+    profile = {"configs": [{
+        "name": "slots8",
+        "points": [
+            {"concurrency": 1, "ttft_p50_s": 0.01, "itl_p50_s": 0.005},
+            {"concurrency": 4, "ttft_p50_s": 0.05, "itl_p50_s": 0.01},
+            {"concurrency": 8, "ttft_p50_s": 0.50, "itl_p50_s": 0.05},
+        ],
+    }]}
+    cap = SlaCapacity(profile, ttft_sla_s=0.1, itl_sla_s=0.02)
+    assert cap.max_concurrency() == 4
+    assert cap.replicas_for(0) == 1
+    assert cap.replicas_for(4) == 1
+    assert cap.replicas_for(5) == 2
+    assert cap.replicas_for(12) == 3
+    # unmeetable SLA: min_replicas, not a crash
+    tight = SlaCapacity(profile, ttft_sla_s=0.001)
+    assert tight.max_concurrency() == 0
+    assert tight.replicas_for(100, min_replicas=2) == 2
+
+
+async def test_planner_sla_mode():
+    from dynamo_tpu.kv_router.protocols import (
+        ForwardPassMetrics, KvStats, WorkerStats,
+    )
+    from dynamo_tpu.planner import Planner, PlannerConfig
+    from dynamo_tpu.runtime.client import KvClient
+    from dynamo_tpu.runtime.store import serve_store
+
+    server, store = await serve_store(port=0)
+    port = server.sockets[0].getsockname()[1]
+    kv = await KvClient(port=port).connect()
+
+    class Conn:
+        n = 1
+
+        def current_replicas(self):
+            return self.n
+
+        async def set_replicas(self, n):
+            self.n = n
+
+    profile = {"configs": [{"name": "c", "points": [
+        {"concurrency": 4, "ttft_p50_s": 0.01, "itl_p50_s": 0.005},
+    ]}]}
+    planner = Planner(
+        kv, Conn(), PlannerConfig(min_replicas=1, max_replicas=5),
+        sla=SlaCapacity(profile, ttft_sla_s=0.1),
+    )
+    # 10 observed streams at capacity 4/replica -> 3 replicas
+    planner.aggregator.update(ForwardPassMetrics(
+        worker_id="w0",
+        worker_stats=WorkerStats(request_active_slots=6,
+                                 num_requests_waiting=4),
+        kv_stats=KvStats(),
+    ))
+    assert planner.decide() == 3
+    # clamped by max_replicas
+    planner.aggregator.update(ForwardPassMetrics(
+        worker_id="w0",
+        worker_stats=WorkerStats(request_active_slots=40),
+        kv_stats=KvStats(),
+    ))
+    assert planner.decide() == 5
+
+    # downscale is damped: a transient empty snapshot must not collapse
+    # the fleet — one step down only after stable_intervals lows
+    planner.connector.n = 5
+    planner.aggregator.update(ForwardPassMetrics(
+        worker_id="w0", worker_stats=WorkerStats(), kv_stats=KvStats(),
+    ))
+    assert planner.decide() == 5   # streak 1: hold
+    assert planner.decide() == 4   # streak 2: one step
+    await kv.close()
+    server.close()
