@@ -94,6 +94,8 @@ def main(argv: list[str] | None = None) -> int:
         return _run_llmctl(rest)
     if cmd == "profile":
         return _run_profile(rest)
+    if cmd == "datagen":
+        return _run_datagen(rest)
     print(f"dynamo-tpu: unknown subcommand {cmd!r}", file=sys.stderr)
     return 2
 
@@ -202,6 +204,31 @@ def _run_llmctl(rest: list[str]) -> int:
             await kv.close()
 
     return asyncio.run(run())
+
+
+def _run_datagen(rest: list[str]) -> int:
+    """Synthesize/analyze mooncake-style request traces (reference
+    benchmarks/data_generator)."""
+    import argparse
+
+    p = argparse.ArgumentParser(prog="dynamo-tpu datagen")
+    p.add_argument("--num", type=int, default=100)
+    p.add_argument("--rate", type=float, default=2.0,
+                   help="Poisson arrival rate (req/s)")
+    p.add_argument("--isl", type=int, default=256)
+    p.add_argument("--osl", type=int, default=128)
+    p.add_argument("--block-size", type=int, default=64)
+    p.add_argument("--sessions", type=int, default=20)
+    p.add_argument("--turns", type=float, default=4.0)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--output", default="trace.jsonl")
+    p.add_argument("--analyze", default=None, metavar="TRACE",
+                   help="analyze an existing trace instead of generating")
+    args = p.parse_args(rest)
+    from dynamo_tpu.data_generator import run_datagen
+
+    run_datagen(args)
+    return 0
 
 
 def _run_metrics(rest: list[str]) -> int:

@@ -69,6 +69,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--record-kv-events", default=None, metavar="PATH",
                    help="record the frontend's kv_events stream to a JSONL "
                         "file for later replay (reference KvRecorder)")
+    p.add_argument("--system-port", type=int, default=None,
+                   help="per-process /metrics + /health server port "
+                        "(reference http_server.rs); 0 = ephemeral")
     # multi-host single-engine bootstrap (reference MultiNodeConfig,
     # flags.rs:86-101 + leader_worker_barrier.rs)
     p.add_argument("--num-nodes", type=int, default=1)
@@ -377,6 +380,15 @@ async def _serve_worker(args, chain) -> None:
         model_path=args.model_path,
     )
     served = await register_llm(rt, engine, entry)
+    if args.system_port is not None:
+        from dynamo_tpu.runtime.system_server import SystemServer
+
+        sysrv = await SystemServer(
+            engine, port=args.system_port,
+            worker_id=str(served.lease_id),
+        ).start()
+        disagg_parts.append(sysrv)  # stopped alongside disagg parts
+        print(f"system server on :{sysrv.port}")
     print(
         f"worker {chain.name!r} instance {served.lease_id} "
         f"({args.role}) serving "

@@ -259,6 +259,12 @@ def _build_sla(args):
             itl_sla_s=args.itl_sla,
             config_name=config_name,
         )
+        if sla.max_concurrency() <= 0:
+            raise SystemExit(
+                f"SLA unmeetable: no profiled point of {config_name!r} "
+                f"satisfies ttft<={args.ttft_sla} itl<={args.itl_sla} — "
+                "re-profile or relax the targets"
+            )
     elif args.ttft_sla is not None or args.itl_sla is not None:
         raise SystemExit("--ttft-sla/--itl-sla need --sla-profile")
     return sla

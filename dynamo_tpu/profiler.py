@@ -24,10 +24,12 @@ from typing import Any, Callable, Optional
 from dynamo_tpu.protocols.common import PreprocessedRequest, StopConditions
 
 
-def _pct(sorted_vals: list[float], q: float) -> Optional[float]:
-    if not sorted_vals:
+def _pct(vals: list[float], q: float) -> Optional[float]:
+    if not vals:
         return None
-    return sorted_vals[min(len(sorted_vals) - 1, int(len(sorted_vals) * q))]
+    import numpy as np
+
+    return float(np.percentile(vals, q * 100.0))
 
 
 @dataclass
@@ -120,9 +122,12 @@ async def profile_engine(
             start()
         points = []
         try:
-            # warmup at the lowest concurrency to absorb compiles
-            await measure_point(engine, concurrency=1, isl=isl, osl=4,
-                                rounds=1)
+            # warmup at the HIGHEST measured concurrency: compiles are per
+            # (bucket, width) shape, and the widest shapes only appear at
+            # full batch — a narrow warmup would leave compile stalls
+            # inside the measured latencies
+            await measure_point(engine, concurrency=max(concurrencies),
+                                isl=isl, osl=4, rounds=1)
             for c in concurrencies:
                 pt = await measure_point(
                     engine, concurrency=c, isl=isl, osl=osl, rounds=rounds
@@ -166,10 +171,11 @@ class SlaCapacity:
                 ttft = pt.get(f"ttft_{self.percentile}_s")
                 itl = pt.get(f"itl_{self.percentile}_s")
                 ok = True
-                if self.ttft_sla_s is not None and ttft is not None:
-                    ok = ok and ttft <= self.ttft_sla_s
-                if self.itl_sla_s is not None and itl is not None:
-                    ok = ok and itl <= self.itl_sla_s
+                if self.ttft_sla_s is not None:
+                    # a point MISSING the measurement cannot prove the SLA
+                    ok = ok and ttft is not None and ttft <= self.ttft_sla_s
+                if self.itl_sla_s is not None:
+                    ok = ok and itl is not None and itl <= self.itl_sla_s
                 if ok:
                     best = max(best, int(pt["concurrency"]))
         return best

@@ -1,0 +1,108 @@
+"""Per-process system HTTP server: /metrics + /health on every worker.
+
+Parity: reference lib/runtime/src/http_server.rs:27-45,91 — each process
+exposes its own Prometheus endpoint (uptime + process-local stats) so
+operators can scrape workers directly, independent of the frontend's
+service metrics and the standalone re-exporter.
+"""
+from __future__ import annotations
+
+import logging
+import time
+from typing import Any, Optional
+
+from aiohttp import web
+
+log = logging.getLogger(__name__)
+
+
+class SystemServer:
+    """Tiny per-process observability server. `engine` is optional: when
+    it exposes `metrics()` (ForwardPassMetrics), those gauges are
+    rendered alongside uptime."""
+
+    def __init__(
+        self,
+        engine: Any = None,
+        *,
+        host: str = "0.0.0.0",
+        port: int = 0,
+        worker_id: str = "",
+    ):
+        self.engine = engine
+        self.host = host
+        self.port = port
+        self.worker_id = worker_id
+        self._started = time.monotonic()
+        self._runner: Optional[web.AppRunner] = None
+        self.app = web.Application()
+        self.app.add_routes([
+            web.get("/metrics", self.handle_metrics),
+            web.get("/health", self.handle_health),
+            web.get("/live", self.handle_health),
+        ])
+
+    async def start(self) -> "SystemServer":
+        self._runner = web.AppRunner(self.app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.host, self.port)
+        await site.start()
+        self.port = site._server.sockets[0].getsockname()[1]
+        log.info("system server on %s:%d", self.host, self.port)
+        return self
+
+    async def stop(self) -> None:
+        if self._runner is not None:
+            await self._runner.cleanup()
+            self._runner = None
+
+    def render(self) -> str:
+        lines = [
+            "# HELP dynamo_system_uptime_seconds process uptime",
+            "# TYPE dynamo_system_uptime_seconds gauge",
+            f"dynamo_system_uptime_seconds "
+            f"{time.monotonic() - self._started:.3f}",
+        ]
+        metrics_fn = getattr(self.engine, "metrics", None)
+        if metrics_fn is not None:
+            try:
+                m = metrics_fn()
+            except Exception:  # noqa: BLE001 — observability must not throw
+                log.exception("engine metrics failed")
+                m = None
+            if m is not None:
+                w = self.worker_id or m.worker_id
+
+                def g(name: str, help_: str, v) -> None:
+                    lines.append(f"# HELP {name} {help_}")
+                    lines.append(f"# TYPE {name} gauge")
+                    lines.append(f'{name}{{worker="{w}"}} {v}')
+
+                ws, ks = m.worker_stats, m.kv_stats
+                g("dynamo_worker_active_slots", "requests in decode slots",
+                  ws.request_active_slots)
+                g("dynamo_worker_total_slots", "decode slot capacity",
+                  ws.request_total_slots)
+                g("dynamo_worker_waiting_requests", "queued requests",
+                  ws.num_requests_waiting)
+                g("dynamo_kv_active_blocks", "KV pages in use",
+                  ks.kv_active_blocks)
+                g("dynamo_kv_total_blocks", "KV page capacity",
+                  ks.kv_total_blocks)
+                g("dynamo_kv_usage_perc", "KV pool usage fraction",
+                  ks.gpu_cache_usage_perc)
+                g("dynamo_kv_hit_rate", "prefix cache hit rate",
+                  ks.gpu_prefix_cache_hit_rate)
+                g("dynamo_kv_host_blocks", "host-tier (G2) cached pages",
+                  ks.host_blocks)
+        return "\n".join(lines) + "\n"
+
+    async def handle_metrics(self, request: web.Request) -> web.Response:
+        return web.Response(text=self.render(), content_type="text/plain")
+
+    async def handle_health(self, request: web.Request) -> web.Response:
+        return web.json_response({
+            "status": "ok",
+            "uptime_s": round(time.monotonic() - self._started, 3),
+            "worker_id": self.worker_id,
+        })

@@ -195,3 +195,26 @@ async def test_metrics_exporter_prometheus():
     await wkv.close()
     await kv.close()
     server.close()
+
+
+async def test_system_server_per_worker():
+    """Reference http_server.rs parity: each worker process exposes its
+    own /metrics + /health."""
+    import aiohttp
+
+    from dynamo_tpu.mocker import MockerArgs, MockerEngine
+    from dynamo_tpu.runtime.system_server import SystemServer
+
+    eng = MockerEngine(MockerArgs(speedup_ratio=100.0, page_size=4))
+    srv = await SystemServer(eng, host="127.0.0.1", port=0,
+                             worker_id="w9").start()
+    async with aiohttp.ClientSession() as s:
+        async with s.get(f"http://127.0.0.1:{srv.port}/health") as r:
+            body = await r.json()
+            assert body["status"] == "ok" and body["worker_id"] == "w9"
+        async with s.get(f"http://127.0.0.1:{srv.port}/metrics") as r:
+            text = await r.text()
+    assert "dynamo_system_uptime_seconds" in text
+    assert 'dynamo_worker_total_slots{worker="w9"} 8' in text
+    await srv.stop()
+    await eng.stop()
