@@ -327,17 +327,30 @@ class HttpService:
             if isinstance(r, BaseException):
                 raise r
         if chat:
-            choices = [
-                {
+            choices = []
+            for i in range(len(streams)):
+                message: dict = {"role": "assistant", "content": texts[i]}
+                finish = finishes[i].to_openai()
+                if getattr(req, "tools", None):
+                    from dynamo_tpu.tool_calls import (
+                        parse_tool_calls_with_content,
+                    )
+
+                    calls, content = parse_tool_calls_with_content(
+                        texts[i], _declared_tool_names(req)
+                    )
+                    if calls is not None:
+                        message = {"role": "assistant", "content": content,
+                                   "tool_calls": calls}
+                        finish = "tool_calls"
+                choices.append({
                     "index": i,
-                    "message": {"role": "assistant", "content": texts[i]},
-                    "finish_reason": finishes[i].to_openai(),
+                    "message": message,
+                    "finish_reason": finish,
                     "logprobs": (
                         {"content": lp_entries[i]} if lp_entries[i] else None
                     ),
-                }
-                for i in range(len(streams))
-            ]
+                })
             body = chat_completion_response(
                 rid=make_id("chatcmpl"),
                 model=req.model,
@@ -384,6 +397,14 @@ class HttpService:
         gen = DeltaGenerator(req.model, chat=chat, n=max(1, req.n))
         streams = self._fanout(req, chain, pre)
         completion_tokens = 0
+        # tool-call detection: hold back tool-shaped text until it parses
+        tool_accs: dict[int, Any] = {}
+        if chat and getattr(req, "tools", None):
+            from dynamo_tpu.tool_calls import ToolCallAccumulator
+
+            allowed = _declared_tool_names(req)
+            tool_accs = {i: ToolCallAccumulator(allowed)
+                         for i in range(len(streams))}
         queue: asyncio.Queue = asyncio.Queue()
         DONE = object()
 
@@ -406,26 +427,53 @@ class HttpService:
                     continue
                 if isinstance(item, Exception):
                     # the failed pump's DONE sentinel still arrives and
-                    # decrements `live`; just surface the error in-band
+                    # decrements `live`; just surface the error in-band.
+                    # Flush any tool-detection buffer first — held-back
+                    # text must not vanish with the error.
+                    if i in tool_accs:
+                        _calls, leftover = tool_accs[i].finalize()
+                        if leftover:
+                            await resp.write(encode_event(
+                                gen.text_chunk(leftover, index=i)
+                            ))
                     log.warning("engine stream %d failed: %s", i, item)
                     await resp.write(
                         encode_event({"error": {"message": str(item)}})
                     )
                     continue
                 completion_tokens += len(item.token_ids)
-                if item.text or item.logprob_entries:
+                text = item.text or ""
+                if i in tool_accs and text:
+                    text = tool_accs[i].feed(text)
+                if text or item.logprob_entries:
                     # entries may arrive on a text-less output (final token
                     # eaten by the stop jail / partial UTF-8) — still owed
                     # to the client, one entry per token
                     await resp.write(
                         encode_event(gen.text_chunk(
-                            item.text or "", index=i,
+                            text, index=i,
                             logprob_entries=item.logprob_entries,
                         ))
                     )
                 if item.finish_reason is not None:
+                    finish_override = None
+                    if i in tool_accs:
+                        calls, leftover = tool_accs[i].finalize()
+                        if leftover:
+                            # hermes prose / text that wasn't a tool call
+                            await resp.write(encode_event(
+                                gen.text_chunk(leftover, index=i)
+                            ))
+                        if calls is not None:
+                            await resp.write(encode_event(
+                                gen.tool_calls_chunk(calls, index=i)
+                            ))
+                            finish_override = "tool_calls"
                     await resp.write(
-                        encode_event(gen.finish_chunk(item.finish_reason, index=i))
+                        encode_event(gen.finish_chunk(
+                            item.finish_reason, index=i,
+                            finish_override=finish_override,
+                        ))
                     )
             if req.stream_options and req.stream_options.include_usage:
                 await resp.write(
@@ -454,6 +502,18 @@ class HttpService:
                         pass
         await resp.write_eof()
         return resp
+
+
+def _declared_tool_names(req) -> "Optional[set]":
+    """Function names declared in the request's tools (None when they
+    can't be extracted — then any well-formed call name is accepted)."""
+    names = set()
+    for t in getattr(req, "tools", None) or []:
+        if isinstance(t, dict):
+            n = (t.get("function") or {}).get("name") or t.get("name")
+            if n:
+                names.add(n)
+    return names or None
 
 
 def _with_choice_seed(pre, i: int):

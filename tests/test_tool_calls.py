@@ -1,0 +1,246 @@
+"""Tool-call parsing + serving tests (reference protocols/openai tool
+plumbing: tool_calls responses, finish_reason tool_calls, streamed
+deltas)."""
+import json
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from dynamo_tpu.frontend import HttpService, ModelManager
+from dynamo_tpu.protocols.common import FinishReason, LLMEngineOutput
+from dynamo_tpu.protocols.sse import SseDecoder
+from dynamo_tpu.tool_calls import ToolCallAccumulator, parse_tool_calls
+
+
+# ---------------------------------------------------------------------------
+# parser
+
+
+def test_parse_llama3_json_single_and_array():
+    calls = parse_tool_calls(
+        ' {"name": "get_weather", "parameters": {"city": "SF"}} '
+    )
+    assert len(calls) == 1
+    assert calls[0]["type"] == "function"
+    assert calls[0]["function"]["name"] == "get_weather"
+    assert json.loads(calls[0]["function"]["arguments"]) == {"city": "SF"}
+    assert calls[0]["id"].startswith("call_")
+
+    arr = parse_tool_calls(
+        '[{"name": "a", "arguments": {}}, {"name": "b", "parameters": {"x": 1}}]'
+    )
+    assert [c["function"]["name"] for c in arr] == ["a", "b"]
+
+
+def test_parse_hermes_tags():
+    calls = parse_tool_calls(
+        'something\n<tool_call>{"name": "f", "arguments": {"k": 2}}</tool_call>'
+        '<tool_call>{"name": "g", "arguments": {}}</tool_call>'
+    )
+    assert [c["function"]["name"] for c in calls] == ["f", "g"]
+
+
+def test_parse_rejects_non_tool_text():
+    assert parse_tool_calls("hello there") is None
+    assert parse_tool_calls('{"not": "a tool"}') is None
+    assert parse_tool_calls('{"name": broken json') is None
+    assert parse_tool_calls("<tool_call>{unterminated") is None
+    assert parse_tool_calls("") is None
+
+
+def test_accumulator_releases_plain_text_immediately():
+    acc = ToolCallAccumulator()
+    assert acc.feed("Hello") == "Hello"
+    assert acc.feed(" world") == " world"
+    calls, leftover = acc.finalize()
+    assert calls is None and not leftover
+
+
+def test_accumulator_buffers_and_parses_tool_call():
+    acc = ToolCallAccumulator()
+    assert acc.feed('{"name": "f",') == ""
+    assert acc.feed(' "parameters": {}}') == ""
+    calls, leftover = acc.finalize()
+    assert calls is not None and calls[0]["function"]["name"] == "f"
+    assert not leftover
+
+
+def test_accumulator_releases_failed_parse_as_content():
+    acc = ToolCallAccumulator()
+    assert acc.feed("{oops not json") == ""
+    calls, leftover = acc.finalize()
+    assert calls is None and leftover == "{oops not json"
+
+
+# ---------------------------------------------------------------------------
+# service level (fake chain emitting text deltas)
+
+
+class _TextChain:
+    """Chain stub: emits scripted text deltas (what a template-driven
+    model would generate for a tool prompt)."""
+
+    name = "toolm"
+    chat = True
+    completions = True
+
+    def __init__(self, pieces):
+        self.pieces = pieces
+
+    def preprocess(self, req):
+        from dynamo_tpu.protocols.common import PreprocessedRequest
+
+        return PreprocessedRequest(token_ids=[1, 2, 3])
+
+    def generate(self, pre):
+        async def run():
+            for p in self.pieces:
+                yield LLMEngineOutput(token_ids=[0], text=p)
+            yield LLMEngineOutput(token_ids=[], finish_reason=FinishReason.EOS)
+
+        return run()
+
+
+def make_service(pieces):
+    manager = ModelManager()
+    manager.register(_TextChain(pieces))
+    return HttpService(manager)
+
+
+TOOLS = [{"type": "function",
+          "function": {"name": "get_weather", "parameters": {}}}]
+
+
+async def test_unary_chat_tool_calls():
+    svc = make_service(['{"name": "get_weather", ', '"parameters": {"c": 1}}'])
+    client = TestClient(TestServer(svc.app))
+    await client.start_server()
+    r = await client.post("/v1/chat/completions", json={
+        "model": "toolm",
+        "messages": [{"role": "user", "content": "weather?"}],
+        "tools": TOOLS,
+    })
+    body = await r.json()
+    choice = body["choices"][0]
+    assert choice["finish_reason"] == "tool_calls"
+    assert choice["message"]["content"] is None
+    call = choice["message"]["tool_calls"][0]
+    assert call["function"]["name"] == "get_weather"
+    assert json.loads(call["function"]["arguments"]) == {"c": 1}
+
+    # without tools declared, the same text is plain content
+    r = await client.post("/v1/chat/completions", json={
+        "model": "toolm",
+        "messages": [{"role": "user", "content": "weather?"}],
+    })
+    body = await r.json()
+    assert body["choices"][0]["finish_reason"] == "stop"
+    assert body["choices"][0]["message"]["content"].startswith('{"name"')
+    await client.close()
+
+
+async def test_streaming_chat_tool_calls():
+    svc = make_service(['{"name": "get_weather", ', '"parameters": {}}'])
+    client = TestClient(TestServer(svc.app))
+    await client.start_server()
+    r = await client.post("/v1/chat/completions", json={
+        "model": "toolm",
+        "messages": [{"role": "user", "content": "weather?"}],
+        "tools": TOOLS,
+        "stream": True,
+    })
+    dec = SseDecoder()
+    content_chunks, tool_deltas, finish = [], [], None
+    for ev in dec.feed(await r.read()):
+        if ev.is_done:
+            continue
+        chunk = json.loads(ev.data)
+        for c in chunk.get("choices", []):
+            if c.get("delta", {}).get("content"):
+                content_chunks.append(c["delta"]["content"])
+            if c.get("delta", {}).get("tool_calls"):
+                tool_deltas.extend(c["delta"]["tool_calls"])
+            if c.get("finish_reason"):
+                finish = c["finish_reason"]
+    assert content_chunks == []          # tool text never leaked as content
+    assert finish == "tool_calls"
+    assert tool_deltas[0]["function"]["name"] == "get_weather"
+    await client.close()
+
+
+async def test_streaming_plain_text_with_tools_declared():
+    """Tools declared but the model answers normally: content streams
+    through (after the undecided first char resolves)."""
+    svc = make_service(["Sunny ", "today."])
+    client = TestClient(TestServer(svc.app))
+    await client.start_server()
+    r = await client.post("/v1/chat/completions", json={
+        "model": "toolm",
+        "messages": [{"role": "user", "content": "weather?"}],
+        "tools": TOOLS,
+        "stream": True,
+    })
+    dec = SseDecoder()
+    text, finish = "", None
+    for ev in dec.feed(await r.read()):
+        if ev.is_done:
+            continue
+        chunk = json.loads(ev.data)
+        for c in chunk.get("choices", []):
+            text += c.get("delta", {}).get("content") or ""
+            if c.get("finish_reason"):
+                finish = c["finish_reason"]
+    assert text == "Sunny today."
+    assert finish == "stop"
+    await client.close()
+
+
+def test_parse_strict_rejects_content_objects_and_unknown_names():
+    # extra keys: a content object that merely HAS "name" is not a call
+    assert parse_tool_calls('{"name": "Alice", "age": 30}') is None
+    # declared-name validation
+    assert parse_tool_calls('{"name": "evil", "arguments": {}}',
+                            allowed={"get_weather"}) is None
+    assert parse_tool_calls('{"name": "get_weather", "arguments": {}}',
+                            allowed={"get_weather"}) is not None
+
+
+def test_parse_hermes_preserves_surrounding_prose():
+    from dynamo_tpu.tool_calls import parse_tool_calls_with_content
+
+    calls, content = parse_tool_calls_with_content(
+        "Let me check.\n"
+        '<tool_call>{"name": "f", "arguments": {}}</tool_call>'
+        "\nOne moment."
+    )
+    assert calls and calls[0]["function"]["name"] == "f"
+    assert "Let me check." in content and "One moment." in content
+
+
+def test_accumulator_releases_diverged_tag_early():
+    acc = ToolCallAccumulator()
+    # '<p' diverges from '<tool_call>' at the 2nd char -> released at once
+    assert acc.feed("<p>") == "<p>"
+    assert acc.feed("hello") == "hello"
+    calls, leftover = acc.finalize()
+    assert calls is None and not leftover
+
+
+def test_accumulator_releases_non_tool_json_once_complete():
+    acc = ToolCallAccumulator()
+    assert acc.feed('{"answer":') == ""
+    out = acc.feed(' 42}')
+    assert out == '{"answer": 42}'         # complete non-tool JSON released
+    calls, leftover = acc.finalize()
+    assert calls is None and leftover is None
+
+
+def test_accumulator_catches_mid_stream_hermes_tag():
+    acc = ToolCallAccumulator()
+    released = acc.feed("Okay. ")
+    released += acc.feed('<tool_call>{"name": "f", ')
+    released += acc.feed('"arguments": {}}</tool_call>')
+    assert released.startswith("Okay. ")
+    assert "<tool_call>" not in released
+    calls, leftover = acc.finalize()
+    assert calls is not None and calls[0]["function"]["name"] == "f"
