@@ -22,6 +22,7 @@ import asyncio
 import json
 import os
 import sys
+import time
 from typing import Any, Optional
 
 
@@ -44,6 +45,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--http-port", type=int, default=cfg.http_port)
     p.add_argument("--prompt", default=None, help="prompt for in=text")
     p.add_argument("--max-tokens", type=int, default=64)
+    p.add_argument("--trace-speedup", type=float, default=0.0,
+                   help="in=batch with a mooncake trace: replay arrival "
+                        "timestamps at this speed multiple (0 = ignore "
+                        "timestamps, submit all at once)")
+    p.add_argument("--trace-block-size", type=int, default=64,
+                   help="tokens represented by one trace hash id (must "
+                        "match the datagen --block-size for the trace's "
+                        "prefix sharing to replay faithfully)")
     p.add_argument("--tensor-parallel-size", type=int, default=1)
     p.add_argument("--num-pages", type=int, default=cfg.num_pages)
     p.add_argument("--page-size", type=int, default=cfg.page_size)
@@ -305,20 +314,120 @@ async def _serve_stdin(args, chain) -> None:
 
 
 async def _serve_batch(args, chain, path: str) -> None:
+    """Batch mode doubles as the built-in benchmark (reference
+    entrypoint/input/batch.rs:294): plain {"prompt": ...} JSONL runs
+    through the chat chain; mooncake trace records (datagen output, with
+    hash_ids/input_length/output_length) replay token-level with their
+    prefix-sharing structure intact and timestamp pacing via
+    --trace-speedup. Both print a summary line at the end."""
     with open(path) as f:
         recs = [json.loads(line) for line in f if line.strip()]
+    is_trace = bool(recs) and "hash_ids" in recs[0]
     # submit concurrently so the continuous-batching engine actually batches
     sem = asyncio.Semaphore(64)
+    ttfts: list[float] = []
+    total_tokens = 0
+    t0 = time.monotonic()
 
     async def one(rec):
+        nonlocal total_tokens
         async with sem:
-            return await _one_prompt(
-                chain, rec.get("prompt", ""), rec.get("max_tokens", args.max_tokens)
+            t_sub = time.monotonic()
+            first = None
+            if is_trace:
+                pre = _trace_request(rec, args.trace_block_size)
+                n = 0
+                async for out in chain.generate(pre):
+                    if first is None and out.token_ids:
+                        first = time.monotonic() - t_sub
+                    n += len(out.token_ids)
+                total_tokens += n
+                if first is not None:
+                    ttfts.append(first)
+                return n
+            text = await _one_prompt(
+                chain, rec.get("prompt", ""),
+                rec.get("max_tokens", args.max_tokens),
             )
+            ttfts.append(time.monotonic() - t_sub)
+            return text
 
-    texts = await asyncio.gather(*[one(r) for r in recs])
-    for rec, text in zip(recs, texts):
-        print(json.dumps({"prompt": rec.get("prompt", ""), "text": text}))
+    async def paced(rec, delay_s):
+        if delay_s > 0:
+            await asyncio.sleep(delay_s)
+        return await one(rec)
+
+    if is_trace and args.trace_speedup > 0:
+        base_ms = recs[0].get("timestamp", 0)
+        tasks = [
+            paced(r, (r.get("timestamp", 0) - base_ms) / 1000.0
+                  / args.trace_speedup)
+            for r in recs
+        ]
+    else:
+        tasks = [one(r) for r in recs]
+    results = await asyncio.gather(*tasks)
+    wall = time.monotonic() - t0
+    if not is_trace:
+        for rec, text in zip(recs, results):
+            print(json.dumps({"prompt": rec.get("prompt", ""),
+                              "text": text}))
+    ttfts.sort()
+    summary = {
+        "requests": len(recs),
+        "wall_s": round(wall, 3),
+        "requests_per_s": round(len(recs) / wall, 2) if wall else None,
+    }
+    # trace mode measures a real first-token time; the prompt path only
+    # observes whole-request latency — name the metrics honestly
+    prefix = "ttft" if is_trace else "latency"
+    summary[f"{prefix}_p50_s"] = (
+        round(ttfts[len(ttfts) // 2], 4) if ttfts else None
+    )
+    summary[f"{prefix}_p99_s"] = (
+        round(ttfts[min(len(ttfts) - 1, int(len(ttfts) * 0.99))], 4)
+        if ttfts else None
+    )
+    if is_trace:  # token counts only exist on the token-level replay path
+        summary["output_tok_s"] = round(total_tokens / wall, 2) \
+            if wall else None
+    print(json.dumps({"batch_summary": summary}), file=sys.stderr)
+
+
+def _trace_request(rec: dict, block_size: int = 64) -> "Any":
+    """Mooncake record -> PreprocessedRequest with DETERMINISTIC tokens
+    per hash id, so equal hash prefixes produce equal token blocks and the
+    prefix cache / KV router see the trace's sharing structure. The hash →
+    tokens mapping uses a FIXED block_size (one hash = block_size tokens):
+    a per-record size would make the same hash expand differently across
+    records and destroy the sharing the replay exists to measure."""
+    from dynamo_tpu.protocols.common import (
+        PreprocessedRequest,
+        StopConditions,
+    )
+
+    hash_ids = rec.get("hash_ids") or [0]
+    isl = max(1, int(rec.get("input_length", 1)))
+    tokens: list[int] = []
+    for h in hash_ids:
+        base = (int(h) * 2654435761) & 0x7FFFFFFF
+        tokens.extend(
+            (base + j * 40503) % 30000 + 10 for j in range(block_size)
+        )
+        if len(tokens) >= isl:
+            break
+    if len(tokens) < isl:  # trace lengths can exceed hash coverage
+        tokens.extend(
+            (len(tokens) + j) % 30000 + 10
+            for j in range(isl - len(tokens))
+        )
+    return PreprocessedRequest(
+        token_ids=tokens[:isl],
+        stop_conditions=StopConditions(
+            max_tokens=max(1, int(rec.get("output_length", 16))),
+            ignore_eos=True,
+        ),
+    )
 
 
 def _cp_addr(args) -> tuple[str, int]:

@@ -53,3 +53,53 @@ def test_trace_roundtrip_and_analyze(tmp_path):
     first = json.loads(open(path).readline())
     assert set(first) == {"timestamp", "input_length", "output_length",
                           "hash_ids"}
+
+
+def test_trace_request_determinism_and_sharing():
+    """Equal hash prefixes must produce equal token prefixes EVEN when the
+    records' input_length/hash ratios differ (real synthesize() output) —
+    the property that makes trace replay exercise the prefix cache."""
+    from dynamo_tpu.launch.run import _trace_request
+
+    bs = 16
+    a = _trace_request({"input_length": 32, "output_length": 8,
+                        "hash_ids": [1, 2]}, bs)
+    b = _trace_request({"input_length": 48, "output_length": 8,
+                        "hash_ids": [1, 2, 3]}, bs)
+    assert a.token_ids == b.token_ids[: len(a.token_ids)]  # shared prefix
+    # divergent ratios (the realistic case): 27/2 vs 41/3 hash coverage
+    d = _trace_request({"input_length": 27, "output_length": 8,
+                        "hash_ids": [1, 2]}, bs)
+    e = _trace_request({"input_length": 41, "output_length": 8,
+                        "hash_ids": [1, 2, 3]}, bs)
+    assert d.token_ids == e.token_ids[: len(d.token_ids)]
+    c = _trace_request({"input_length": 32, "output_length": 8,
+                        "hash_ids": [9, 10]}, bs)
+    assert c.token_ids != a.token_ids
+    assert a.stop_conditions.max_tokens == 8
+    assert all(0 < t < 2**31 for t in a.token_ids)
+
+
+def test_trace_request_sharing_on_real_synthesized_trace():
+    """End-to-end property on actual datagen output: every hash-prefix
+    pair in the trace yields a shared token prefix through _trace_request
+    (with block_size matching the trace's)."""
+    from dynamo_tpu.launch.run import _trace_request
+
+    bs = 16
+    records = synthesize(TraceConfig(num_requests=40, num_sessions=4,
+                                     turns_mean=6.0, block_size=bs,
+                                     seed=5))
+    reqs = [_trace_request(r, bs) for r in records]
+    checked = 0
+    for i, ri in enumerate(records):
+        for j, rj in enumerate(records):
+            hi, hj = ri["hash_ids"], rj["hash_ids"]
+            if i != j and len(hi) < len(hj) and hj[: len(hi)] == hi:
+                shared_tokens = min(len(hi) * bs,
+                                    len(reqs[i].token_ids),
+                                    len(reqs[j].token_ids))
+                assert reqs[i].token_ids[:shared_tokens] == \
+                    reqs[j].token_ids[:shared_tokens]
+                checked += 1
+    assert checked > 5  # the trace really contains sharing to check
