@@ -74,6 +74,7 @@ async def register_llm(
     worker_id: str = "",
     lease_ttl_s: float = 5.0,
     publish_kv_events: bool = True,
+    kv_resync_interval_s: float = 60.0,
 ):
     """Worker-side: serve the engine + publish the model entry. Entries are
     per-instance keys suffixed with the lease id, so the model vanishes
@@ -116,6 +117,36 @@ async def register_llm(
         allocator.worker_id = str(served.lease_id)
         allocator.on_event = pub
         served.kv_publisher = pub
+        if kv_resync_interval_s > 0:
+            # periodic authoritative resync: the pub/sub plane is lossy
+            # (slow consumers drop), and a dropped STORED would otherwise
+            # skew routing until the worker restarts
+            async def resync_loop():
+                while True:
+                    await asyncio.sleep(kv_resync_interval_s)
+                    try:
+                        events = allocator.snapshot_stored_events()
+                        # all-or-nothing: a CLEARED whose STORED batches
+                        # get dropped by a full queue would ERASE correct
+                        # routing state instead of healing it. This loop
+                        # runs on the publisher's own loop, so the
+                        # capacity check + enqueue burst is atomic wrt
+                        # other (call_soon_threadsafe) producers.
+                        free = pub.queue.maxsize - pub.queue.qsize()
+                        if free < len(events):
+                            log.warning(
+                                "kv resync skipped: publisher backlog "
+                                "(%d free < %d events)", free, len(events)
+                            )
+                            continue
+                        for ev in events:
+                            pub(ev)  # stamps worker_id, same as live path
+                    except Exception:  # noqa: BLE001 — keep resyncing
+                        log.exception("kv resync failed")
+
+            served.kv_resync_task = asyncio.get_running_loop().create_task(
+                resync_loop()
+            )
     # load-metrics plane (planner + standalone exporter consume this)
     if hasattr(engine, "on_metrics"):
         from dynamo_tpu.runtime.publisher import WorkerMetricsPublisher

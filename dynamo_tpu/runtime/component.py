@@ -67,6 +67,9 @@ class ServedEndpoint:
 
     async def shutdown(self) -> None:
         """Graceful drain: revoke lease (deregisters) then stop serving."""
+        task = getattr(self, "kv_resync_task", None)
+        if task is not None:
+            task.cancel()
         await self.lease.revoke()
         await self.server.stop()
 
