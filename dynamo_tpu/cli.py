@@ -262,6 +262,10 @@ def _run_planner(rest: list[str]) -> int:
     p.add_argument("--adjustment-interval", type=float, default=10.0)
     p.add_argument("--min-replicas", type=int, default=1)
     p.add_argument("--max-replicas", type=int, default=8)
+    p.add_argument("--predictor", default="constant",
+                   choices=("constant", "moving_average", "ar", "arima"),
+                   help="load forecaster filtering observations before "
+                        "scaling decisions (reference load_predictor.py)")
     # SLA mode (reference planner_sla.py): consume a profiler table
     p.add_argument("--sla-profile", default=None, metavar="PROFILE_JSON",
                    help="profile from `dynamo-tpu profile`; enables SLA "

@@ -43,6 +43,8 @@ class RuntimeConfig:
     max_decode_slots: int = 8
     cache_dtype: str = "bfloat16"
     host_offload_pages: int = 0
+    disk_offload_pages: int = 0
+    disk_offload_path: Optional[str] = None
 
     @property
     def store_host_port(self) -> tuple[str, int]:

@@ -48,6 +48,12 @@ class EngineConfig:
     # LRU are asynchronously copied to a host pool of this many pages;
     # prefix misses in HBM onboard from it instead of recomputing.
     host_offload_pages: int = 0
+    # mmap-backed disk tier (KVBM G3, reference storage/disk.rs:25): 0
+    # disables. G2's LRU evictions spill into it; requires G2 enabled
+    # (the tier hierarchy is strict: G1 -> G2 -> G3).
+    disk_offload_pages: int = 0
+    # backing file for the G3 pool (None = fresh tempfile per engine)
+    disk_offload_path: Optional[str] = None
     # offload dispatch cap per scheduling round (bounds the per-round
     # gather size; pow2-bucketed for compile-cache reuse)
     offload_batch: int = 8

@@ -190,13 +190,28 @@ class TpuEngine:
         # never a swap that could drop a concurrent append.
         self.offload = None
         self._offload_cands: deque = deque()
+        if e.disk_offload_pages > 0 and e.host_offload_pages <= 0:
+            raise ValueError(
+                "disk_offload_pages (G3) requires host_offload_pages (G2): "
+                "the tier hierarchy is strict (block_manager.rs:69-82)"
+            )
         if e.host_offload_pages > 0:
-            from dynamo_tpu.engine.offload import HostOffloadTier
+            from dynamo_tpu.engine.offload import (
+                DiskOffloadTier,
+                HostOffloadTier,
+            )
 
+            page_shape = (
+                2, c.num_layers, c.num_kv_heads, e.page_size, c.head_dim
+            )
+            spill = None
+            if e.disk_offload_pages > 0:
+                spill = DiskOffloadTier(
+                    e.disk_offload_pages, page_shape, cache_dtype,
+                    path=e.disk_offload_path,
+                )
             self.offload = HostOffloadTier(
-                e.host_offload_pages,
-                (2, c.num_layers, c.num_kv_heads, e.page_size, c.head_dim),
-                cache_dtype,
+                e.host_offload_pages, page_shape, cache_dtype, spill=spill,
             )
             self.allocator.on_park = (
                 lambda p, h, par: self._offload_cands.append((p, h, par))
@@ -386,6 +401,8 @@ class TpuEngine:
             await asyncio.to_thread(self._thread.join, 30.0)
         # items raced in after the loop's own exit drain
         self._drain_xfer_queue()
+        if self.offload is not None and self.offload.spill is not None:
+            self.offload.spill.close()
 
     # ------------------------------------------------------------------
     # AsyncEngine surface
@@ -505,6 +522,19 @@ class TpuEngine:
                 if kind == "export":
                     out = self._gather_padded(ids)
                     box["result"] = np.asarray(out)[:, :, :, : len(ids)]
+                elif kind == "clear":
+                    n = self.allocator.clear()
+                    self._offload_cands.clear()  # parked refs now stale
+                    if self.offload is not None:
+                        n += self.offload.clear()
+                        # in-flight D2H offload batches would repopulate
+                        # the tiers after the clear — drop them (their
+                        # fetches complete harmlessly, results unused)
+                        self._entries = [
+                            en for en in self._entries
+                            if en.kind != "offload"
+                        ]
+                    box["result"] = n
                 else:
                     self._scatter_padded(ids, data)
                     box["result"] = None
@@ -512,6 +542,13 @@ class TpuEngine:
                 box["error"] = e
             finally:
                 done.set()
+
+    def clear_kv_blocks(self) -> int:
+        """Drop all reusable cached pages across every tier (G1 HBM LRU +
+        G2 DRAM + G3 disk) — the /clear_kv_blocks operation (reference
+        http/service/clear_kv_blocks.rs). In-use pages survive. Thread-safe:
+        serviced by the engine loop at a round boundary."""
+        return self._xfer_op("clear", [], None)
 
     def embed(self, token_ids: list[int]) -> list[float]:
         """Mean-pooled normalized embedding of a prompt (the /v1/embeddings
@@ -555,6 +592,14 @@ class TpuEngine:
                 ),
                 host_onboard_hits=(
                     self.offload.onboard_hits if self.offload else 0
+                ),
+                disk_blocks=(
+                    len(self.offload.spill)
+                    if self.offload and self.offload.spill else 0
+                ),
+                disk_total_blocks=(
+                    self.offload.spill.num_pages
+                    if self.offload and self.offload.spill else 0
                 ),
             ),
         )

@@ -1,11 +1,13 @@
-"""Host-DRAM KV offload tier (KVBM G2 — reference block_manager/offload.rs).
+"""Host-side KV offload tiers: G2 DRAM + G3 disk (KVBM — reference
+block_manager/offload.rs, block_manager/storage/disk.rs:25).
 
-Reference shape (offload.rs:46-80, pool.rs:156): blocks leaving the device
-pool's reuse set are offloaded down the tier hierarchy (G1 HBM -> G2 DRAM
--> G3 disk) through a priority queue with batched transfers; prefix hits
-consult lower tiers and onboard blocks back up. This buys the BASELINE's
-"40% TTFT from KV offload to CPU RAM" on multi-turn traffic whose working
-set exceeds HBM.
+Reference shape (offload.rs:46-80, pool.rs:156, block_manager.rs:69-82):
+blocks leaving the device pool's reuse set are offloaded down the tier
+hierarchy (G1 HBM -> G2 DRAM -> G3 disk) through a priority queue with
+batched transfers; prefix hits consult lower tiers and onboard blocks back
+up. This buys the BASELINE's "40% TTFT from KV offload to CPU RAM" on
+multi-turn traffic whose working set exceeds HBM, and G3 extends the
+reusable corpus past DRAM.
 
 TPU redesign: offload piggybacks on the engine's pipelined round loop —
 candidates are pages PARKED in the allocator's LRU (committed, refcount 0);
@@ -13,15 +15,23 @@ once per round the engine validates them (hash still owns the page),
 batch-gathers them in one fused jit, and fetches device->host
 asynchronously behind compute (same copy_to_host_async pipeline as token
 fetches). Nothing blocks the decode path. Onboard is the reverse: at
-admission, a contiguous run of G2 blocks extends the G1 prefix match via
-one scatter jit (async H2D upload; prefill follows in device order).
+admission, a contiguous run of G2/G3 blocks extends the G1 prefix match
+via one scatter jit (async H2D upload; prefill follows in device order).
 
-This module owns only the host pool + hash registry; the device side
+The G3 tier is an mmap-backed page pool: G2's LRU evictions spill DOWN
+into it (instead of being dropped), and prefix lookups fall through G2
+into G3 mid-run, so a run may be assembled from both tiers. Writes go
+through the OS page cache (no fsync on the hot path) — G3 is a cache, not
+durable state; its file is recreated at engine start.
+
+This module owns only the host pools + hash registries; the device side
 (gather/scatter, validation, scheduling) lives in engine.py.
 """
 from __future__ import annotations
 
 import logging
+import os
+import tempfile
 from collections import OrderedDict
 from typing import Optional
 
@@ -30,19 +40,20 @@ import numpy as np
 log = logging.getLogger(__name__)
 
 
-class HostOffloadTier:
-    """Fixed-capacity host pool of KV pages keyed by chained block hash.
+class _PageTier:
+    """Fixed-capacity pool of KV pages keyed by chained block hash.
 
-    Slots hold [2(k/v), L, kvh, ps, hd] per page. LRU eviction on
-    pressure. Single-owner (the engine loop) except for read-only counter
-    access."""
+    Slots hold [2(k/v), L, kvh, ps, hd] per page; the pool array adds the
+    page axis at 3. LRU eviction on pressure. Single-owner (the engine
+    loop) except for read-only counter access. Subclasses provide the
+    backing storage via ``_ensure_pool``."""
 
     def __init__(self, num_pages: int, page_shape: tuple, dtype):
-        # page_shape = (2, L, kvh, ps, hd); pool adds the page axis at 3
+        # page_shape = (2, L, kvh, ps, hd)
         self.num_pages = num_pages
         self.page_shape = tuple(page_shape)
         self.dtype = np.dtype(dtype)
-        self._pool: Optional[np.ndarray] = None  # lazy: it can be GBs
+        self._pool = None  # lazy: it can be GBs
         # hash -> (slot, parent_hash); insertion order = LRU order
         self._index: "OrderedDict[int, tuple[int, int]]" = OrderedDict()
         self._free: list[int] = list(range(num_pages))
@@ -51,14 +62,15 @@ class HostOffloadTier:
         self.onboard_hits = 0
         self.lookups = 0
 
+    @property
+    def pool_shape(self) -> tuple:
+        return (
+            self.page_shape[0], self.page_shape[1], self.page_shape[2],
+            self.num_pages, self.page_shape[3], self.page_shape[4],
+        )
+
     def _ensure_pool(self) -> np.ndarray:
-        if self._pool is None:
-            shape = (
-                self.page_shape[0], self.page_shape[1], self.page_shape[2],
-                self.num_pages, self.page_shape[3], self.page_shape[4],
-            )
-            self._pool = np.zeros(shape, self.dtype)
-        return self._pool
+        raise NotImplementedError
 
     def __contains__(self, block_hash: int) -> bool:
         return block_hash in self._index
@@ -66,26 +78,34 @@ class HostOffloadTier:
     def __len__(self) -> int:
         return len(self._index)
 
+    def _evict_one(self) -> None:
+        """Drop the LRU entry to free a slot (hook point for spill)."""
+        old_h, (old_slot, _) = self._index.popitem(last=False)
+        self._free.append(old_slot)
+
+    def put_one(self, h: int, parent: int, page: np.ndarray) -> bool:
+        """Store one page ([2, L, kvh, ps, hd]); False if already held."""
+        if h in self._index:
+            self._index.move_to_end(h)
+            return False
+        pool = self._ensure_pool()
+        if not self._free:
+            self._evict_one()
+        slot = self._free.pop()
+        pool[:, :, :, slot] = page
+        self._index[h] = (slot, parent)
+        self.pages_offloaded += 1
+        return True
+
     def put_batch(
         self, hashes: list[int], parents: list[int], data: np.ndarray
     ) -> int:
         """Store gathered pages (data [2, L, kvh, n, ps, hd], aligned with
         hashes). Existing entries are refreshed in LRU order. Returns the
         number of new pages stored."""
-        pool = self._ensure_pool()
         stored = 0
         for i, (h, parent) in enumerate(zip(hashes, parents)):
-            if h in self._index:
-                self._index.move_to_end(h)
-                continue
-            if not self._free:
-                old_h, (old_slot, _) = self._index.popitem(last=False)
-                self._free.append(old_slot)
-            slot = self._free.pop()
-            pool[:, :, :, slot] = data[:, :, :, i]
-            self._index[h] = (slot, parent)
-            stored += 1
-        self.pages_offloaded += stored
+            stored += bool(self.put_one(h, parent, data[:, :, :, i]))
         return stored
 
     def lookup_run(self, hashes: list[int]) -> list[tuple[int, int]]:
@@ -108,6 +128,11 @@ class HostOffloadTier:
         slots = [self._index[h][0] for h in hashes]
         return pool[:, :, :, slots]
 
+    def read_page(self, block_hash: int) -> np.ndarray:
+        """One page [2, L, kvh, ps, hd] (must be present)."""
+        pool = self._ensure_pool()
+        return pool[:, :, :, self._index[block_hash][0]]
+
     def drop(self, block_hash: int) -> None:
         ent = self._index.pop(block_hash, None)
         if ent is not None:
@@ -117,4 +142,104 @@ class HostOffloadTier:
         n = len(self._index)
         for h in list(self._index):
             self.drop(h)
+        return n
+
+
+class DiskOffloadTier(_PageTier):
+    """G3: mmap-backed page pool (reference storage/disk.rs:25,
+    block_manager.rs:69-82 CacheLevel::G3). The file is a plain dense
+    array; the OS page cache absorbs write bursts and serves hot reads,
+    so spill/onboard never issue synchronous IO on the engine loop."""
+
+    def __init__(self, num_pages: int, page_shape: tuple, dtype,
+                 path: Optional[str] = None):
+        super().__init__(num_pages, page_shape, dtype)
+        self.path = path
+        self._owns_file = path is None
+
+    def _ensure_pool(self) -> np.ndarray:
+        if self._pool is None:
+            if self.path is None:
+                fd, self.path = tempfile.mkstemp(
+                    prefix="dynamo-tpu-kv-g3-", suffix=".mmap"
+                )
+                os.close(fd)
+            self._pool = np.memmap(
+                self.path, dtype=self.dtype, mode="w+",
+                shape=self.pool_shape,
+            )
+            log.info(
+                "G3 disk tier: %d pages (%.1f MB) at %s", self.num_pages,
+                np.prod(self.pool_shape) * self.dtype.itemsize / 1e6,
+                self.path,
+            )
+        return self._pool
+
+    def close(self) -> None:
+        if self._pool is not None:
+            self._pool._mmap.close()
+            self._pool = None
+        if self._owns_file and self.path and os.path.exists(self.path):
+            os.unlink(self.path)
+            self.path = None
+
+
+class HostOffloadTier(_PageTier):
+    """G2: host-DRAM pool. With a ``spill`` tier attached, LRU evictions
+    cascade DOWN into it (G2 -> G3) instead of being dropped, and
+    ``lookup_run``/``gather`` fall through to it mid-run, so one onboard
+    can be assembled from both tiers (reference offload.rs tier walk)."""
+
+    def __init__(self, num_pages: int, page_shape: tuple, dtype,
+                 spill: Optional[_PageTier] = None):
+        super().__init__(num_pages, page_shape, dtype)
+        self.spill = spill
+
+    def _ensure_pool(self) -> np.ndarray:
+        if self._pool is None:
+            self._pool = np.zeros(self.pool_shape, self.dtype)
+        return self._pool
+
+    def _evict_one(self) -> None:
+        old_h, (old_slot, old_parent) = self._index.popitem(last=False)
+        if self.spill is not None:
+            self.spill.put_one(
+                old_h, old_parent, self._ensure_pool()[:, :, :, old_slot]
+            )
+        self._free.append(old_slot)
+
+    def lookup_run(self, hashes: list[int]) -> list[tuple[int, int]]:
+        self.lookups += len(hashes)
+        run: list[tuple[int, int]] = []
+        for h in hashes:
+            ent = self._index.get(h)
+            if ent is not None:
+                self._index.move_to_end(h)
+                run.append((h, ent[1]))
+                continue
+            if self.spill is not None:
+                sub = self.spill.lookup_run([h])
+                if sub:
+                    run.append(sub[0])
+                    continue
+            break
+        self.onboard_hits += len(run)
+        return run
+
+    def gather(self, hashes: list[int]) -> np.ndarray:
+        out = np.empty(
+            self.page_shape[:3] + (len(hashes),) + self.page_shape[3:],
+            self.dtype,
+        )
+        for i, h in enumerate(hashes):
+            if h in self._index:
+                out[:, :, :, i] = self.read_page(h)
+            else:
+                out[:, :, :, i] = self.spill.read_page(h)
+        return out
+
+    def clear(self) -> int:
+        n = super().clear()
+        if self.spill is not None:
+            n += self.spill.clear()
         return n

@@ -16,6 +16,7 @@ drop-to-cancel contract — reference AsyncEngineContext::stop_generating).
 from __future__ import annotations
 
 import asyncio
+import json
 import logging
 import time
 from typing import Any, AsyncIterator, Optional
@@ -83,6 +84,18 @@ def _error(status: int, message: str, err_type: str = "invalid_request_error") -
     )
 
 
+class _ApiError(Exception):
+    """Endpoint-local error mapped to an OpenAI error response by
+    _run_endpoint (the shared request envelope)."""
+
+    def __init__(self, status: int, message: str,
+                 etype: str = "invalid_request_error"):
+        super().__init__(message)
+        self.status = status
+        self.message = message
+        self.etype = etype
+
+
 class HttpService:
     """The OpenAI-compatible frontend over a ModelManager."""
 
@@ -106,6 +119,7 @@ class HttpService:
             [
                 web.post("/v1/chat/completions", self.handle_chat),
                 web.post("/v1/completions", self.handle_completion),
+                web.post("/v1/responses", self.handle_responses),
                 web.post("/v1/embeddings", self.handle_embeddings),
                 web.get("/v1/models", self.handle_models),
                 web.get("/health", self.handle_health),
@@ -153,14 +167,14 @@ class HttpService:
         )
 
     async def handle_clear_kv(self, request: web.Request) -> web.Response:
+        from dynamo_tpu.runtime.remote_engine import invoke_clear
+
         cleared = []
         for name in self.manager.list_models():
             engine = self.manager.get(name).engine
             reset = getattr(engine, "clear_kv_blocks", None)
             if reset is not None:
-                res = reset()
-                if asyncio.iscoroutine(res):
-                    await res
+                await invoke_clear(reset)
                 cleared.append(name)
         return web.json_response({"cleared": cleared})
 
@@ -227,6 +241,189 @@ class HttpService:
             encoding_format=req.encoding_format,
         ))
 
+    async def handle_responses(self, request: web.Request) -> web.StreamResponse:
+        """OpenAI Responses API (reference protocols/openai/responses.rs):
+        lowered onto the chat pipeline via ResponsesRequest.to_chat().
+        Stateless — `store`/`previous_response_id` chaining is rejected at
+        validation."""
+        from dynamo_tpu.protocols.openai import (
+            ResponsesRequest,
+            responses_response,
+        )
+
+        async def run(body: dict, env: dict) -> web.StreamResponse:
+            try:
+                rreq = ResponsesRequest(**body)
+                chat_req = rreq.to_chat()
+            except ValidationError as e:
+                raise _ApiError(400, e.errors()[0].get("msg", "invalid request"))
+            except ValueError as e:
+                raise _ApiError(400, str(e))
+            env["model"] = rreq.model
+            chain = self._resolve_model(rreq.model, chat=True)
+            try:
+                pre = chain.preprocess(chat_req)
+            except ValueError as e:
+                raise _ApiError(400, str(e))
+
+            rid = make_id("resp")
+            self.metrics.inflight.labels(rreq.model).inc()
+            try:
+                if rreq.stream:
+                    return await self._stream_responses_api(
+                        request, rreq, chain, pre, rid)
+                text = ""
+                n_tok = 0
+                finish: Optional[FinishReason] = None
+                stream = chain.generate(pre)
+                try:
+                    async for out in stream:
+                        if out.text:
+                            text += out.text
+                        n_tok += len(out.token_ids)
+                        if out.finish_reason is not None:
+                            finish = out.finish_reason
+                finally:
+                    close = getattr(stream, "aclose", None)
+                    if close is not None:
+                        try:
+                            await close()
+                        except Exception:  # noqa: BLE001
+                            pass
+                incomplete = (finish == FinishReason.LENGTH)
+                return web.json_response(responses_response(
+                    rid=rid, model=rreq.model, text=text,
+                    prompt_tokens=len(pre.token_ids),
+                    completion_tokens=n_tok,
+                    status="incomplete" if incomplete else "completed",
+                    incomplete_reason=(
+                        "max_output_tokens" if incomplete else None),
+                ))
+            finally:
+                self.metrics.inflight.labels(rreq.model).dec()
+
+        return await self._run_endpoint(request, "responses", run)
+
+    def _resolve_model(self, name: str, *, chat: bool = False,
+                       completion: bool = False):
+        try:
+            return self.manager.get(name, chat=chat, completion=completion)
+        except ModelNotFound:
+            raise _ApiError(404, f"model '{name}' not found",
+                            "not_found_error")
+
+    async def _run_endpoint(self, request: web.Request, endpoint: str, fn):
+        """Shared request envelope: JSON-parse, _ApiError mapping, metrics
+        accounting (requests_total/duration), 499 on cancellation.
+        `fn(body, env)` does the endpoint-specific work and sets
+        env["model"] as soon as it is known."""
+        env = {"model": ""}
+        status = "500"
+        t0 = time.monotonic()
+        try:
+            try:
+                body = await request.json()
+            except Exception:
+                status = "400"
+                return _error(400, "invalid JSON body")
+            try:
+                resp = await fn(body, env)
+            except _ApiError as e:
+                status = str(e.status)
+                return _error(e.status, e.message, e.etype)
+            status = str(resp.status)
+            return resp
+        except asyncio.CancelledError:
+            status = "499"
+            raise
+        except Exception:
+            log.exception("%s handler failed", endpoint)
+            return _error(500, "internal error", "internal_server_error")
+        finally:
+            self.metrics.requests_total.labels(
+                env["model"], endpoint, status).inc()
+            self.metrics.duration.labels(env["model"]).observe(
+                time.monotonic() - t0)
+
+    async def _stream_responses_api(
+        self, request: web.Request, rreq, chain, pre, rid: str
+    ) -> web.StreamResponse:
+        """Responses-API SSE: typed events (response.created →
+        response.output_text.delta* → response.completed)."""
+        from dynamo_tpu.protocols.openai import responses_response
+
+        resp = web.StreamResponse(
+            status=200,
+            headers={
+                "Content-Type": "text/event-stream",
+                "Cache-Control": "no-cache",
+                "Connection": "keep-alive",
+            },
+        )
+        await resp.prepare(request)
+
+        async def event(etype: str, data: dict) -> None:
+            payload = json.dumps({"type": etype, **data})
+            await resp.write(
+                f"event: {etype}\ndata: {payload}\n\n".encode())
+
+        snapshot = responses_response(
+            rid=rid, model=rreq.model, text="",
+            prompt_tokens=len(pre.token_ids), completion_tokens=0,
+            status="in_progress",
+        )
+        await event("response.created", {"response": snapshot})
+        text = ""
+        n_tok = 0
+        finish: Optional[FinishReason] = None
+        stream = chain.generate(pre)
+        try:
+            try:
+                async for out in stream:
+                    if out.text:
+                        text += out.text
+                        await event("response.output_text.delta",
+                                    {"delta": out.text, "output_index": 0,
+                                     "content_index": 0})
+                    n_tok += len(out.token_ids)
+                    if out.finish_reason is not None:
+                        finish = out.finish_reason
+            except Exception as e:  # noqa: BLE001 — surface in-band: the
+                # stream is already prepared, a 500 can't be returned
+                log.warning("responses stream failed: %s", e)
+                await event("response.failed", {"response": {
+                    "id": rid, "object": "response", "status": "failed",
+                    "error": {"message": str(e)},
+                }})
+                await resp.write_eof()
+                return resp
+            await event("response.output_text.done",
+                        {"text": text, "output_index": 0, "content_index": 0})
+            incomplete = (finish == FinishReason.LENGTH)
+            final = responses_response(
+                rid=rid, model=rreq.model, text=text,
+                prompt_tokens=len(pre.token_ids), completion_tokens=n_tok,
+                status="incomplete" if incomplete else "completed",
+                incomplete_reason="max_output_tokens" if incomplete else None,
+            )
+            await event(
+                "response.incomplete" if incomplete else "response.completed",
+                {"response": final})
+        except ConnectionResetError:
+            # routine client disconnect: not an error; the prepared
+            # StreamResponse is all we can return
+            log.info("client disconnected mid-stream")
+            return resp
+        finally:
+            close = getattr(stream, "aclose", None)
+            if close is not None:
+                try:
+                    await close()
+                except Exception:  # noqa: BLE001
+                    pass
+        await resp.write_eof()
+        return resp
+
     async def handle_chat(self, request: web.Request) -> web.StreamResponse:
         return await self._handle_openai(request, chat=True)
 
@@ -240,51 +437,30 @@ class HttpService:
         self, request: web.Request, *, chat: bool
     ) -> web.StreamResponse:
         endpoint = "chat_completions" if chat else "completions"
-        model = ""
-        status = "500"
-        t0 = time.monotonic()
-        try:
-            try:
-                body = await request.json()
-            except Exception:
-                status = "400"
-                return _error(400, "invalid JSON body")
+
+        async def run(body: dict, env: dict) -> web.StreamResponse:
             try:
                 req = (ChatCompletionRequest if chat else CompletionRequest)(**body)
             except ValidationError as e:
-                status = "400"
-                return _error(400, e.errors()[0].get("msg", "invalid request"))
-            model = req.model
-            try:
-                chain = self.manager.get(req.model, chat=chat, completion=not chat)
-            except ModelNotFound:
-                status = "404"
-                return _error(404, f"model '{req.model}' not found", "not_found_error")
+                raise _ApiError(400, e.errors()[0].get("msg", "invalid request"))
+            env["model"] = req.model
+            chain = self._resolve_model(req.model, chat=chat,
+                                        completion=not chat)
             try:
                 pre = chain.preprocess(req)
             except ValueError as e:
-                status = "400"
-                return _error(400, str(e))
+                raise _ApiError(400, str(e))
 
-            self.metrics.inflight.labels(model).inc()
+            self.metrics.inflight.labels(req.model).inc()
             try:
                 if req.stream:
-                    resp = await self._stream_response(request, req, chain, pre, chat)
-                else:
-                    resp = await self._unary_response(req, chain, pre, chat)
-                status = str(resp.status)
-                return resp
+                    return await self._stream_response(
+                        request, req, chain, pre, chat)
+                return await self._unary_response(req, chain, pre, chat)
             finally:
-                self.metrics.inflight.labels(model).dec()
-        except asyncio.CancelledError:
-            status = "499"
-            raise
-        except Exception:
-            log.exception("%s handler failed", endpoint)
-            return _error(500, "internal error", "internal_server_error")
-        finally:
-            self.metrics.requests_total.labels(model, endpoint, status).inc()
-            self.metrics.duration.labels(model).observe(time.monotonic() - t0)
+                self.metrics.inflight.labels(req.model).dec()
+
+        return await self._run_endpoint(request, endpoint, run)
 
     def _fanout(self, req, chain, pre) -> list[AsyncIterator[LLMEngineOutput]]:
         """n>1: run n independent engine streams (distinct seeds per choice,

@@ -61,6 +61,9 @@ class KvStats:
     host_blocks: int = 0
     host_total_blocks: int = 0
     host_onboard_hits: int = 0
+    # mmap-backed disk tier (KVBM G3); zero when the tier is disabled
+    disk_blocks: int = 0
+    disk_total_blocks: int = 0
 
 
 @dataclass

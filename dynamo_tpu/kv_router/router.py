@@ -97,6 +97,23 @@ class KvPushRouter:
         self.router.update_workers(list(self.workers))
         self.router.indexer.remove_worker(worker_id)
 
+    async def clear_kv_blocks(self) -> int:
+        """Fan /clear_kv_blocks out to every routed worker and drop their
+        indexer state (the radix view is now stale by construction)."""
+        from dynamo_tpu.runtime.remote_engine import invoke_clear
+
+        total = 0
+        for wid, engine in list(self.workers.items()):
+            clear = getattr(engine, "clear_kv_blocks", None)
+            if clear is None:
+                continue
+            try:
+                total += await invoke_clear(clear)
+            except Exception:  # noqa: BLE001 — best-effort per worker
+                continue
+            self.router.indexer.remove_worker(wid)
+        return total
+
     async def generate(
         self, request: PreprocessedRequest
     ) -> AsyncIterator[LLMEngineOutput]:

@@ -63,6 +63,13 @@ def build_parser() -> argparse.ArgumentParser:
                    default=cfg.host_offload_pages,
                    help="host-DRAM KV offload tier capacity in pages "
                         "(KVBM G2); 0 disables")
+    p.add_argument("--disk-offload-pages", type=int,
+                   default=cfg.disk_offload_pages,
+                   help="mmap-backed disk KV tier capacity in pages "
+                        "(KVBM G3, spill target of G2); 0 disables")
+    p.add_argument("--disk-offload-path", default=cfg.disk_offload_path,
+                   help="backing file for the G3 pool "
+                        "(default: fresh tempfile)")
     # distributed mode (reference: etcd/NATS endpoints; here the dcp store).
     # --control-plane default stays None (it's the discovery-mode switch);
     # RuntimeConfig.control_plane is None unless the config file or
@@ -238,6 +245,8 @@ def build_chain(args) -> "Any":
             max_decode_slots=args.max_decode_slots,
             cache_dtype=args.cache_dtype,
             host_offload_pages=args.host_offload_pages,
+            disk_offload_pages=args.disk_offload_pages,
+            disk_offload_path=args.disk_offload_path,
         )
         params = None
         if args.model_path:

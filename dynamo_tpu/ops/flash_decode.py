@@ -1,0 +1,200 @@
+"""Pallas TPU kernel: flash decode attention over CONTIGUOUS per-slot KV.
+
+Round-4 redesign of the decode hot path. The round-3 kernel walked the
+paged pool with grid (slot, kv-head, page): 36k kernel invocations per
+step at ~0.4 µs each — 15.9 ms/step of pure grid overhead (tools/
+profile_decode.py). The fix is layout, not tuning: decode context lives in
+a contiguous per-slot region ``ctx_kv [L, kvh, B, S, hd]`` (the paged pool
+remains as prefix-cache *storage*; the engine copies pages in at admission
+and out at block-seal), so attention streams big linear blocks:
+
+  grid = (kvh, S/CHUNK) — 8 invocations per layer at S=CHUNK=512. Each
+  block is ``ctx_kv[l, h, :, chunk, :]`` — for CHUNK == S a fully
+  CONTIGUOUS 2 MB slab covering every slot — streamed through VMEM with
+  online softmax per (slot, q-head) in scratch. Chunks beyond every slot's
+  context repeat the previous block index, so their DMA is elided.
+
+Position semantics: ctx_kv[l, :, b, p] holds position p of slot b, valid
+while p < ctx_lens[b]. The CURRENT token's KV must be written (scattered)
+before the call — the kernel masks with ``pos < ctx``, covering it.
+
+This replaces what vLLM's paged-attention CUDA kernel does for the
+reference (SURVEY.md §7 "Paged attention on TPU" hard part); paging moved
+out of the per-step critical path entirely.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+DEFAULT_CHUNK = 512
+
+
+def _kernel(
+    # scalar prefetch
+    layer_ref,   # [1] i32
+    nlive_ref,   # [1] i32 — number of chunks covering max(ctx)
+    # blocks
+    q_ref,       # [1, B, G, HD]       (kv head squeezed via index map)
+    k_ref,       # [1, 1, B, CHUNK, HD]
+    v_ref,
+    ctx_ref,     # [B, 1] i32 (VMEM copy of ctx for vectorized masking)
+    o_ref,       # [1, B, G, HD]
+    # scratch
+    m_ref,       # [B, G, 128] f32 running max
+    l_ref,       # [B, G, 128] f32 running denom
+    acc_ref,     # [B, G, HD] f32 running numerator
+    *,
+    scale: float,
+    chunk: int,
+):
+    i = pl.program_id(1)
+    n_chunks = pl.num_programs(1)
+
+    @pl.when(i == 0)
+    def _():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    @pl.when(i < nlive_ref[0])
+    def _():
+        pos = i * chunk + jax.lax.broadcasted_iota(
+            jnp.int32, (1, 1, chunk), 2)                   # [1, 1, chunk]
+        valid = pos < ctx_ref[:][:, :, None]               # [B, 1, chunk]
+        q = q_ref[0]                                       # [B, G, HD]
+        k = k_ref[0, 0]                                    # [B, chunk, HD]
+        v = v_ref[0, 0]
+        # batched over slots: one dot_general, no per-slot unroll
+        s = jax.lax.dot_general(
+            q, k, (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        ) * scale                                          # [B, G, chunk]
+        s = jnp.where(valid, s, NEG_INF)
+        m_prev = m_ref[:, :, :1]                           # [B, G, 1]
+        row_max = jnp.max(s, axis=2, keepdims=True)
+        m_new = jnp.maximum(m_prev, row_max)
+        p = jnp.exp(s - m_new)                             # [B, G, chunk]
+        alpha = jnp.exp(m_prev - m_new)                    # [B, G, 1]
+        l_new = l_ref[:, :, :1] * alpha + jnp.sum(p, axis=2, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        )                                                  # [B, G, HD]
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(i == n_chunks - 1)
+    def _():
+        denom = jnp.maximum(l_ref[:, :, :1], 1e-30)        # [B, G, 1]
+        o_ref[0] = (acc_ref[:] / denom).astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("chunk", "interpret")
+)
+def flash_decode_attention(
+    q: jnp.ndarray,         # [B, n_heads, HD]
+    ctx_k: jnp.ndarray,     # [L, kvh, B, S, HD] contiguous per-slot KV
+    ctx_v: jnp.ndarray,
+    layer: jnp.ndarray,     # scalar i32
+    ctx_lens: jnp.ndarray,  # [B] i32 — context length INCL. current token
+    chunk: int = DEFAULT_CHUNK,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Flash decode attention over contiguous KV. Returns [B, n_heads, HD].
+
+    The current token's KV must already be at position ctx-1 (the engine
+    scatters it before attending)."""
+    B, n_heads, hd = q.shape
+    L, nkv, _, S, _ = ctx_k.shape
+    g = n_heads // nkv
+    chunk = min(chunk, S)
+    assert S % chunk == 0, (S, chunk)
+    scale = float(1.0 / (hd ** 0.5))
+    # head-major q: [nkv, B, g, hd] so one grid step holds one kv head
+    qg = q.reshape(B, nkv, g, hd).transpose(1, 0, 2, 3)
+    n_chunks = S // chunk
+    ctx_i32 = ctx_lens.astype(jnp.int32)
+    n_live = jnp.maximum(
+        (jnp.max(ctx_i32) + chunk - 1) // chunk, 1
+    ).reshape(1)
+
+    def q_map(h, i, layer, nlive):
+        return (h, 0, 0, 0)
+
+    def kv_map(h, i, layer, nlive):
+        # chunks beyond every slot's context repeat the previous index so
+        # the pipeline skips the (unused) DMA
+        return (layer[0], h, 0, jnp.minimum(i, nlive[0] - 1), 0)
+
+    def ctx_map(h, i, layer, nlive):
+        return (0, 0)
+
+    def o_map(h, i, layer, nlive):
+        return (h, 0, 0, 0)
+
+    out = pl.pallas_call(
+        functools.partial(_kernel, scale=scale, chunk=chunk),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(nkv, n_chunks),
+            in_specs=[
+                pl.BlockSpec((1, B, g, hd), q_map),
+                pl.BlockSpec((1, 1, B, chunk, hd), kv_map),
+                pl.BlockSpec((1, 1, B, chunk, hd), kv_map),
+                pl.BlockSpec((B, 1), ctx_map),
+            ],
+            out_specs=pl.BlockSpec((1, B, g, hd), o_map),
+            scratch_shapes=[
+                pltpu.VMEM((B, g, 128), jnp.float32),
+                pltpu.VMEM((B, g, 128), jnp.float32),
+                pltpu.VMEM((B, g, hd), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((nkv, B, g, hd), q.dtype),
+        # the all-slot block pair (k+v, double-buffered) slightly exceeds
+        # the default 16M scoped-vmem budget; v5e has far more VMEM
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=64 * 1024 * 1024,
+        ),
+        interpret=interpret,
+    )(
+        jnp.asarray(layer, jnp.int32).reshape(1),
+        n_live,
+        qg, ctx_k, ctx_v, ctx_i32[:, None],
+    )
+    # [nkv, B, g, hd] -> [B, nkv*g, hd]
+    return out.transpose(1, 0, 2, 3).reshape(B, n_heads, hd)
+
+
+def flash_decode_attention_reference(
+    q: jnp.ndarray,
+    ctx_k: jnp.ndarray,
+    ctx_v: jnp.ndarray,
+    layer: jnp.ndarray,
+    ctx_lens: jnp.ndarray,
+) -> jnp.ndarray:
+    """Pure-jnp equivalent (CPU tests / kernel parity checks)."""
+    B, n_heads, hd = q.shape
+    L, nkv, _, S, _ = ctx_k.shape
+    n_rep = n_heads // nkv
+    k = jnp.repeat(ctx_k[layer], n_rep, axis=0)  # [nh, B, S, hd]
+    v = jnp.repeat(ctx_v[layer], n_rep, axis=0)
+    scores = jnp.einsum(
+        "bnh,nbsh->bns", q, k, preferred_element_type=jnp.float32
+    ) / (hd ** 0.5)
+    mask = jnp.arange(S)[None, :] < ctx_lens[:, None]
+    scores = jnp.where(mask[:, None, :], scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum(
+        "bns,nbsh->bnh", probs.astype(v.dtype), v,
+        preferred_element_type=jnp.float32,
+    )
+    return out.astype(q.dtype)

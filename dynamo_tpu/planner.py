@@ -43,6 +43,11 @@ class PlannerConfig:
     max_replicas: int = 8
     stable_intervals: int = 2    # consecutive low loads before downscale
     metrics_stale_after_s: float = 15.0
+    # load predictor filtering the observed series before decide() — one of
+    # predictors.make_predictor: "constant" (reactive, reference default),
+    # "moving_average", "ar"/"arima" (trend-following forecast;
+    # reference load_predictor.py:159)
+    predictor: str = "constant"
 
 
 class Connector(Protocol):
@@ -112,6 +117,12 @@ class Planner:
         self._low_streak = 0
         self._task: Optional[asyncio.Task] = None
         self._sub_task: Optional[asyncio.Task] = None
+        from dynamo_tpu.predictors import make_predictor
+
+        # one predictor per observed series (independent windows)
+        self._pred_usage = make_predictor(self.config.predictor)
+        self._pred_waiting = make_predictor(self.config.predictor)
+        self._pred_streams = make_predictor(self.config.predictor)
 
     async def start(self) -> "Planner":
         sub = await self.kv.subscribe(f"{METRICS_TOPIC}.>")
@@ -159,6 +170,8 @@ class Planner:
                 + m.worker_stats.num_requests_waiting
                 for m in snap.metrics.values()
             )
+            self._pred_streams.add_data_point(streams)
+            streams = self._pred_streams.predict_next()
             target = min(c.max_replicas,
                          self.sla.replicas_for(streams, c.min_replicas))
             if target >= current:
@@ -169,16 +182,18 @@ class Planner:
                 self._low_streak = 0
                 return current - 1
             return current
-        usage = snap.load_avg()
-        waiting = sum(
+        self._pred_usage.add_data_point(snap.load_avg())
+        self._pred_waiting.add_data_point(sum(
             m.worker_stats.num_requests_waiting
             for m in snap.metrics.values()
-        )
+        ))
+        usage = self._pred_usage.predict_next()
+        waiting = self._pred_waiting.predict_next()
         target = current
         if usage > c.kv_usage_scale_up or waiting > c.waiting_scale_up:
             target = current + 1
             self._low_streak = 0
-        elif usage < c.kv_usage_scale_down and waiting == 0:
+        elif usage < c.kv_usage_scale_down and waiting < 0.5:
             self._low_streak += 1
             if self._low_streak >= c.stable_intervals:
                 target = current - 1
@@ -213,6 +228,7 @@ async def run_planner(args) -> None:
         adjustment_interval_s=args.adjustment_interval,
         min_replicas=args.min_replicas,
         max_replicas=args.max_replicas,
+        predictor=getattr(args, "predictor", "constant"),
     )
     await connector.set_replicas(cfg.min_replicas)
     planner = await Planner(kv, connector, cfg, sla=sla).start()

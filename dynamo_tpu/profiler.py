@@ -159,25 +159,101 @@ class SlaCapacity:
     config_name: Optional[str] = None
     percentile: str = "p50"  # p50 | p99
 
-    def max_concurrency(self) -> int:
-        """Highest profiled concurrency whose latencies meet the SLA
-        (0 if even concurrency 1 violates it)."""
+    def _config_points(self) -> list[list[dict[str, Any]]]:
+        """Per-config point lists (each sorted by concurrency). Each config
+        is its own latency curve — merging them would let one bad config
+        poison another's capacity."""
         cfgs = self.profile.get("configs", [])
         if self.config_name is not None:
             cfgs = [c for c in cfgs if c.get("name") == self.config_name]
+        return [
+            sorted(c.get("points", []), key=lambda p: p["concurrency"])
+            for c in cfgs if c.get("points")
+        ]
+
+    def interpolate(
+        self, concurrency: float, pts: Optional[list[dict[str, Any]]] = None
+    ) -> tuple[Optional[float], Optional[float]]:
+        """(ttft, itl) at a concurrency level, piecewise-linear between
+        profiled points (reference utils/perf_interpolation.py: the SLA
+        planner reads the profiled latency SURFACE, not just the grid).
+        Clamps outside the profiled range to the nearest endpoint. With
+        several configs selected, reads the FIRST config's curve unless
+        `pts` picks one."""
+        if pts is None:
+            groups = self._config_points()
+            pts = groups[0] if groups else []
+        if not pts:
+            return None, None
+
+        def interp(key: str) -> Optional[float]:
+            xs = [p["concurrency"] for p in pts if p.get(key) is not None]
+            ys = [p[key] for p in pts if p.get(key) is not None]
+            if not xs:
+                return None
+            if concurrency <= xs[0]:
+                return ys[0]
+            if concurrency >= xs[-1]:
+                return ys[-1]
+            for (x0, y0), (x1, y1) in zip(zip(xs, ys), zip(xs[1:], ys[1:])):
+                if x0 <= concurrency <= x1:
+                    if x1 == x0:
+                        return max(y0, y1)
+                    t = (concurrency - x0) / (x1 - x0)
+                    return y0 + t * (y1 - y0)
+            return ys[-1]
+
+        return (interp(f"ttft_{self.percentile}_s"),
+                interp(f"itl_{self.percentile}_s"))
+
+    def _point_ok(self, pt: dict[str, Any]) -> bool:
+        ttft = pt.get(f"ttft_{self.percentile}_s")
+        itl = pt.get(f"itl_{self.percentile}_s")
+        good = True
+        if self.ttft_sla_s is not None:
+            # a point MISSING the measurement cannot prove the SLA
+            good = good and ttft is not None and ttft <= self.ttft_sla_s
+        if self.itl_sla_s is not None:
+            good = good and itl is not None and itl <= self.itl_sla_s
+        return good
+
+    def max_concurrency(self) -> int:
+        """Highest concurrency meeting the SLA (0 if no profiled point
+        does). Base semantics: the highest PASSING PROFILED point of any
+        selected config (noise at low load never zeroes out capacity a
+        higher point proved). Interpolation then refines INTO the segment
+        between that point and the next profiled point, finding the SLA
+        crossing on the piecewise-linear curve (reference
+        utils/perf_interpolation.py reads the surface, not just the grid)."""
         best = 0
-        for cfg in cfgs:
-            for pt in cfg.get("points", []):
-                ttft = pt.get(f"ttft_{self.percentile}_s")
-                itl = pt.get(f"itl_{self.percentile}_s")
-                ok = True
-                if self.ttft_sla_s is not None:
-                    # a point MISSING the measurement cannot prove the SLA
-                    ok = ok and ttft is not None and ttft <= self.ttft_sla_s
-                if self.itl_sla_s is not None:
-                    ok = ok and itl is not None and itl <= self.itl_sla_s
-                if ok:
-                    best = max(best, int(pt["concurrency"]))
+        for pts in self._config_points():
+            passing = [i for i, p in enumerate(pts) if self._point_ok(p)]
+            if not passing:
+                continue
+            i = passing[-1]
+            cap = float(pts[i]["concurrency"])
+            if i + 1 < len(pts):
+                # refine toward the next (failing) profiled point
+                def ok(c: float, pts=pts) -> bool:
+                    ttft, itl = self.interpolate(c, pts)
+                    good = True
+                    if self.ttft_sla_s is not None:
+                        good = (good and ttft is not None
+                                and ttft <= self.ttft_sla_s)
+                    if self.itl_sla_s is not None:
+                        good = (good and itl is not None
+                                and itl <= self.itl_sla_s)
+                    return good
+
+                flo, fhi = cap, float(pts[i + 1]["concurrency"])
+                for _ in range(40):
+                    mid = (flo + fhi) / 2
+                    if ok(mid):
+                        flo = mid
+                    else:
+                        fhi = mid
+                cap = flo
+            best = max(best, int(cap))
         return best
 
     def replicas_for(self, concurrent_streams: int,

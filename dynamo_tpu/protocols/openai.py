@@ -125,6 +125,80 @@ class EmbeddingRequest(BaseModel):
     user: Optional[str] = None
 
 
+class ResponsesRequest(BaseModel):
+    """OpenAI Responses API request (reference
+    lib/llm/src/protocols/openai/responses.rs). Served by converting to
+    the chat pipeline: `input` + `instructions` become chat messages."""
+
+    model: str
+    input: Union[str, list[dict[str, Any]]]
+    instructions: Optional[str] = None
+    max_output_tokens: Optional[int] = Field(default=None, ge=1)
+    temperature: Optional[float] = Field(default=None, ge=0.0, le=2.0)
+    top_p: Optional[float] = Field(default=None, gt=0.0, le=1.0)
+    stream: bool = False
+    store: bool = False  # accepted; there is no response store (stateless)
+    previous_response_id: Optional[str] = None
+    metadata: Optional[dict[str, Any]] = None
+    user: Optional[str] = None
+
+    @field_validator("input")
+    @classmethod
+    def _input_nonempty(cls, v):
+        if isinstance(v, (str, list)) and not v:
+            raise ValueError("input must be non-empty")
+        return v
+
+    @field_validator("previous_response_id")
+    @classmethod
+    def _no_chaining(cls, v):
+        if v is not None:
+            raise ValueError(
+                "previous_response_id is not supported (stateless server); "
+                "resend the full conversation in `input`"
+            )
+        return v
+
+    def to_chat(self) -> "ChatCompletionRequest":
+        """Lower onto the chat-completions pipeline."""
+        messages: list[ChatMessage] = []
+        if self.instructions:
+            messages.append(ChatMessage(role="system", content=self.instructions))
+        if isinstance(self.input, str):
+            messages.append(ChatMessage(role="user", content=self.input))
+        else:
+            for item in self.input:
+                if item.get("type") not in (None, "message"):
+                    raise ValueError(
+                        f"unsupported input item type {item.get('type')!r}"
+                    )
+                content = item.get("content")
+                if isinstance(content, list):
+                    # responses content parts: input_text/output_text only
+                    texts = []
+                    for p in content:
+                        ptype = p.get("type") if isinstance(p, dict) else None
+                        if ptype in ("input_text", "output_text", "text"):
+                            texts.append(p.get("text", ""))
+                        else:
+                            raise ValueError(
+                                f"unsupported content part type {ptype!r}"
+                            )
+                    content = "".join(texts)
+                messages.append(ChatMessage(
+                    role=item.get("role", "user"), content=content
+                ))
+        return ChatCompletionRequest(
+            model=self.model,
+            messages=messages,
+            max_tokens=self.max_output_tokens,
+            temperature=self.temperature,
+            top_p=self.top_p,
+            stream=self.stream,
+            user=self.user,
+        )
+
+
 # ---------------------------------------------------------------------------
 # Response builders (dicts — serialized straight to JSON)
 # ---------------------------------------------------------------------------
@@ -140,6 +214,46 @@ def _usage(prompt_tokens: int, completion_tokens: int) -> dict[str, int]:
 
 def make_id(prefix: str = "chatcmpl") -> str:
     return f"{prefix}-{uuid.uuid4().hex}"
+
+
+def responses_response(
+    *,
+    rid: str,
+    model: str,
+    text: str,
+    prompt_tokens: int,
+    completion_tokens: int,
+    status: str = "completed",
+    incomplete_reason: Optional[str] = None,
+    created: Optional[int] = None,
+) -> dict[str, Any]:
+    """OpenAI Responses API response object (responses.rs parity)."""
+    # in-progress snapshots (response.created) carry no output yet; a
+    # truncated response's message is itself marked incomplete
+    output = [] if status == "in_progress" else [{
+        "type": "message",
+        "id": make_id("msg"),
+        "status": "incomplete" if status == "incomplete" else "completed",
+        "role": "assistant",
+        "content": [{"type": "output_text", "text": text,
+                     "annotations": []}],
+    }]
+    return {
+        "id": rid,
+        "object": "response",
+        "created_at": created or int(time.time()),
+        "status": status,
+        "model": model,
+        "output": output,
+        "usage": {
+            "input_tokens": prompt_tokens,
+            "output_tokens": completion_tokens,
+            "total_tokens": prompt_tokens + completion_tokens,
+        },
+        "incomplete_details": (
+            {"reason": incomplete_reason} if incomplete_reason else None
+        ),
+    }
 
 
 def chat_completion_response(

@@ -15,10 +15,32 @@ from dynamo_tpu.runtime.component import Endpoint, EndpointClient, ServedEndpoin
 from dynamo_tpu.protocols.common import LLMEngineOutput, PreprocessedRequest
 
 
+async def invoke_clear(clear) -> int:
+    """Run an engine's clear_kv_blocks without blocking the event loop:
+    async engines are awaited; a sync TpuEngine clear (which blocks until
+    a round boundary) runs in a worker thread."""
+    import asyncio
+    import inspect
+
+    if inspect.iscoroutinefunction(clear):
+        return int(await clear() or 0)
+    return int(await asyncio.to_thread(clear) or 0)
+
+
 def engine_handler(engine: Any):
-    """Wrap an AsyncEngine into an endpoint handler (worker side)."""
+    """Wrap an AsyncEngine into an endpoint handler (worker side).
+
+    Beyond generate, the handler services control verbs sent as
+    ``{"__op__": ...}`` payloads — currently ``clear_kv``, the worker side
+    of the frontend's /clear_kv_blocks fan-out (reference
+    http/service/clear_kv_blocks.rs posts to every instance)."""
 
     async def handler(payload: dict[str, Any]) -> AsyncIterator[dict[str, Any]]:
+        if payload.get("__op__") == "clear_kv":
+            clear = getattr(engine, "clear_kv_blocks", None)
+            n = await invoke_clear(clear) if clear is not None else 0
+            yield {"cleared": n}
+            return
         req = PreprocessedRequest.from_dict(payload)
         async for out in engine.generate(req):
             yield out.to_dict()
@@ -65,6 +87,25 @@ class RemoteEngine:
         ):
             yield LLMEngineOutput.from_dict(item)
 
+    async def clear_kv_blocks(self) -> int:
+        """Fan the clear_kv control verb out to EVERY live instance;
+        returns total blocks cleared (reference clear_kv_blocks.rs
+        broadcasts to all workers). A worker failing mid-clear is skipped —
+        its lease expiry will drop it from the fleet anyway."""
+        total = 0
+        flt = self.client.instance_filter
+        for iid, inst in list(self.client.instances.items()):
+            if flt is not None and not flt(inst):
+                continue
+            try:
+                async for item in self.client.generate(
+                    {"__op__": "clear_kv"}, mode="direct", instance_id=iid,
+                ):
+                    total += int(item.get("cleared", 0))
+            except Exception:  # noqa: BLE001 — best-effort per worker
+                continue
+        return total
+
 
 class RemoteWorkerEngine:
     """Per-worker direct engine view keyed by instance id — what the KV
@@ -73,6 +114,15 @@ class RemoteWorkerEngine:
     def __init__(self, client: EndpointClient, instance_id: int):
         self.client = client
         self.instance_id = instance_id
+
+    async def clear_kv_blocks(self) -> int:
+        total = 0
+        async for item in self.client.generate(
+            {"__op__": "clear_kv"}, mode="direct",
+            instance_id=self.instance_id,
+        ):
+            total += int(item.get("cleared", 0))
+        return total
 
     async def generate(
         self, request: PreprocessedRequest

@@ -1,0 +1,182 @@
+"""Load predictors (reference load_predictor.py:159) + SLA interpolation
+(reference utils/perf_interpolation.py) + planner-with-predictor sim."""
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from dynamo_tpu.predictors import (
+    ARPredictor,
+    ConstantPredictor,
+    MovingAveragePredictor,
+    make_predictor,
+)
+from dynamo_tpu.profiler import SlaCapacity
+
+
+def test_constant_returns_last():
+    p = ConstantPredictor()
+    assert p.predict_next() == 0.0
+    for v in (3, 7, 5):
+        p.add_data_point(v)
+    assert p.predict_next() == 5.0
+    assert p.get_last_value() == 5.0
+
+
+def test_moving_average_smooths():
+    p = MovingAveragePredictor(window_size=4)
+    for v in (0, 10, 0, 10):
+        p.add_data_point(v)
+    assert p.predict_next() == pytest.approx(5.0)
+
+
+def test_ar_learns_linear_trend():
+    p = ARPredictor(window_size=30, order=3, d=1)
+    for i in range(20):
+        p.add_data_point(2.0 * i)
+    # next value of 0,2,4,... is 40
+    assert p.predict_next() == pytest.approx(40.0, abs=1.0)
+
+
+def test_ar_constant_series():
+    p = ARPredictor()
+    for _ in range(15):
+        p.add_data_point(7.0)
+    assert p.predict_next() == pytest.approx(7.0, abs=0.5)
+
+
+def test_ar_never_negative():
+    p = ARPredictor(d=1)
+    for v in (50, 40, 30, 20, 10, 5, 2, 1, 0, 0):
+        p.add_data_point(v)
+    assert p.predict_next() >= 0.0
+
+
+def test_ar_few_points_falls_back_to_mean():
+    p = ARPredictor()
+    p.add_data_point(4.0)
+    p.add_data_point(6.0)
+    assert p.predict_next() == pytest.approx(5.0)
+
+
+def test_nan_observation_ignored():
+    p = ConstantPredictor()
+    p.add_data_point(3.0)
+    p.add_data_point(float("nan"))
+    assert p.predict_next() == 3.0
+
+
+def test_make_predictor_names():
+    assert isinstance(make_predictor("constant"), ConstantPredictor)
+    assert isinstance(make_predictor("arima"), ARPredictor)
+    with pytest.raises(ValueError):
+        make_predictor("prophet")
+
+
+# ---------------------------------------------------------------------------
+# SLA surface interpolation
+
+def _profile(points):
+    return {"configs": [{"name": "c", "points": points}]}
+
+
+def test_interpolate_between_points():
+    cap = SlaCapacity(
+        profile=_profile([
+            {"concurrency": 2, "ttft_p50_s": 0.1, "itl_p50_s": 0.01},
+            {"concurrency": 10, "ttft_p50_s": 0.9, "itl_p50_s": 0.05},
+        ]),
+        ttft_sla_s=0.5,
+    )
+    ttft, itl = cap.interpolate(6.0)
+    assert ttft == pytest.approx(0.5)
+    assert itl == pytest.approx(0.03)
+    # clamped outside range
+    assert cap.interpolate(1)[0] == pytest.approx(0.1)
+    assert cap.interpolate(99)[0] == pytest.approx(0.9)
+
+
+def test_max_concurrency_interpolates_crossing():
+    cap = SlaCapacity(
+        profile=_profile([
+            {"concurrency": 2, "ttft_p50_s": 0.1, "itl_p50_s": 0.01},
+            {"concurrency": 10, "ttft_p50_s": 0.9, "itl_p50_s": 0.05},
+        ]),
+        ttft_sla_s=0.5,
+    )
+    # crossing at concurrency 6 — between the profiled 2 and 10
+    assert cap.max_concurrency() == 6
+
+
+def test_max_concurrency_zero_when_even_lowest_violates():
+    cap = SlaCapacity(
+        profile=_profile([{"concurrency": 1, "ttft_p50_s": 2.0,
+                           "itl_p50_s": 0.5}]),
+        ttft_sla_s=0.5,
+    )
+    assert cap.max_concurrency() == 0
+
+
+def test_max_concurrency_full_range_ok():
+    cap = SlaCapacity(
+        profile=_profile([
+            {"concurrency": 1, "ttft_p50_s": 0.1, "itl_p50_s": 0.01},
+            {"concurrency": 8, "ttft_p50_s": 0.2, "itl_p50_s": 0.02},
+        ]),
+        ttft_sla_s=0.5, itl_sla_s=0.1,
+    )
+    assert cap.max_concurrency() == 8
+
+
+# ---------------------------------------------------------------------------
+# planner sim: predictor-filtered decisions flap less on noisy load
+
+class _FakeConnector:
+    def __init__(self):
+        self.n = 2
+
+    def current_replicas(self) -> int:
+        return self.n
+
+    async def set_replicas(self, n: int) -> None:
+        self.n = n
+
+
+def _sim_flaps(predictor: str, series) -> int:
+    """Feed a load series through Planner.decide(); count target changes."""
+    from dynamo_tpu.kv_router.protocols import (
+        ForwardPassMetrics, KvStats, WorkerStats,
+    )
+    from dynamo_tpu.planner import Planner, PlannerConfig
+
+    cfg = PlannerConfig(predictor=predictor, stable_intervals=1,
+                        min_replicas=1, max_replicas=8)
+    conn = _FakeConnector()
+    planner = Planner(kv=None, connector=conn, config=cfg)
+    changes = 0
+    prev = conn.n
+    for usage in series:
+        planner.aggregator.update(ForwardPassMetrics(
+            worker_id="w0",
+            worker_stats=WorkerStats(
+                request_active_slots=1, request_total_slots=8,
+                num_requests_waiting=0),
+            kv_stats=KvStats(kv_active_blocks=int(usage * 100),
+                             kv_total_blocks=100,
+                             gpu_cache_usage_perc=usage),
+        ))
+        target = planner.decide()
+        conn.n = target
+        if target != prev:
+            changes += 1
+        prev = target
+    return changes
+
+
+def test_predictor_reduces_flapping():
+    # noise oscillating across the scale-up threshold (0.8)
+    rng = np.random.RandomState(3)
+    series = np.clip(0.78 + 0.1 * rng.randn(40), 0.0, 1.0)
+    flappy = _sim_flaps("constant", series)
+    smooth = _sim_flaps("moving_average", series)
+    assert smooth < flappy

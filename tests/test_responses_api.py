@@ -1,0 +1,96 @@
+"""OpenAI Responses API tests (reference protocols/openai/responses.rs):
+unary + streamed typed events + validation, over the echo engine."""
+import json
+
+from tests.test_http_service import make_echo_service, with_client
+
+
+async def test_responses_unary():
+    client = await with_client(make_echo_service())
+    r = await client.post(
+        "/v1/responses",
+        json={"model": "echo", "input": "hello world", "max_output_tokens": 2},
+    )
+    assert r.status == 200
+    body = await r.json()
+    assert body["object"] == "response"
+    assert body["status"] == "incomplete"  # ran into max_output_tokens
+    assert body["incomplete_details"] == {"reason": "max_output_tokens"}
+    msg = body["output"][0]
+    assert msg["type"] == "message" and msg["role"] == "assistant"
+    assert msg["content"][0]["type"] == "output_text"
+    assert msg["content"][0]["text"].strip() == "hello world"
+    assert body["usage"]["output_tokens"] == 2
+    assert body["usage"]["input_tokens"] > 0
+    await client.close()
+
+
+async def test_responses_message_array_and_instructions():
+    client = await with_client(make_echo_service())
+    r = await client.post(
+        "/v1/responses",
+        json={
+            "model": "echo",
+            "instructions": "hello",
+            "input": [
+                {"type": "message", "role": "user",
+                 "content": [{"type": "input_text", "text": "world"}]},
+            ],
+            "max_output_tokens": 2,
+        },
+    )
+    assert r.status == 200
+    body = await r.json()
+    # echo returns the formatted prompt: instructions + input concatenated
+    assert body["output"][0]["content"][0]["text"].strip() == "hello world"
+    await client.close()
+
+
+async def test_responses_streaming_events():
+    client = await with_client(make_echo_service())
+    r = await client.post(
+        "/v1/responses",
+        json={"model": "echo", "input": "hello world",
+              "max_output_tokens": 2, "stream": True},
+    )
+    assert r.status == 200
+    raw = (await r.content.read()).decode()
+    events = []
+    for block in raw.split("\n\n"):
+        lines = dict(
+            ln.split(": ", 1) for ln in block.splitlines() if ": " in ln
+        )
+        if "event" in lines:
+            events.append((lines["event"], json.loads(lines["data"])))
+    kinds = [k for k, _ in events]
+    assert kinds[0] == "response.created"
+    assert events[0][1]["response"]["status"] == "in_progress"
+    assert "response.output_text.delta" in kinds
+    assert kinds[-2] == "response.output_text.done"
+    assert kinds[-1] == "response.incomplete"  # hit max_output_tokens
+    text = "".join(d["delta"] for k, d in events
+                   if k == "response.output_text.delta")
+    assert text.strip() == "hello world"
+    final = events[-1][1]["response"]
+    assert final["output"][0]["content"][0]["text"].strip() == "hello world"
+    await client.close()
+
+
+async def test_responses_validation():
+    client = await with_client(make_echo_service())
+    # empty input
+    r = await client.post("/v1/responses",
+                          json={"model": "echo", "input": ""})
+    assert r.status == 400
+    # stateful chaining rejected
+    r = await client.post(
+        "/v1/responses",
+        json={"model": "echo", "input": "x",
+              "previous_response_id": "resp_123"},
+    )
+    assert r.status == 400
+    # unknown model
+    r = await client.post("/v1/responses",
+                          json={"model": "nope", "input": "x"})
+    assert r.status == 404
+    await client.close()

@@ -1,0 +1,204 @@
+"""Decompose the fused decode round's device time on the real chip.
+
+Times each suspected component of the ~17ms/step (round 3 bench) as its own
+jitted fori_loop mirroring the engine_round structure, so we know where the
+gap to the ~3ms weight-pass roofline goes. Run: python tools/profile_decode.py
+"""
+from __future__ import annotations
+
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dynamo_tpu.engine import sampling
+from dynamo_tpu.engine.config import EngineConfig
+from dynamo_tpu.models import llama
+from dynamo_tpu.models.config import ModelConfig
+from dynamo_tpu.ops.pallas_attention import paged_decode_attention_pallas
+
+N_STEPS = 16
+B = 32
+W = 8  # page-table width (ctx up to 512)
+
+
+def timeit(name, fn, *args, reps=5):
+    out = fn(*args)
+    jax.block_until_ready(out)  # compile
+    t0 = time.monotonic()
+    for _ in range(reps):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    dt = (time.monotonic() - t0) / reps
+    print(f"{name:28s} {dt * 1e3 / N_STEPS:8.3f} ms/step   ({dt * 1e3:8.2f} ms/round)")
+    return dt
+
+
+def main():
+    c = ModelConfig.llama3_1b()
+    e = EngineConfig(
+        num_pages=416, page_size=64, max_pages_per_seq=16,
+        max_decode_slots=B, flush_every=N_STEPS,
+    )
+    params = llama.init_params(c, 0)
+    params = jax.device_put(params)
+    cache = jax.device_put(llama.init_cache(c, e.num_pages, e.page_size, jnp.bfloat16))
+    ring = jax.device_put(llama.init_ring(c, B, N_STEPS, jnp.bfloat16))
+
+    rng = np.random.RandomState(0)
+    pt = np.zeros((B, W), np.int32)
+    for b in range(B):
+        pt[b] = rng.permutation(np.arange(1, e.num_pages))[:W]
+    pt = jnp.asarray(pt)
+    ctx = jnp.full((B,), 356, jnp.int32)
+    ring_base = ctx - 1
+    tokens = jnp.ones((B,), jnp.int32)
+    logits_fixed = jax.device_put(
+        jnp.asarray(rng.randn(B, c.vocab_size), jnp.float32))
+
+    dev = {
+        "tokens": tokens, "ctx": ctx,
+        "cap": jnp.full((B,), W * e.page_size, jnp.int32),
+        "keys": jnp.zeros((B, 2), jnp.uint32),
+        "counts": jnp.zeros((B, c.vocab_size), jnp.int32),
+        "temp": jnp.zeros(B, jnp.float32),
+        "top_k": jnp.zeros(B, jnp.int32),
+        "top_p": jnp.ones(B, jnp.float32),
+        "freq": jnp.zeros(B, jnp.float32),
+        "pres": jnp.zeros(B, jnp.float32),
+        "rep": jnp.ones(B, jnp.float32),
+    }
+    sp = sampling.SamplingParams(
+        temperature=dev["temp"], top_k=dev["top_k"], top_p=dev["top_p"],
+        frequency_penalty=dev["freq"], presence_penalty=dev["pres"],
+        repetition_penalty=dev["rep"],
+    )
+
+    # ---- 1. full round (engine_round equivalent) ----
+    @functools.partial(jax.jit, static_argnums=())
+    def full_round(params, cache, ring, dev, pt, ring_base):
+        def body(s, carry):
+            ring, dev = carry
+            ring, logits = llama.decode_step_impl(
+                c, params, cache, ring, dev["tokens"], pt, dev["ctx"],
+                ring_base, s)
+            toks, st = sampling.sample_step_impl(
+                logits, sampling.SamplerState(dev["keys"], dev["counts"]),
+                sp, e.max_top_k)
+            dev = dict(dev, tokens=toks, ctx=jnp.minimum(dev["ctx"] + 1, dev["cap"]),
+                       keys=st.keys, counts=st.counts)
+            return ring, dev
+        ring, dev = jax.lax.fori_loop(0, N_STEPS, body, (ring, dev))
+        valid = jnp.minimum(jnp.int32(N_STEPS), dev["cap"] - ring_base)
+        cache2 = llama.flush_impl(c, cache, ring, pt, ring_base, valid)
+        return cache2, ring, dev
+
+    timeit("full_round", full_round, params, cache, ring, dev, pt, ring_base)
+
+    # ---- 2. model-only (no sampling: cheap argmax over 128 lanes) ----
+    @jax.jit
+    def model_only(params, cache, ring, tokens, pt, ctx, ring_base):
+        def body(s, carry):
+            ring, tokens = carry
+            ring, logits = llama.decode_step_impl(
+                c, params, cache, ring, tokens, pt, ctx, ring_base, s)
+            toks = jnp.argmax(logits[:, :128], axis=-1).astype(jnp.int32)
+            return ring, toks
+        ring, tokens = jax.lax.fori_loop(0, N_STEPS, body, (ring, tokens))
+        return ring, tokens
+
+    timeit("model_only(+argmax128)", model_only, params, cache, ring,
+           tokens, pt, ctx, ring_base)
+
+    # ---- 3. sampling only ----
+    @jax.jit
+    def sample_only(logits, keys, counts):
+        def body(s, carry):
+            keys, counts = carry
+            toks, st = sampling.sample_step_impl(
+                logits, sampling.SamplerState(keys, counts), sp, e.max_top_k)
+            return st.keys, st.counts
+        return jax.lax.fori_loop(0, N_STEPS, body, (keys, counts))
+
+    timeit("sample_only", sample_only, logits_fixed, dev["keys"], dev["counts"])
+
+    # ---- 4. top_k only ----
+    @jax.jit
+    def topk_only(logits):
+        def body(s, acc):
+            vals, idxs = jax.lax.top_k(logits + acc, 64)
+            return acc + vals[0, 0]
+        return jax.lax.fori_loop(0, N_STEPS, body, jnp.float32(0))
+
+    timeit("topk64_only", topk_only, logits_fixed)
+
+    # ---- 5. attention only (16 layers x pallas kernel) ----
+    q = jax.device_put(jnp.asarray(
+        rng.randn(B, c.num_heads, c.head_dim), jnp.bfloat16))
+
+    @jax.jit
+    def attn_only(q, cache, ring, pt, ctx, ring_base):
+        def body(s, acc):
+            out = acc
+            for l in range(c.num_layers):
+                out = paged_decode_attention_pallas(
+                    q + out, cache["k"], cache["v"], ring["k"], ring["v"],
+                    jnp.int32(l), pt, ctx, ring_base)
+            return out
+        return jax.lax.fori_loop(0, N_STEPS, body, jnp.zeros_like(q))
+
+    timeit("attn_only(16L pallas)", attn_only, q, cache, ring, pt, ctx, ring_base)
+
+    # ---- 6. matmuls only (weight-bound floor) ----
+    @jax.jit
+    def matmul_only(params, tokens):
+        def body(s, tokens):
+            h = params["embed"][tokens].astype(jnp.bfloat16)
+            for l in range(c.num_layers):
+                lp = jax.tree.map(lambda x: x[l], params["layers"])
+                x = llama.rms_norm(h, lp["ln1"], c.rms_norm_eps)
+                qq = x @ lp["wq"]
+                kk = x @ lp["wk"]
+                vv = x @ lp["wv"]
+                h = h + (qq + jnp.pad(kk, ((0, 0), (0, c.q_dim - c.kv_dim)))
+                         + jnp.pad(vv, ((0, 0), (0, c.q_dim - c.kv_dim)))) @ lp["wo"]
+                x2 = llama.rms_norm(h, lp["ln2"], c.rms_norm_eps)
+                h = h + (jax.nn.silu(x2 @ lp["wg"]) * (x2 @ lp["wu"])) @ lp["wd"]
+            logits = llama._logits(c, params, h)
+            return jnp.argmax(logits[:, :128], axis=-1).astype(jnp.int32)
+        return jax.lax.fori_loop(0, N_STEPS, body, tokens)
+
+    timeit("matmul_only(floor)", matmul_only, params, tokens)
+
+    # ---- 7. lm head only ----
+    h = jax.device_put(jnp.asarray(rng.randn(B, c.hidden_size), jnp.bfloat16))
+
+    @jax.jit
+    def head_only(params, h):
+        def body(s, h):
+            logits = llama._logits(c, params, h)
+            return h + logits[:, :c.hidden_size].astype(jnp.bfloat16) * 1e-9
+        return jax.lax.fori_loop(0, N_STEPS, body, h)
+
+    timeit("lm_head_only", head_only, params, h)
+
+    # ---- 8. flush only (once per round) ----
+    @jax.jit
+    def flush_only(cache, ring, pt, ring_base):
+        valid = jnp.full((B,), N_STEPS, jnp.int32)
+        return llama.flush_impl(c, cache, ring, pt, ring_base, valid)
+
+    out = flush_only(cache, ring, pt, ring_base)
+    jax.block_until_ready(out)
+    t0 = time.monotonic()
+    for _ in range(5):
+        out = flush_only(cache, ring, pt, ring_base)
+    jax.block_until_ready(out)
+    dt = (time.monotonic() - t0) / 5
+    print(f"{'flush_only(per round)':28s} {dt * 1e3 / N_STEPS:8.3f} ms/step   ({dt * 1e3:8.2f} ms/round)")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,147 @@
+"""Prototype the ring-free decode round: direct pool scatter + XLA gather
+attention, full model, 16 fused steps. The decisive measurement for the
+round-4 engine redesign — compare against the r03 17.2 ms/step and the
+3.5 ms/step matmul floor. Run: python tools/profile_round_v2.py
+"""
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dynamo_tpu.engine import sampling
+from dynamo_tpu.models import llama
+from dynamo_tpu.models.config import ModelConfig
+from dynamo_tpu.ops.rope import apply_rope, rope_cos_sin, rope_inv_freq
+
+N_STEPS = 16
+B, W, P, PS = 32, 8, 416, 64
+
+
+def decode_step_v2(c, params, cache, tokens, page_tables, ctx_lens):
+    """One decode step, writing KV directly into the pool (no ring).
+    ctx_lens INCLUDES the current token; its position is ctx-1."""
+    inv_freq = jnp.asarray(
+        rope_inv_freq(c.head_dim, c.rope_theta, c.rope_scaling_dict))
+    positions = jnp.maximum(ctx_lens - 1, 0)
+    cos, sin = rope_cos_sin(positions, inv_freq)
+    h = params["embed"][tokens].astype(cache["k"].dtype)
+    n_rep = c.num_heads // c.num_kv_heads
+    page_of = jnp.take_along_axis(
+        page_tables, (positions // PS)[:, None], axis=1)[:, 0]  # [B]
+    slot_of = positions % PS
+    S = W * PS
+    pool_pos = jnp.arange(S)[None, :]
+    mask = pool_pos < ctx_lens[:, None]          # [B, S]
+    scale = 1.0 / np.sqrt(c.head_dim)
+
+    for l in range(c.num_layers):
+        lp = jax.tree.map(lambda x: x[l], params["layers"])
+        x = llama.rms_norm(h, lp["ln1"], c.rms_norm_eps)
+        q = (x @ lp["wq"]).reshape(B, c.num_heads, c.head_dim)
+        k = (x @ lp["wk"]).reshape(B, c.num_kv_heads, c.head_dim)
+        v = (x @ lp["wv"]).reshape(B, c.num_kv_heads, c.head_dim)
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+        # direct pool write: [B, kvh, hd] -> pool[l, :, page_of, slot_of]
+        bidx = jnp.arange(B)
+        ck = cache["k"].at[l, :, page_of, slot_of].set(
+            k.astype(cache["k"].dtype).transpose(0, 1, 2))
+        cv = cache["v"].at[l, :, page_of, slot_of].set(
+            v.astype(cache["v"].dtype).transpose(0, 1, 2))
+        cache = {"k": ck, "v": cv}
+        # gather attention over the bucketed table width
+        kk = cache["k"][l][:, page_tables].reshape(c.num_kv_heads, B, S, c.head_dim)
+        vv = cache["v"][l][:, page_tables].reshape(c.num_kv_heads, B, S, c.head_dim)
+        kk = jnp.repeat(kk, n_rep, axis=0)
+        vv = jnp.repeat(vv, n_rep, axis=0)
+        scores = jnp.einsum("bnh,nbsh->bns", q, kk,
+                            preferred_element_type=jnp.float32) * scale
+        scores = jnp.where(mask[:, None, :], scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1)
+        attn = jnp.einsum("bns,nbsh->bnh", probs.astype(vv.dtype), vv,
+                          preferred_element_type=jnp.float32).astype(h.dtype)
+        h = h + attn.reshape(B, c.q_dim) @ lp["wo"]
+        x2 = llama.rms_norm(h, lp["ln2"], c.rms_norm_eps)
+        h = h + (jax.nn.silu(x2 @ lp["wg"]) * (x2 @ lp["wu"])) @ lp["wd"]
+
+    logits = llama._logits(c, params, h)
+    return cache, logits
+
+
+def main():
+    c = ModelConfig.llama3_1b()
+    params = jax.device_put(llama.init_params(c, 0))
+    cache = jax.device_put(llama.init_cache(c, P, PS, jnp.bfloat16))
+    rng = np.random.RandomState(0)
+    pt = np.zeros((B, W), np.int32)
+    for b in range(B):
+        pt[b] = rng.permutation(np.arange(1, P))[:W]
+    pt = jnp.asarray(pt)
+    ctx0 = jnp.full((B,), 356, jnp.int32)
+    tokens0 = jnp.ones((B,), jnp.int32)
+
+    import functools
+
+    @functools.partial(jax.jit, donate_argnums=(1,))
+    def round_v2(params, cache, tokens, pt, ctx):
+        def body(s, carry):
+            cache, tokens, ctx = carry
+            cache, logits = decode_step_v2(c, params, cache, tokens, pt, ctx)
+            toks = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            return cache, toks, ctx + 1
+        return jax.lax.fori_loop(0, N_STEPS, body, (cache, tokens0, ctx0))
+
+    out = round_v2(params, cache, tokens0, pt, ctx0)
+    jax.block_until_ready(out)
+    cache = out[0]
+    t0 = time.monotonic()
+    reps = 5
+    for _ in range(reps):
+        out = round_v2(params, cache, tokens0, pt, ctx0)
+        cache = out[0]
+    jax.block_until_ready(out)
+    dt = (time.monotonic() - t0) / reps
+    print(f"round_v2 (greedy): {dt * 1e3 / N_STEPS:.3f} ms/step "
+          f"({dt * 1e3:.2f} ms/round)")
+
+    # with full sampling state
+    dev = {
+        "keys": jnp.zeros((B, 2), jnp.uint32),
+        "counts": jnp.zeros((B, c.vocab_size), jnp.int32),
+    }
+    sp = sampling.SamplingParams(
+        temperature=jnp.zeros(B), top_k=jnp.zeros(B, jnp.int32),
+        top_p=jnp.ones(B), frequency_penalty=jnp.zeros(B),
+        presence_penalty=jnp.zeros(B), repetition_penalty=jnp.ones(B))
+
+    @functools.partial(jax.jit, donate_argnums=(1,))
+    def round_v2_sampled(params, cache, tokens, pt, ctx, keys, counts):
+        def body(s, carry):
+            cache, tokens, ctx, keys, counts = carry
+            cache, logits = decode_step_v2(c, params, cache, tokens, pt, ctx)
+            toks, st = sampling.sample_step_impl(
+                logits, sampling.SamplerState(keys, counts), sp, 64)
+            return cache, toks, ctx + 1, st.keys, st.counts
+        return jax.lax.fori_loop(
+            0, N_STEPS, body, (cache, tokens0, ctx0, keys, counts))
+
+    out = round_v2_sampled(params, cache, tokens0, pt, ctx0,
+                           dev["keys"], dev["counts"])
+    jax.block_until_ready(out)
+    cache = out[0]
+    t0 = time.monotonic()
+    for _ in range(reps):
+        out = round_v2_sampled(params, cache, tokens0, pt, ctx0,
+                               dev["keys"], dev["counts"])
+        cache = out[0]
+    jax.block_until_ready(out)
+    dt = (time.monotonic() - t0) / reps
+    print(f"round_v2 (full sampling): {dt * 1e3 / N_STEPS:.3f} ms/step "
+          f"({dt * 1e3:.2f} ms/round)")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,99 @@
+"""Isolate V3 components: flash kernel alone, scatter alone, both, on the
+contiguous ctx_kv layout. Run: python tools/profile_v3_parts.py"""
+from __future__ import annotations
+
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dynamo_tpu.ops.flash_decode import flash_decode_attention
+
+N_STEPS = 16
+L, NKV, NH, HD = 16, 8, 32, 64
+B, S = 32, 512
+
+
+def timeit(name, fn, *args, reps=5, donate_state=False):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    if donate_state:
+        args = (out[0], *args[1:])
+    t0 = time.monotonic()
+    for _ in range(reps):
+        out = fn(*args)
+        if donate_state:
+            args = (out[0], *args[1:])
+    jax.block_until_ready(out)
+    dt = (time.monotonic() - t0) / reps
+    print(f"{name:40s} {dt * 1e3 / N_STEPS:8.3f} ms/step  ({dt * 1e3:8.2f} ms/round)")
+
+
+def main():
+    rng = np.random.RandomState(0)
+    ck = jax.device_put(jnp.asarray(
+        rng.randn(L, NKV, B, S, HD) * 0.3, jnp.bfloat16))
+    cv = jax.device_put(jnp.asarray(
+        rng.randn(L, NKV, B, S, HD) * 0.3, jnp.bfloat16))
+    q0 = jax.device_put(jnp.asarray(rng.randn(B, NH, HD), jnp.bfloat16))
+    ctx = jnp.full((B,), 356, jnp.int32)
+    kv_new = jax.device_put(jnp.asarray(rng.randn(B, NKV, HD), jnp.bfloat16))
+
+    # 1. kernel alone, 16 layers x 16 steps, static cache
+    @jax.jit
+    def attn_only(q0, ck, cv, ctx):
+        def body(s, q):
+            out = q
+            for l in range(L):
+                out = flash_decode_attention(
+                    q0 + out * 0.01, ck, cv, jnp.int32(l), ctx)
+            return out
+        return jax.lax.fori_loop(0, N_STEPS, body, q0)
+
+    timeit("attn_only(flash,16L)", attn_only, q0, ck, cv, ctx)
+
+    # 2. scatter alone: per-layer per-step write of [B] rows
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def scatter_only(ck, kv_new, ctx):
+        bidx = jnp.arange(B)
+        def body(s, ck):
+            pos = jnp.minimum(ctx - 1 + s, S - 1)
+            for l in range(L):
+                ck = ck.at[l, :, bidx, pos].set(kv_new + s * 0.001)
+            return ck
+        return jax.lax.fori_loop(0, N_STEPS, body, ck)
+
+    timeit("scatter_only(16L)", scatter_only, ck, kv_new, ctx,
+           donate_state=False)
+
+    # 3. scatter + kernel interleaved (the real pattern)
+    @functools.partial(jax.jit, donate_argnums=(1, 2))
+    def both(q0, ck, cv, ctx, kv_new):
+        bidx = jnp.arange(B)
+        def body(s, carry):
+            ck, cv, out = carry
+            pos = jnp.minimum(ctx - 1 + s, S - 1)
+            for l in range(L):
+                ck = ck.at[l, :, bidx, pos].set(kv_new + out[0, 0, 0] * 0.001)
+                cv = cv.at[l, :, bidx, pos].set(kv_new)
+                out = flash_decode_attention(
+                    q0 + out * 0.01, ck, cv, jnp.int32(l), ctx)
+            return ck, cv, out
+        return jax.lax.fori_loop(0, N_STEPS, body, (ck, cv, q0))
+
+    out = both(q0, ck, cv, ctx, kv_new)
+    jax.block_until_ready(out)
+    ck2, cv2 = out[0], out[1]
+    t0 = time.monotonic()
+    for _ in range(5):
+        out = both(q0, ck2, cv2, ctx, kv_new)
+        ck2, cv2 = out[0], out[1]
+    jax.block_until_ready(out)
+    dt = (time.monotonic() - t0) / 5
+    print(f"{'scatter+kernel(16L)':40s} {dt * 1e3 / N_STEPS:8.3f} ms/step  ({dt * 1e3:8.2f} ms/round)")
+
+
+if __name__ == "__main__":
+    main()
