@@ -10,11 +10,14 @@ distributed inference stack) designed TPU-first:
 - KV-cache-aware routing over a global prefix radix tree.
 - Disaggregated prefill/decode with worker-to-worker KV-block migration
   (ICI within a slice, host-staged DCN across slices).
-- Multi-tier KV block manager (HBM -> host DRAM -> SSD).
-- A real JAX/XLA engine: continuous batching over a paged KV cache held as
-  a sharded HBM tensor, pjit/GSPMD tensor parallelism over the ICI mesh,
-  Pallas paged-attention kernels, on-device sampling.
-- SLA/load planner that autoscales workers.
+- Multi-tier KV block manager (G1 HBM -> G2 host DRAM -> G3 mmap disk).
+- A real JAX/XLA engine: continuous batching over contiguous per-slot KV
+  with a paged prefix-cache pool, pjit/GSPMD tensor/expert parallelism
+  over the ICI mesh, a Pallas flash-decode kernel, on-device (greedy-
+  gated) sampling, MoE serving, and sequence-parallel ring prefill for
+  long prompts.
+- SLA/load planner (constant/moving-average/AR load prediction) that
+  autoscales workers locally or through the Kubernetes API.
 
 Layer map mirrors SURVEY.md section 1 (reference layers L0-L7).
 """

@@ -78,8 +78,30 @@ def main(argv: list[str] | None = None) -> int:
         import asyncio
 
         if not rest:
-            print("usage: dynamo-tpu serve <graph.yaml>", file=sys.stderr)
+            print("usage: dynamo-tpu serve <graph.yaml> "
+                  "[--emit-k8s [--image IMG] [--k8s-namespace NS]]",
+                  file=sys.stderr)
             return 2
+        if "--emit-k8s" in rest:
+            # render the graph as kubectl-appliable manifests instead of
+            # supervising local processes (reference deploy/cloud operator
+            # + helm surface)
+            import argparse
+
+            p = argparse.ArgumentParser(prog="dynamo-tpu serve")
+            p.add_argument("graph")
+            p.add_argument("--emit-k8s", action="store_true")
+            p.add_argument("--image", default="dynamo-tpu:latest")
+            p.add_argument("--k8s-namespace", default="default")
+            args = p.parse_args(rest)
+            from dynamo_tpu.k8s import emit_k8s_manifests, render_manifests
+            from dynamo_tpu.launch.serve import load_graph
+
+            print(render_manifests(emit_k8s_manifests(
+                load_graph(args.graph), image=args.image,
+                k8s_namespace=args.k8s_namespace,
+            )))
+            return 0
         from dynamo_tpu.launch.serve import serve_main
 
         try:
@@ -266,6 +288,14 @@ def _run_planner(rest: list[str]) -> int:
                    choices=("constant", "moving_average", "ar", "arima"),
                    help="load forecaster filtering observations before "
                         "scaling decisions (reference load_predictor.py)")
+    p.add_argument("--connector", default="local",
+                   choices=("local", "kubernetes"),
+                   help="scale actuator: spawn local worker subprocesses, "
+                        "or patch a k8s Deployment's replicas (reference "
+                        "local_connector.py / kubernetes_connector.py)")
+    p.add_argument("--k8s-deployment", default=None,
+                   help="worker Deployment name (connector=kubernetes)")
+    p.add_argument("--k8s-namespace", default="default")
     # SLA mode (reference planner_sla.py): consume a profiler table
     p.add_argument("--sla-profile", default=None, metavar="PROFILE_JSON",
                    help="profile from `dynamo-tpu profile`; enables SLA "

@@ -44,6 +44,13 @@ class EngineConfig:
     # prefix cache
     enable_prefix_caching: bool = True
 
+    # sequence-parallel ring prefill (ops/ring_attention.py): prompts of at
+    # least this many tokens run as ONE whole-prompt ring-attention pass
+    # over the mesh's `sp` axis instead of chunked local prefill. None
+    # disables. Requires the engine mesh to have sp > 1; the long-context
+    # path the reference lacks (SURVEY §2.5 SP row).
+    sp_prefill_threshold: Optional[int] = None
+
     # host-DRAM offload tier (KVBM G2): 0 disables. Pages parked in the
     # LRU are asynchronously copied to a host pool of this many pages;
     # prefix misses in HBM onboard from it instead of recomputing.

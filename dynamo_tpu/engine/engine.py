@@ -1,31 +1,40 @@
-"""TpuEngine: pipelined continuous batching over the paged-KV JAX model.
+"""TpuEngine: pipelined continuous batching over contiguous per-slot KV.
 
 Architecture (TPU-first redesign of what the reference delegates to vLLM —
-SURVEY.md §7 step 3). The defining constraint is that device→host reads
-have high latency (µs on PCIe TPU VMs, ~80ms through a tunneled dev chip)
-while dispatches and host→device uploads are cheap and asynchronous. The
-engine therefore NEVER blocks a decode step on host data:
+SURVEY.md §7 step 3; round-4 layout, see models/llama.py module doc). The
+defining constraints: device→host reads have high latency (µs on PCIe TPU
+VMs, ~80ms through a tunneled dev chip) while dispatches and host→device
+uploads are cheap and asynchronous, and paged gathers/scatters in the
+per-step program waste bandwidth. The engine therefore NEVER blocks a
+decode step on host data, and keeps PAGING OUT of the hot path:
 
-  - All decode state lives on device: last tokens, context lengths, page
-    tables, context caps, sampler keys/counts, per-slot sampling params.
-    One fused jit (decode + sample + state advance) steps every slot.
+  - Serving context is contiguous per slot (``ctx_kv``); the paged pool is
+    prefix-cache storage, copied in at admission (load_ctx_pages) and out
+    at block seal (seal_blocks). Decode attention streams dense slabs
+    (ops/flash_decode.py).
+  - All decode state lives on device: last tokens, context lengths, write
+    destinations, sampler keys/counts, per-slot sampling params. One fused
+    jit (decode + sample + state advance) steps every slot;
+    all-greedy rounds skip the full sampler (static want_sample gate).
   - The host loop dispatches steps ahead in rounds of ``flush_every``; each
     round's sampled tokens are stacked on device ([F, B]) and fetched with
     ``copy_to_host_async`` — fetches pipeline behind compute, so results
     arrive a bounded LAG behind dispatch without ever stalling the device.
-  - Host processing (token emission, stop detection, block sealing/commit,
-    page growth, admission, preemption) runs on lagged results. State
-    changes are applied via a patch jit dispatched between rounds —
-    device-order semantics make this race-free: a step dispatched before a
-    patch sees pre-patch state, and page writes it performs land before
-    any later prefill that reuses those pages.
+  - Host processing (token emission, stop detection, block sealing,
+    admission) runs on lagged results. State changes are applied via a
+    patch jit dispatched between rounds — device-order semantics make this
+    race-free: a step dispatched before a patch sees pre-patch state, and
+    a seal copy dispatched before a lane's re-prefill reads the pre-reuse
+    content.
   - Slots finished on host keep garbage-decoding until their release patch
-    lands (≤ pipeline lag steps). Safety: garbage writes only ever touch
-    the slot's own uncommitted tail pages, pre-allocated private pages, or
-    the reserved scratch page 0 — a finished request's final sealed block
-    is deliberately NOT committed to the prefix cache (see _finish).
-  - Prefill runs per request at bucketed padded lengths; the first token is
-    sampled on device and patched into the slot without a host round trip.
+    lands (≤ pipeline lag steps). Safety: the release patch redirects the
+    lane's writes to the scratch lane (dest), so a lane being prefilled
+    for its next request is never corrupted; before release, garbage
+    writes advance monotonically past every sealed position.
+  - Prefill runs per request at bucketed padded lengths into the slot's
+    region; the first token is sampled on device and patched into the slot
+    without a host round trip. Admission needs only a free lane — active
+    requests can never run out of KV space, so there is no preemption.
 
 The engine implements the AsyncEngine contract: ``generate(request)`` yields
 LLMEngineOutput deltas; dropping the iterator cancels (reference
@@ -88,10 +97,9 @@ class _Request:
     seq: TokenBlockSequence
     out: asyncio.Queue
     loop: asyncio.AbstractEventLoop
-    # current (possibly restart-extended) prompt — kept separate from
-    # req.token_ids so preemption never mutates the caller's request object
+    # the prompt — kept separate from req.token_ids so engine-side state
+    # never mutates the caller's request object
     tokens: list[int] = field(default_factory=list)
-    pages: list[int] = field(default_factory=list)
     matched_blocks: int = 0
     # chunked-prefill progress: tokens already in cache (-1 = not started).
     # Prefill runs ONE chunk per scheduling round so decode rounds
@@ -164,14 +172,23 @@ class TpuEngine:
         if params is None:
             params = llama.init_params(c, rng_seed)
         self.params = jax.tree.map(lambda x, s: jax.device_put(x, s), params, p_sh)
+        # paged pool: prefix-cache STORAGE (sealed blocks copied in,
+        # admission prefixes copied out — models/llama.py module doc)
         self.cache = jax.tree.map(
             lambda x, s: jax.device_put(x, s),
             llama.init_cache(c, e.num_pages, e.page_size, cache_dtype),
             llama.cache_shardings(c, self.mesh),
         )
-        # decode write ring: one lane per slot, flush_every entries deep —
-        # decode steps write here; llama.flush scatters a full ring into the
-        # page pool once per round (see models/llama.py init_ring)
+        # contiguous per-slot serving context (+1 scratch lane for freed
+        # slots' in-flight garbage steps)
+        self.ctx = jax.tree.map(
+            lambda x, s: jax.device_put(x, s),
+            llama.init_ctx(c, e.max_decode_slots, e.max_context, cache_dtype),
+            llama.ctx_shardings(c, self.mesh),
+        )
+        # decode write ring: the round's steps write here; flush_ctx
+        # scatters it into the ctx region once per round (keeping the
+        # GB-scale region read-only inside the round — see llama.init_ring)
         self.ring = jax.tree.map(
             lambda x, s: jax.device_put(x, s),
             llama.init_ring(c, e.max_decode_slots, e.flush_every, cache_dtype),
@@ -220,17 +237,20 @@ class TpuEngine:
         B = e.max_decode_slots
         self._B = B
         self._slots: list[Optional[_Request]] = [None] * B
-        # host mirrors of dispatch-time state (exactly track device values)
-        self._pt_disp = np.zeros((B, e.max_pages_per_seq), np.int32)
+        # slots reserved by an in-progress (multi-chunk) prefill: occupied
+        # but NOT decoding — their dev lane stays parked on scratch until
+        # the admission patch
+        self._prefilling: dict[int, _Request] = {}
+        # host mirror of dispatch-time context lengths
         self._ctx_disp = np.ones(B, np.int32)
-        self._cap_disp = np.full(B, e.page_size, np.int32)
 
-        # device state dict (page tables stay host-side — uploaded
-        # width-bucketed per round, so the attention grid tracks actual use)
+        # device state dict
         self._dev = {
             "tokens": jnp.zeros(B, jnp.int32),
             "ctx": jnp.ones(B, jnp.int32),
-            "cap": jnp.full((B,), e.page_size, jnp.int32),
+            # live slots write their own ctx lane; freed slots write the
+            # scratch lane B (protects lanes being re-prefilled)
+            "dest": jnp.full((B,), B, jnp.int32),
             "keys": jnp.zeros((B, 2), jnp.uint32),
             "counts": jnp.zeros((B, c.vocab_size), jnp.int32),
             "temp": jnp.zeros(B, jnp.float32),
@@ -247,13 +267,16 @@ class TpuEngine:
         self._xfer: queue_mod.Queue = queue_mod.Queue()  # page export/import
         self._waiting: list[_Request] = []
         self._entries: list[_Entry] = []
-        self._grow_dirty: set[int] = set()
+        # sealed blocks awaiting the batched ctx->pool copy:
+        # (slot, start_pos, pool_page)
+        self._seal_queue: list[tuple[int, int, int]] = []
         self._to_release: list[_Request] = []
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._started = False
         self.step_count = 0
         self.tokens_generated = 0
+        self.sp_prefills = 0
 
     # ------------------------------------------------------------------
     # jitted programs
@@ -261,25 +284,26 @@ class TpuEngine:
     def _build_jits(self) -> None:
         c, e = self.config, self.ecfg
         max_top_k = e.max_top_k
+        max_context = e.max_context
 
         max_logprobs = e.max_logprobs
 
         @functools.partial(jax.jit, donate_argnums=(1, 2, 3),
-                           static_argnums=(6, 7))
-        def engine_round(params, cache, ring, dev, pt, ring_base,
-                         n_steps, want_lp):
+                           static_argnums=(4, 5, 6))
+        def engine_round(params, ctx_kv, ring, dev, n_steps, want_lp,
+                         want_sample):
             """A FULL scheduling round in one program: n_steps fused
             decode+sample steps via lax.fori_loop (body compiles once) and
-            the ring->pool flush — one dispatch + one result fetch per
+            the ring->ctx flush — one dispatch + one result fetch per
             round instead of n_steps+2, the single biggest lever on
-            per-step host overhead. pt is width-bucketed [B, W] (one
-            compile per (W, n_steps, want_lp)); `want_lp` adds the logprob
-            computation only for rounds that asked for it.
-
-            Flush contract: pt must cover every position written this
-            round (the scheduler's _ensure_coverage guarantees it), so the
-            bucketed table doubles as the flush table."""
+            per-step host overhead. The ctx region is READ-ONLY until the
+            tail flush (write/read interleave on it forces XLA copies —
+            llama.init_ring). `want_lp` adds the logprob computation only
+            for rounds that asked for it; `want_sample` gates the full
+            sampler — all-greedy rounds (the common serving case) take a
+            bare argmax instead of top-k over the vocab."""
             B = dev["tokens"].shape[0]
+            ring_base = jnp.maximum(dev["ctx"] - 1, 0)
             toks_out = jnp.zeros((n_steps, B), jnp.int32)
             lp_out = (
                 (jnp.zeros((n_steps, B), jnp.float32),
@@ -293,16 +317,26 @@ class TpuEngine:
                 repetition_penalty=dev["rep"],
             )
 
+            # MoE models: freed/garbage lanes must not claim expert
+            # capacity (and masking keeps outputs batch-independent)
+            live = (dev["dest"] != B) if c.moe is not None else None
+
             def body(s, carry):
                 ring, dev, toks_out, lp_out = carry
                 ring, logits = llama.decode_step_impl(
-                    c, params, cache, ring, dev["tokens"], pt, dev["ctx"],
-                    ring_base, s,
+                    c, params, ctx_kv, ring, dev["tokens"], dev["ctx"],
+                    ring_base, s, live,
                 )
-                toks, st = sampling.sample_step_impl(
-                    logits, sampling.SamplerState(dev["keys"], dev["counts"]),
-                    sp, max_top_k,
-                )
+                if want_sample:
+                    toks, st = sampling.sample_step_impl(
+                        logits,
+                        sampling.SamplerState(dev["keys"], dev["counts"]),
+                        sp, max_top_k,
+                    )
+                    keys, counts = st.keys, st.counts
+                else:
+                    toks = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                    keys, counts = dev["keys"], dev["counts"]
                 toks_out = jax.lax.dynamic_update_index_in_dim(
                     toks_out, toks, s, 0
                 )
@@ -321,39 +355,46 @@ class TpuEngine:
                 dev = dict(
                     dev,
                     tokens=toks,
-                    ctx=jnp.minimum(dev["ctx"] + 1, dev["cap"]),
-                    keys=st.keys,
-                    counts=st.counts,
+                    ctx=jnp.minimum(dev["ctx"] + 1, max_context),
+                    keys=keys,
+                    counts=counts,
                 )
                 return ring, dev, toks_out, lp_out
 
             ring, dev, toks_out, lp_out = jax.lax.fori_loop(
                 0, n_steps, body, (ring, dev, toks_out, lp_out)
             )
-            # round boundary: scatter the ring into the pool in-program
-            valid = jnp.minimum(
-                jnp.int32(n_steps), dev["cap"] - ring_base
+            # round boundary: scatter the ring into the ctx region
+            # (single write, after every read — aliases in place)
+            valid = jnp.minimum(jnp.int32(n_steps), max_context - ring_base)
+            ctx_kv = llama.flush_ctx_impl(
+                ctx_kv, ring, dev["dest"], ring_base, valid
             )
-            cache = llama.flush_impl(c, cache, ring, pt, ring_base, valid)
-            return cache, ring, dev, toks_out, lp_out
+            return ctx_kv, ring, dev, toks_out, lp_out
 
         @functools.partial(jax.jit, donate_argnums=(0,))
         def patch(
-            dev, clear_mask, grow_mask, cap_new,
+            dev, clear_mask,
             admit_slot, admit_ctx, admit_tok, admit_keys,
             admit_temp, admit_top_k, admit_top_p,
             admit_freq, admit_pres, admit_rep,
         ):
+            B = dev["tokens"].shape[0]
             dev = dict(dev)
-            dev["cap"] = jnp.where(grow_mask | clear_mask, cap_new, dev["cap"])
             dev["ctx"] = jnp.where(clear_mask, 1, dev["ctx"])
             dev["tokens"] = jnp.where(clear_mask, 0, dev["tokens"])
             dev["temp"] = jnp.where(clear_mask, 0.0, dev["temp"])
             dev["counts"] = jnp.where(clear_mask[:, None], 0, dev["counts"])
+            # freed slots park on the scratch lane so their in-flight
+            # garbage steps can't touch a lane being re-prefilled
+            dev["dest"] = jnp.where(
+                clear_mask, B, dev["dest"]
+            ).astype(jnp.int32)
             # single admission (admit_slot == B sentinel -> all .at[] dropped)
             s = admit_slot
             dev["tokens"] = dev["tokens"].at[s].set(admit_tok[0])
             dev["ctx"] = dev["ctx"].at[s].set(admit_ctx)
+            dev["dest"] = dev["dest"].at[s].set(admit_slot)
             dev["keys"] = dev["keys"].at[s].set(admit_keys)
             dev["counts"] = dev["counts"].at[s].set(0)
             dev["temp"] = dev["temp"].at[s].set(admit_temp)
@@ -420,10 +461,17 @@ class TpuEngine:
                 f"prompt length {len(request.token_ids)} exceeds max context "
                 f"{self.ecfg.max_context}"
             )
+        # multimodal requests salt their block hashes with the image digest:
+        # placeholder tokens are identical across different images, and a
+        # prefix-cache hit keyed on tokens alone would serve the wrong
+        # image's KV
+        salt = request.model
+        if request.multimodal and request.multimodal.get("digest"):
+            salt = f"{salt}|mm:{request.multimodal['digest']}"
         r = _Request(
             req=request,
             seq=TokenBlockSequence.from_tokens(
-                request.token_ids, self.ecfg.page_size, salt=request.model
+                request.token_ids, self.ecfg.page_size, salt=salt
             ),
             out=asyncio.Queue(),
             loop=asyncio.get_running_loop(),
@@ -577,9 +625,17 @@ class TpuEngine:
         return ForwardPassMetrics(
             worker_id=self.ecfg.worker_id,
             worker_stats=WorkerStats(
-                request_active_slots=sum(s is not None for s in self._slots),
+                request_active_slots=(
+                    sum(s is not None for s in self._slots)
+                    + len(self._prefilling)
+                ),
                 request_total_slots=self._B,
-                num_requests_waiting=len(self._waiting) + self._intake.qsize(),
+                # in-prefill requests count as active (they hold a lane),
+                # not waiting
+                num_requests_waiting=(
+                    sum(1 for r in self._waiting if r.slot < 0)
+                    + self._intake.qsize()
+                ),
             ),
             kv_stats=KvStats(
                 kv_active_blocks=a.active_pages,
@@ -635,12 +691,13 @@ class TpuEngine:
             done.set()
 
     def _round(self) -> bool:
-        """One scheduling round: process ready results, apply patches
-        (releases, admissions, page growth), dispatch a round of steps."""
+        """One scheduling round: process ready results, flush seal copies,
+        apply patches (releases, admissions), dispatch a round of steps."""
         e = self.ecfg
         self._drain_intake()
         rounds_in_flight = sum(1 for en in self._entries if en.kind == "round")
         self._process_entries(block=rounds_in_flight > e.max_inflight_rounds)
+        self._flush_seals()
         self._apply_releases()
         self._process_transfers()
         self._dispatch_offloads()
@@ -669,33 +726,35 @@ class TpuEngine:
         """Dispatch flush_every fused steps + one stacked-token fetch."""
         e = self.ecfg
         n = e.flush_every
-        if not self._ensure_coverage(active, n):
-            active = [i for i, s in enumerate(self._slots) if s is not None]
-            if not active:
-                return
-        # width-bucketed page-table upload (uploads are cheap/async)
-        widest = max(
-            (len(self._slots[i].pages) for i in active), default=1
-        )
-        w = min(pow2_cover(widest, lo=2), e.max_pages_per_seq)
-        pt_dev = jnp.asarray(self._pt_disp[:, :w])
-        # ring slot 0 holds the position decoded by this round's first step
-        ring_base_np = np.maximum(self._ctx_disp - 1, 0)
-        ring_base = jnp.asarray(ring_base_np)
         want_lp = any(
             self._slots[i] is not None
             and not self._slots[i].finished
             and self._slots[i].req.output_options.logprobs is not None
             for i in active
         )
-        # one fused program: n decode+sample steps + flush (see engine_round)
-        self.cache, self.ring, self._dev, stacked, lp_stacked = (
+        # plain-greedy rounds skip the full sampler (argmax only). A slot
+        # needs the sampler if it samples OR carries penalties — penalties
+        # apply to greedy decoding too, and the counts histogram must
+        # advance for them to be correct
+        def needs_sampler(i: int) -> bool:
+            r = self._slots[i]
+            if r is None or r.finished:
+                return False
+            so = r.req.sampling_options
+            return ((so.temperature or 0.0) > 0.0
+                    or (so.frequency_penalty or 0.0) != 0.0
+                    or (so.presence_penalty or 0.0) != 0.0
+                    or (so.repetition_penalty or 1.0) != 1.0)
+
+        want_sample = any(needs_sampler(i) for i in active)
+        # one fused program: n decode+sample steps + flush (engine_round)
+        self.ctx, self.ring, self._dev, stacked, lp_stacked = (
             self._engine_round(
-                self.params, self.cache, self.ring, self._dev, pt_dev,
-                ring_base, n, want_lp,
+                self.params, self.ctx, self.ring, self._dev, n,
+                want_lp, want_sample,
             )
         )
-        self._ctx_disp = np.minimum(self._ctx_disp + n, self._cap_disp)
+        self._ctx_disp = np.minimum(self._ctx_disp + n, e.max_context)
         self.step_count += n
         stacked.copy_to_host_async()
         if lp_stacked is not None:
@@ -711,60 +770,19 @@ class TpuEngine:
             )
         )
 
-    def _ensure_coverage(self, active: list[int], n_steps: int) -> bool:
-        """Make every active slot's page table cover the positions the next
-        n_steps will write; allocate/preempt as needed. Returns False if any
-        preemption happened (caller must recompute the active set)."""
-        e = self.ecfg
-        ps = e.page_size
-        clean = True
-        for slot in list(active):
-            r = self._slots[slot]
-            if r is None or r.finished:
-                continue  # finished slots garbage-write within their cap
-            # last position written in this round = ctx_disp - 1 + n_steps
-            need_pos = min(int(self._ctx_disp[slot]) - 1 + n_steps,
-                           e.max_context - 1)
-            need_pages = need_pos // ps + 1
-            while len(r.pages) < need_pages:
-                got = self.allocator.allocate(1)
-                if got is None:
-                    self._preempt_for_space(slot)
-                    clean = False
-                    if self._slots[slot] is None:
-                        break
-                    continue
-                r.pages.extend(got)
-                self._pt_disp[slot, len(r.pages) - 1] = got[0]
-            if self._slots[slot] is not None:
-                new_cap = min(len(r.pages) * ps, e.max_context)
-                if new_cap != self._cap_disp[slot]:
-                    self._cap_disp[slot] = new_cap
-                    self._grow_dirty.add(slot)
-        if self._grow_dirty:
-            self._dispatch_patch(grow_slots=sorted(self._grow_dirty))
-            self._grow_dirty.clear()
-        return clean
-
     def _dispatch_patch(
         self,
-        grow_slots: list[int] = (),
         clear_slots: list[int] = (),
         admit: Optional[dict[str, Any]] = None,
     ) -> None:
         B = self._B
         clear = np.zeros(B, bool)
-        grow = np.zeros(B, bool)
         for s in clear_slots:
             clear[s] = True
-        for s in grow_slots:
-            grow[s] = True
         a = admit or {}
         self._dev = self._patch(
             self._dev,
             jnp.asarray(clear),
-            jnp.asarray(grow),
-            jnp.asarray(self._cap_disp),
             jnp.int32(a.get("slot", B)),
             jnp.int32(a.get("ctx", 1)),
             a.get("tok", jnp.zeros(1, jnp.int32)),
@@ -775,6 +793,47 @@ class TpuEngine:
             jnp.float32(a.get("freq", 0.0)),
             jnp.float32(a.get("pres", 0.0)),
             jnp.float32(a.get("rep", 1.0)),
+        )
+
+    # ---- block sealing (ctx -> pool prefix-cache copies) ----
+
+    def _queue_seal(self, r: _Request, position: int,
+                    block_hash: int, parent_hash: int) -> None:
+        """Copy-commit one sealed block into the prefix cache. Best-effort:
+        a full pool (no free/evictable page) skips the commit — the prefix
+        cache is a cache, not required state."""
+        got = self.allocator.allocate(1)
+        if got is None:
+            return
+        page = got[0]
+        if not self.allocator.commit(page, block_hash, parent_hash):
+            self.allocator.free([page])  # duplicate hash: already cached
+            return
+        self._seal_queue.append((r.slot, position * self.ecfg.page_size, page))
+        # release our reference: the page parks in the LRU (prefix-hittable,
+        # offload-candidate) once the seal copy below is dispatched
+        self.allocator.free([page])
+
+    def _flush_seals(self) -> None:
+        """Dispatch the batched ctx->pool seal copy (pow2-padded; padding
+        rows target scratch page 0). Device order makes this safe: the
+        sealed positions were written by already-dispatched programs, and
+        any admission/offload/export that READS these pool pages is
+        dispatched after this."""
+        if not self._seal_queue:
+            return
+        batch = self._seal_queue
+        self._seal_queue = []
+        w = pow2_cover(len(batch))
+        slots = np.zeros(w, np.int32)
+        starts = np.zeros(w, np.int32)
+        pages = np.zeros(w, np.int32)  # padding -> scratch page 0
+        for i, (s, st, pg) in enumerate(batch):
+            slots[i], starts[i], pages[i] = s, st, pg
+        self.cache = llama.seal_blocks(
+            self.cache, self.ctx,
+            jnp.asarray(slots), jnp.asarray(starts), jnp.asarray(pages),
+            page_size=self.ecfg.page_size,
         )
 
     # ---- offload (G2 tier) ----
@@ -836,9 +895,7 @@ class TpuEngine:
         kept = []
         for r in self._waiting:
             if r.cancelled:
-                if r.pages:  # half-prefilled head: release its pages
-                    self.allocator.free(r.pages)
-                    r.pages = []
+                self._abort_prefill(r)
             else:
                 kept.append(r)
         self._waiting = kept
@@ -846,42 +903,76 @@ class TpuEngine:
         # chunk at a time with decode rounds in between (ITL isolation,
         # the local form of what disagg provides globally)
         budget = max(1, self.ecfg.prefill_chunks_per_round)
-        while budget > 0 and self._waiting and None in self._slots:
+        while budget > 0 and self._waiting:
             r = self._waiting[0]
+            if r.slot < 0 and self._free_slot() is None:
+                return  # no lane to prefill into
             status = self._prefill_step(r)
             budget -= 1
-            if status == "blocked":
-                return  # head-of-line blocks until pages free up
             if status in ("done", "failed"):
                 self._waiting.pop(0)
 
+    def _free_slot(self) -> Optional[int]:
+        for i, s in enumerate(self._slots):
+            if s is None and i not in self._prefilling:
+                return i
+        return None
+
+    def _abort_prefill(self, r: _Request) -> None:
+        """Release a half-prefilled request's lane reservation."""
+        if r.slot >= 0 and self._prefilling.get(r.slot) is r:
+            del self._prefilling[r.slot]
+        r.slot = -1
+        r.prefill_pos = -1
+
     def _prefill_step(self, r: _Request) -> str:
         """Advance one prefill chunk; on the final chunk, sample the first
-        token on device and assign a slot. Returns blocked | progress |
-        done | failed."""
+        token on device and activate the slot. Returns progress | done |
+        failed. Long prompts route through the sequence-parallel ring
+        prefill when the mesh has an sp axis (EngineConfig
+        sp_prefill_threshold)."""
         e = self.ecfg
         ps = e.page_size
         prompt = r.tokens
 
+        if (r.prefill_pos < 0
+                and e.sp_prefill_threshold is not None
+                and not (r.req.multimodal or {}).get("embeddings")
+                and self.mesh.shape.get("sp", 1) > 1):
+            # threshold applies to the UNCACHED suffix: a mostly-cached
+            # long prompt is cheaper on the chunked local path (which
+            # reuses the prefix) than on a full ring recompute
+            hashes = r.seq.block_hashes()
+            matchable = hashes[: max(0, (len(prompt) - 1) // ps)]
+            cached = self.allocator.cached_prefix_len(matchable)
+            if len(prompt) - cached * ps >= e.sp_prefill_threshold:
+                return self._sp_prefill_full(r)
+
         if r.prefill_pos < 0:
-            # start: prefix match (HBM, then host tier) + full allocation
+            # start: reserve a lane, then prefix match (HBM, then host
+            # tiers) and copy the matched run pool -> ctx. Seals queued by
+            # other requests must be flushed first — their pool pages are
+            # matchable but the copy may not be dispatched yet.
+            self._flush_seals()
+            slot = self._free_slot()
+            assert slot is not None, "caller checks slot availability"
+            r.slot = slot
+            self._prefilling[slot] = r
             hashes = r.seq.block_hashes()
             matchable = hashes[: max(0, (len(prompt) - 1) // ps)]
             matched_pages = self.allocator.match_prefix(matchable)
             matched_pages = self._onboard_from_host(matchable, matched_pages)
-            n_total_pages = (len(prompt) + ps - 1) // ps
-            if n_total_pages > e.max_pages_per_seq:
-                self.allocator.free(matched_pages)
-                r.emit(ValueError("prompt does not fit page table"))
-                return "failed"
-            fresh = self.allocator.allocate(
-                n_total_pages - len(matched_pages)
-            )
-            if fresh is None:
-                self.allocator.free(matched_pages)
-                return "blocked"
-            r.pages = matched_pages + fresh
             r.matched_blocks = len(matched_pages)
+            if matched_pages:
+                w = pow2_cover(len(matched_pages))
+                padded = np.zeros(w, np.int32)  # padding -> scratch page 0
+                padded[: len(matched_pages)] = matched_pages
+                self.ctx = llama.load_ctx_pages(
+                    self.ctx, self.cache, jnp.int32(slot),
+                    jnp.asarray(padded),
+                )
+                # copy dispatched — device order lets us drop the refs now
+                self.allocator.free(matched_pages)
             r.prefill_pos = len(matched_pages) * ps
 
         # one page-aligned continuation chunk (q_start advances); only the
@@ -893,26 +984,77 @@ class TpuEngine:
         pad_t = ((pad_t + ps - 1) // ps) * ps
         toks = np.zeros(pad_t, np.int32)
         toks[: len(chunk)] = chunk
-        # width-bucketed table (pow2 cover of pages in play); one
-        # compile per (bucket, width) pair
-        w = min(pow2_cover(start // ps + pad_t // ps, lo=2),
-                e.max_pages_per_seq)
-        table = np.zeros(w, np.int32)
-        table[: len(r.pages)] = r.pages[:w]
-        self.cache, logits = llama.prefill(
-            self.config, self.params, self.cache,
-            jnp.asarray(toks), jnp.asarray(table),
+        embeds = embeds_mask = None
+        mm = r.req.multimodal or {}
+        if mm.get("embeddings"):
+            # override rows for image-token positions in this chunk
+            # (vision-encoder outputs injected in place of the token
+            # embedding — reference examples/multimodal E/P/D flow)
+            ov = np.zeros((pad_t, self.config.hidden_size), np.float32)
+            msk = np.zeros(pad_t, bool)
+            for ent in mm["embeddings"]:
+                data = np.asarray(ent["data"], np.float32)
+                p0 = int(ent["pos"])
+                lo = max(p0, start)
+                hi = min(p0 + len(data), start + len(chunk))
+                if lo < hi:
+                    ov[lo - start: hi - start] = data[lo - p0: hi - p0]
+                    msk[lo - start: hi - start] = True
+            if msk.any():
+                embeds = jnp.asarray(ov)
+                embeds_mask = jnp.asarray(msk)
+        self.ctx, logits = llama.prefill(
+            self.config, self.params, self.ctx,
+            jnp.asarray(toks), jnp.int32(r.slot),
             jnp.int32(start), jnp.int32(start + len(chunk)),
+            embeds, embeds_mask,
         )
         r.prefill_pos = start + len(chunk)
         if r.prefill_pos < len(prompt):
             return "progress"  # decode rounds run before the next chunk
 
-        # final chunk: commit complete prompt blocks beyond the match
+        return self._finish_prefill(r, logits)
+
+    def _sp_prefill_full(self, r: _Request) -> str:
+        """Whole-prompt sequence-parallel ring prefill (ops/
+        ring_attention.py): ONE pass with the prompt sharded over the sp
+        mesh axis — per-device KV is O(T/sp), KV blocks rotate over ICI.
+        The computed span enters the slot's ctx region via write_ctx_span;
+        block sealing/commit then proceeds exactly like local prefill.
+        (Recomputes the full prompt — no prefix-match integration; the sp
+        path exists for prompts too long to prefill locally at all.)"""
+        from dynamo_tpu.ops.ring_attention import sp_shard
+
+        e = self.ecfg
+        prompt = r.tokens
+        self._flush_seals()
+        slot = self._free_slot()
+        assert slot is not None, "caller checks slot availability"
+        r.slot = slot
+        self._prefilling[slot] = r
+        sp_n = self.mesh.shape["sp"]
+        pad = -len(prompt) % sp_n
+        toks = np.zeros(len(prompt) + pad, np.int32)
+        toks[: len(prompt)] = prompt
+        kv, logits = llama.sp_prefill(
+            self.config, self.params,
+            sp_shard(jnp.asarray(toks), self.mesh),
+            jnp.int32(len(prompt)), self.mesh,
+        )
+        self.ctx = llama.write_ctx_span(self.ctx, jnp.int32(slot), kv)
+        r.prefill_pos = len(prompt)
+        r.matched_blocks = 0
+        self.sp_prefills += 1
+        return self._finish_prefill(r, logits)
+
+    def _finish_prefill(self, r: _Request, logits) -> str:
+        """Shared prefill tail: commit prompt blocks, sample the first
+        token on device, activate the slot."""
+        prompt = r.tokens
+        # copy-commit complete prompt blocks beyond the match into the
+        # prefix cache
         for blk in r.seq.blocks[r.matched_blocks:]:
-            self.allocator.commit(
-                r.pages[blk.position], blk.block_hash, blk.parent_hash
-            )
+            self._queue_seal(r, blk.position, blk.block_hash, blk.parent_hash)
 
         so = r.req.sampling_options
         if so.seed is not None:
@@ -939,15 +1081,11 @@ class TpuEngine:
             want_lp,
         )
 
-        slot = self._slots.index(None)
-        r.slot = slot
+        slot = r.slot
+        del self._prefilling[slot]
         self._slots[slot] = r
-        self._pt_disp[slot] = 0
-        self._pt_disp[slot, : len(r.pages)] = r.pages
         self._ctx_disp[slot] = len(prompt) + 1
-        self._cap_disp[slot] = min(len(r.pages) * ps, e.max_context)
         self._dispatch_patch(
-            grow_slots=[slot],
             admit=dict(
                 slot=slot,
                 ctx=len(prompt) + 1,
@@ -1024,50 +1162,80 @@ class TpuEngine:
             self._finish(r, FinishReason.LENGTH, emit_empty=True)
 
     def _process_round(self, entry: _Entry, toks: np.ndarray) -> None:
+        """Consume one round's stacked tokens. Emission is BATCHED per
+        request per round (tokens of a round arrive together in one fetch;
+        per-token emits through the asyncio machinery are pure host
+        overhead — on a 1-core box they, not the device, capped
+        throughput)."""
         lp_arrs = None
         if entry.lp_handle is not None:
             lp_arrs = tuple(np.asarray(a) for a in entry.lp_handle)
-        for step in range(entry.n_steps):
-            for slot, r in enumerate(entry.slots):
-                # identity check doubles as the epoch: a recycled slot holds
-                # a different _Request object than the snapshot
-                if r is None or r.finished or self._slots[slot] is not r:
-                    continue
-                if r.cancelled:
-                    self._finish(r, None)
-                    continue
-                lp = None
-                if lp_arrs is not None:
-                    lp = (float(lp_arrs[0][step, slot]),
-                          lp_arrs[1][step, slot], lp_arrs[2][step, slot])
-                self._consume_token(r, int(toks[step, slot]), lp)
+        for slot, r in enumerate(entry.slots):
+            # identity check doubles as the epoch: a recycled slot holds
+            # a different _Request object than the snapshot
+            if r is None or r.finished or self._slots[slot] is not r:
+                continue
+            if r.cancelled:
+                self._finish(r, None)
+                continue
+            batch: list[int] = []
+            lp_chosen: list[float] = []
+            lp_top: list[list] = []
+            n_lp = r.req.output_options.logprobs
+            finish: Optional[FinishReason] = None
+            for step in range(entry.n_steps):
+                tok = int(toks[step, slot])
+                finish = self._advance_token(r, tok)
+                if finish is FinishReason.EOS:
+                    break  # stop token itself is not emitted
+                batch.append(tok)
+                if lp_arrs is not None and n_lp is not None:
+                    k = min(int(n_lp), self.ecfg.max_logprobs)
+                    lp_chosen.append(float(lp_arrs[0][step, slot]))
+                    lp_top.append(
+                        [[int(i), float(v)] for i, v in zip(
+                            lp_arrs[1][step, slot][:k],
+                            lp_arrs[2][step, slot][:k])]
+                    )
+                if finish is not None:
+                    break
+            if batch or finish is not None:
+                extra = {}
+                if lp_chosen:
+                    extra = {"log_probs": lp_chosen, "top_logprobs": lp_top}
+                r.emit(LLMEngineOutput(
+                    token_ids=batch, finish_reason=finish, **extra
+                ))
+            if finish is not None:
+                self._finish(r, None)
         self.tokens_generated += int(
             sum(1 for s in entry.slots if s is not None) * entry.n_steps
         )
 
-    def _consume_token(self, r: _Request, tok: int, lp=None) -> None:
+    def _advance_token(
+        self, r: _Request, tok: int
+    ) -> Optional[FinishReason]:
+        """Per-token state advance (sealing, stop detection, budget).
+        Returns the finish reason when this token ENDS the request (EOS:
+        token not emitted; LENGTH: token emitted as the last one)."""
         sc = r.req.stop_conditions
-        # seal/commit the block completed by the previous token
+        # copy-commit the block completed by the previous token into the
+        # prefix cache (device order: those positions were written by
+        # already-dispatched steps)
         if r.last_token >= 0:
             for blk in r.seq.extend([r.last_token]):
-                if blk.position < len(r.pages):
-                    self.allocator.commit(
-                        r.pages[blk.position], blk.block_hash, blk.parent_hash
-                    )
+                self._queue_seal(
+                    r, blk.position, blk.block_hash, blk.parent_hash
+                )
         if not sc.ignore_eos and tok in (sc.stop_token_ids or []) and (
             sc.min_tokens is None or r.produced >= sc.min_tokens
         ):
-            self._finish(r, FinishReason.EOS, emit_empty=True)
-            return
+            return FinishReason.EOS
         r.last_token = tok
         r.produced += 1
         if r.produced >= r.max_new_tokens(self.ecfg.max_context):
-            r.emit(LLMEngineOutput(token_ids=[tok],
-                                   finish_reason=FinishReason.LENGTH,
-                                   **self._lp_payload(r, lp)))
-            self._finish(r, None)
-            return
-        r.emit(LLMEngineOutput(token_ids=[tok], **self._lp_payload(r, lp)))
+            return FinishReason.LENGTH
+        return None
 
     def _finish(
         self,
@@ -1075,9 +1243,9 @@ class TpuEngine:
         reason: Optional[FinishReason],
         emit_empty: bool = False,
     ) -> None:
-        """Mark finished on host; slot is reclaimed via a release patch at
-        the next round boundary. The final (possibly just-sealed) block is
-        NOT committed — in-flight garbage steps may still write its page."""
+        """Mark finished on host; the slot is reclaimed via a release patch
+        at the next round boundary (in-flight garbage steps are redirected
+        to the scratch lane by the patch's dest update)."""
         if r.finished:
             return
         r.finished = True
@@ -1095,76 +1263,26 @@ class TpuEngine:
             return
         clear_slots = []
         for r in self._to_release:
-            self.allocator.free(r.pages)
-            r.pages = []
             if r.slot >= 0 and self._slots[r.slot] is r:
                 clear_slots.append(r.slot)
                 self._slots[r.slot] = None
-                self._pt_disp[r.slot] = 0
                 self._ctx_disp[r.slot] = 1
-                self._cap_disp[r.slot] = self.ecfg.page_size
             r.slot = -1
         self._to_release = []
         if clear_slots:
             self._dispatch_patch(clear_slots=clear_slots)
-
-    # ---- preemption ----
-
-    def _preempt_for_space(self, needing_slot: int) -> None:
-        """Free pages by preempting the most recently admitted other request
-        (LIFO keeps older requests progressing); preempts `needing_slot`
-        itself only when it is the sole occupant."""
-        victims = [
-            s for s in self._slots
-            if s is not None and not s.finished and s.slot != needing_slot
-        ]
-        victim = max(victims, key=lambda r: r.enqueue_time) if victims else (
-            self._slots[needing_slot]
-        )
-        if victim is None:
-            return
-        slot = victim.slot
-        self.allocator.free(victim.pages)
-        victim.pages = []
-        # restart = everything processed so far + pending token as new prompt
-        new_prompt = victim.seq.tokens + (
-            [victim.last_token] if victim.last_token >= 0 else []
-        )
-        victim.tokens = new_prompt
-        victim.seq = TokenBlockSequence.from_tokens(
-            new_prompt, self.ecfg.page_size, salt=victim.req.model
-        )
-        victim.last_token = -1
-        victim.matched_blocks = 0
-        victim.prefill_pos = -1  # restart prefill from scratch
-        self._slots[slot] = None
-        self._pt_disp[slot] = 0
-        self._ctx_disp[slot] = 1
-        self._cap_disp[slot] = self.ecfg.page_size
-        victim.slot = -1
-        self._dispatch_patch(clear_slots=[slot])
-        # never jump AHEAD of a half-prefilled head: it already holds its
-        # full page allocation and only needs budget (and the slot this
-        # preemption just freed) to finish — queueing the victim in front
-        # would deadlock (victim can't allocate, head can't reach budget)
-        pos = 1 if (self._waiting
-                    and self._waiting[0].prefill_pos >= 0) else 0
-        self._waiting.insert(pos, victim)
-        log.info("preempted request %s", victim.req.request_id)
 
     def _fail_all(self, err: Exception) -> None:
         for r in list(self._slots):
             if r is not None:
                 r.emit(err)
                 r.finished = True
-                self.allocator.free(r.pages)
-                r.pages = []
         self._slots = [None] * self._B
         for r in self._waiting:
             r.emit(err)
-            if r.pages:  # half-prefilled head holds pages
-                self.allocator.free(r.pages)
-                r.pages = []
+            self._abort_prefill(r)
         self._waiting = []
+        self._prefilling = {}
         self._entries = []
+        self._seal_queue = []
 

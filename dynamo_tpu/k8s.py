@@ -1,0 +1,310 @@
+"""Kubernetes integration: planner connector + manifest generation.
+
+Parity targets:
+  - ``KubernetesConnector`` (reference components/planner/src/dynamo/
+    planner/kubernetes_connector.py:79 + utils/kube.py:164): the planner's
+    scale actuator. The reference patches its DynamoComponentDeployment
+    CRD and lets the operator reconcile; without an operator we patch the
+    worker Deployment's ``scale`` subresource directly — same control
+    loop, one hop shorter.
+  - ``emit_k8s_manifests`` (reference deploy/cloud/operator CRDs +
+    helm): renders a serve graph (launch/serve.py format) into plain
+    Deployments/Services so ``dynamo-tpu serve --emit-k8s`` gives a
+    kubectl-appliable deployment without the Go operator.
+
+No kubernetes client library is baked into this image; the connector
+speaks the API server's REST surface over aiohttp using in-cluster
+defaults (service-account token + CA) or explicit parameters.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+from typing import Any, Optional
+
+log = logging.getLogger(__name__)
+
+SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+
+
+class KubernetesConnector:
+    """Planner Connector realizing replica counts via the Deployment
+    scale subresource. ``current_replicas`` returns the last observed
+    value (refreshed on start() and after every patch) — the planner is
+    the only writer, so staleness is bounded by its own actions."""
+
+    def __init__(
+        self,
+        deployment: str,
+        namespace: str = "default",
+        *,
+        api_base: Optional[str] = None,
+        token: Optional[str] = None,
+        verify_ssl: bool = True,
+    ):
+        self.deployment = deployment
+        self.namespace = namespace
+        if api_base is None:
+            host = os.environ.get("KUBERNETES_SERVICE_HOST")
+            port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+            if not host:
+                raise ValueError(
+                    "no api_base and not in-cluster "
+                    "(KUBERNETES_SERVICE_HOST unset)"
+                )
+            api_base = f"https://{host}:{port}"
+        self.api_base = api_base.rstrip("/")
+        if token is None:
+            token_path = os.path.join(SA_DIR, "token")
+            if os.path.exists(token_path):
+                with open(token_path, encoding="utf-8") as f:
+                    token = f.read().strip()
+        self.token = token
+        self.verify_ssl = verify_ssl
+        self._replicas = 0
+        self._session = None
+
+    @property
+    def _scale_url(self) -> str:
+        return (
+            f"{self.api_base}/apis/apps/v1/namespaces/{self.namespace}"
+            f"/deployments/{self.deployment}/scale"
+        )
+
+    def _headers(self, content_type: Optional[str] = None) -> dict[str, str]:
+        h = {"Accept": "application/json"}
+        if self.token:
+            h["Authorization"] = f"Bearer {self.token}"
+        if content_type:
+            h["Content-Type"] = content_type
+        return h
+
+    async def _ensure_session(self):
+        if self._session is None:
+            import ssl as ssl_mod
+
+            import aiohttp
+
+            if not self.verify_ssl:
+                ssl_arg: Any = False
+            else:
+                # in-cluster: the API server's cert is signed by the
+                # cluster CA, not anything in the system trust store
+                ca_path = os.path.join(SA_DIR, "ca.crt")
+                ssl_arg = (
+                    ssl_mod.create_default_context(cafile=ca_path)
+                    if os.path.exists(ca_path) else None
+                )
+            connector = aiohttp.TCPConnector(ssl=ssl_arg)
+            self._session = aiohttp.ClientSession(connector=connector)
+        return self._session
+
+    async def start(self) -> "KubernetesConnector":
+        await self.refresh()
+        return self
+
+    async def close(self) -> None:
+        if self._session is not None:
+            await self._session.close()
+            self._session = None
+
+    async def refresh(self) -> int:
+        """GET the scale subresource; updates and returns the replica
+        count."""
+        session = await self._ensure_session()
+        async with session.get(
+            self._scale_url, headers=self._headers()
+        ) as resp:
+            body = await resp.json()
+            if resp.status != 200:
+                raise RuntimeError(
+                    f"scale GET {resp.status}: {body.get('message', body)}"
+                )
+        self._replicas = int(body.get("spec", {}).get("replicas", 0))
+        return self._replicas
+
+    # ---- planner Connector protocol ----
+
+    def current_replicas(self) -> int:
+        return self._replicas
+
+    async def set_replicas(self, n: int) -> None:
+        session = await self._ensure_session()
+        patch = json.dumps({"spec": {"replicas": int(n)}})
+        async with session.patch(
+            self._scale_url,
+            data=patch,
+            headers=self._headers("application/merge-patch+json"),
+        ) as resp:
+            body = await resp.json()
+            if resp.status != 200:
+                raise RuntimeError(
+                    f"scale PATCH {resp.status}: {body.get('message', body)}"
+                )
+        self._replicas = int(body.get("spec", {}).get("replicas", n))
+        log.info(
+            "k8s: %s/%s scaled to %d",
+            self.namespace, self.deployment, self._replicas,
+        )
+
+
+# ---------------------------------------------------------------------------
+# manifest generation
+
+
+def _meta(name: str, namespace: str, component: str) -> dict[str, Any]:
+    return {
+        "name": name,
+        "namespace": namespace,
+        "labels": {
+            "app.kubernetes.io/part-of": "dynamo-tpu",
+            "app.kubernetes.io/component": component,
+            "app": name,
+        },
+    }
+
+
+def _deployment(
+    name: str,
+    namespace: str,
+    component: str,
+    image: str,
+    args: list[str],
+    *,
+    replicas: int = 1,
+    ports: Optional[list[int]] = None,
+    env: Optional[dict[str, str]] = None,
+    tpu_chips: int = 0,
+) -> dict[str, Any]:
+    container: dict[str, Any] = {
+        "name": name,
+        "image": image,
+        "args": args,
+    }
+    if ports:
+        container["ports"] = [{"containerPort": p} for p in ports]
+    if env:
+        container["env"] = [
+            {"name": k, "value": v} for k, v in sorted(env.items())
+        ]
+    if tpu_chips:
+        container["resources"] = {
+            "limits": {"google.com/tpu": tpu_chips},
+        }
+    spec: dict[str, Any] = {
+        "replicas": replicas,
+        "selector": {"matchLabels": {"app": name}},
+        "template": {
+            "metadata": {"labels": {"app": name}},
+            "spec": {"containers": [container]},
+        },
+    }
+    return {
+        "apiVersion": "apps/v1",
+        "kind": "Deployment",
+        "metadata": _meta(name, namespace, component),
+        "spec": spec,
+    }
+
+
+def _service(
+    name: str, namespace: str, component: str, port: int
+) -> dict[str, Any]:
+    return {
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": _meta(name, namespace, component),
+        "spec": {
+            "selector": {"app": name},
+            "ports": [{"port": port, "targetPort": port}],
+        },
+    }
+
+
+def emit_k8s_manifests(
+    graph: dict[str, Any],
+    *,
+    image: str = "dynamo-tpu:latest",
+    k8s_namespace: str = "default",
+) -> list[dict[str, Any]]:
+    """Render a serve graph (launch/serve.py format) into Deployments and
+    Services: control-plane store, frontend, one Deployment per worker
+    fleet (its `replicas` is what the planner's KubernetesConnector
+    patches), and optionally the planner itself."""
+    ns = graph.get("namespace", "dynamo")
+    cp = graph.get("control_plane", {}) or {}
+    cp_port = int(cp.get("port", 7111))
+    cp_external = cp.get("external")
+    out: list[dict[str, Any]] = []
+
+    if cp_external:
+        cp_addr = cp_external
+    else:
+        store_name = f"{ns}-store"
+        out.append(_deployment(
+            store_name, k8s_namespace, "control-plane", image,
+            ["cp", "--host", "0.0.0.0", "--port", str(cp_port)],
+        ))
+        out.append(_service(store_name, k8s_namespace, "control-plane",
+                            cp_port))
+        cp_addr = f"{store_name}:{cp_port}"
+
+    fe = graph.get("frontend", {}) or {}
+    http_port = int(fe.get("http_port", 8080))
+    fe_name = f"{ns}-frontend"
+    out.append(_deployment(
+        fe_name, k8s_namespace, "frontend", image,
+        ["run", "in=http", "--control-plane", cp_addr,
+         "--namespace", ns, "--http-port", str(http_port)]
+        + [str(a) for a in fe.get("args", []) or []],
+        ports=[http_port],
+    ))
+    out.append(_service(fe_name, k8s_namespace, "frontend", http_port))
+
+    for spec in graph.get("workers", []) or []:
+        name = spec.get("name", "worker")
+        w_name = f"{ns}-{name}"
+        args = [str(a) for a in spec.get("args", []) or []]
+        out.append(_deployment(
+            w_name, k8s_namespace, "worker", image,
+            ["run", "in=endpoint", "--control-plane", cp_addr,
+             "--namespace", ns] + args,
+            replicas=int(spec.get("replicas", 1)),
+            tpu_chips=int(spec.get("tpu_chips", 0)),
+        ))
+
+    planner = graph.get("planner")
+    if planner:
+        p_name = f"{ns}-planner"
+        # the planner patches the (first, or `scales`-named) worker
+        # Deployment's replicas through the k8s API
+        target = planner.get("scales") or (
+            graph["workers"][0]["name"] if graph.get("workers") else None
+        )
+        p_args = ["planner", "--control-plane", cp_addr,
+                  "--namespace", ns]
+        if target:
+            p_args += ["--connector", "kubernetes",
+                       "--k8s-deployment", f"{ns}-{target}",
+                       "--k8s-namespace", k8s_namespace]
+        for k in ("min_replicas", "max_replicas", "adjustment_interval",
+                  "predictor"):
+            if k in planner:
+                p_args += [f"--{k.replace('_', '-')}", str(planner[k])]
+        out.append(_deployment(
+            p_name, k8s_namespace, "planner", image, p_args,
+        ))
+    return out
+
+
+def render_manifests(manifests: list[dict[str, Any]]) -> str:
+    """YAML multi-doc when pyyaml is importable, JSON lines otherwise."""
+    try:
+        import yaml
+
+        return "---\n".join(
+            yaml.safe_dump(m, sort_keys=False) for m in manifests
+        )
+    except ImportError:
+        return "\n".join(json.dumps(m, indent=1) for m in manifests)

@@ -34,10 +34,19 @@ class ModelConfig:
     tie_word_embeddings: bool = False
     model_type: str = "llama"
     dtype: str = "bfloat16"
+    # Mixture-of-Experts FFN (hashable, like rope_scaling): tuple of sorted
+    # (key, value) pairs with keys num_experts / top_k / capacity_factor.
+    # None = dense MLP. Experts shard over the `ep` mesh axis, expert
+    # hidden dim over `tp` (the sglang wide-EP shape, SURVEY §2.5).
+    moe: Optional[tuple[tuple[str, Any], ...]] = None
 
     @property
     def rope_scaling_dict(self) -> Optional[dict[str, Any]]:
         return dict(self.rope_scaling) if self.rope_scaling else None
+
+    @property
+    def moe_dict(self) -> Optional[dict[str, Any]]:
+        return dict(self.moe) if self.moe else None
 
     @property
     def q_dim(self) -> int:
@@ -94,6 +103,19 @@ class ModelConfig:
         )
         base.update(kw)
         return cls(**base)
+
+    @classmethod
+    def tiny_moe(cls, **kw) -> "ModelConfig":
+        """Toy MoE model (8 experts, top-2, dropless) for CPU tests / the
+        dryrun — the served stand-in for the reference's wide-EP DeepSeek
+        shape. capacity_factor 0 = dropless (see moe.MoEConfig.capacity:
+        capacity drops break prefix-cache reproducibility)."""
+        base = dict(
+            moe=(("capacity_factor", 0.0), ("num_experts", 8),
+                 ("top_k", 2)),
+        )
+        base.update(kw)
+        return cls.tiny(**base)
 
     @classmethod
     def llama3_1b(cls) -> "ModelConfig":

@@ -1,24 +1,28 @@
 """Llama-family forward pass (Llama-2/3/3.x, DeepSeek-R1-Distill-Llama).
 
-Design notes (TPU-first):
+Design notes (TPU-first, round-4 layout):
   - Parameters are a pytree whose per-layer leaves are STACKED on a leading
-    layer axis and the decoder runs as one ``lax.scan`` — one compiled layer
-    body regardless of depth (compile time stays flat from 4 to 80 layers).
-  - The KV cache is a paged pool per layer: ``[L, kv_heads, num_pages,
-    page_size, head_dim]`` (head-leading so one (head, page) block is a
-    clean TPU tile and the kv_heads axis shards over ``tp``); requests
-    address it through page tables. Page 0
-    is a reserved scratch page: page-table entries BEYOND a request's
-    allocated pages point at it, so whole-page padding writes and inactive
-    decode slots never corrupt real pages. Padding tokens within a
-    request's own tail page DO write garbage KV into that page's tail slots
-    — they are never valid context (masked by seq_len/ctx_len, and decode
-    overwrites them in order), but attention kernels MUST keep the validity
-    mask and the prefix cache must only ever share complete pages.
-  - Tensor parallelism is pure GSPMD: `param_shardings`/`cache_shardings`
-    put head/hidden dims on the ``tp`` mesh axis; XLA inserts the ICI
-    collectives. No hand-written comm (contrast: reference engines use NCCL
-    inside vLLM — SURVEY.md §2.5).
+    layer axis; the decoder is an unrolled python loop with static layer
+    indices (XLA's aliasing keeps donated KV updates in place, which a
+    lax.scan carry defeats).
+  - SERVING CONTEXT is contiguous per slot: ``ctx_kv [L, kv_heads, B+1,
+    S_max, head_dim]`` — slot b's tokens live at [.., b, 0:ctx). Decode
+    scatters one row per slot per step and attention streams dense slabs
+    (ops/flash_decode.py); prefill writes a contiguous span. Lane B is a
+    SCRATCH lane: freed slots' in-flight garbage steps are redirected
+    there (``dest`` argument), so a slot being prefilled for a new request
+    is never corrupted by a stale pipelined step.
+  - The PAGED POOL ``[L, kv_heads, num_pages, page_size, head_dim]`` is
+    prefix-cache STORAGE only: sealed blocks are copied ctx->pool
+    (seal_blocks) and prefix hits are copied pool->ctx at admission
+    (load_ctx_pages). Paging is thereby removed from the per-step hot path
+    entirely — the round-3 paged decode kernel spent 15.9 ms/step on
+    page-grid overhead. Page 0 stays reserved as scratch for padded
+    pool I/O (gather/scatter/seal padding).
+  - Tensor parallelism is pure GSPMD: `param_shardings`/`cache_shardings`/
+    `ctx_shardings` put head/hidden dims on the ``tp`` mesh axis; XLA
+    inserts the ICI collectives. No hand-written comm (contrast: reference
+    engines use NCCL inside vLLM — SURVEY.md §2.5).
   - Prefill is B=1 over a padded token bucket (positions q_start..q_start+T);
     decode is a fixed-slot batch, one token per slot. Both are jittable with
     static shapes; the engine buckets prompt lengths to bound recompiles.
@@ -37,7 +41,10 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from dynamo_tpu.models.config import ModelConfig
-from dynamo_tpu.ops.attention import paged_decode_attention, prefill_attention
+from dynamo_tpu.ops.attention import (
+    ctx_decode_attention,
+    ctx_prefill_attention,
+)
 from dynamo_tpu.ops.rope import apply_rope, rope_cos_sin, rope_inv_freq
 
 Params = dict[str, Any]
@@ -61,19 +68,31 @@ def init_params(config: ModelConfig, rng: jax.Array | int = 0) -> Params:
         return (jax.random.normal(key, shape, jnp.float32) * scale).astype(dtype)
 
     L, H, I, V = c.num_layers, c.hidden_size, c.intermediate_size, c.vocab_size
+    layers: dict[str, jnp.ndarray] = {
+        "ln1": jnp.ones((L, H), dtype),
+        "ln2": jnp.ones((L, H), dtype),
+        "wq": rnd(keys[1], L, H, c.q_dim),
+        "wk": rnd(keys[2], L, H, c.kv_dim),
+        "wv": rnd(keys[3], L, H, c.kv_dim),
+        "wo": rnd(keys[4], L, c.q_dim, H),
+    }
+    if c.moe is not None:
+        E = c.moe_dict["num_experts"]
+        layers.update(
+            wr=rnd(keys[5], L, H, E),
+            we_g=rnd(keys[6], L, E, H, I),
+            we_u=rnd(keys[7], L, E, H, I),
+            we_d=rnd(keys[9], L, E, I, H),
+        )
+    else:
+        layers.update(
+            wg=rnd(keys[5], L, H, I),
+            wu=rnd(keys[6], L, H, I),
+            wd=rnd(keys[7], L, I, H),
+        )
     params: Params = {
         "embed": rnd(keys[0], V, H, scale=0.02),
-        "layers": {
-            "ln1": jnp.ones((L, H), dtype),
-            "ln2": jnp.ones((L, H), dtype),
-            "wq": rnd(keys[1], L, H, c.q_dim),
-            "wk": rnd(keys[2], L, H, c.kv_dim),
-            "wv": rnd(keys[3], L, H, c.kv_dim),
-            "wo": rnd(keys[4], L, c.q_dim, H),
-            "wg": rnd(keys[5], L, H, I),
-            "wu": rnd(keys[6], L, H, I),
-            "wd": rnd(keys[7], L, I, H),
-        },
+        "layers": layers,
         "norm_f": jnp.ones((H,), dtype),
     }
     if not c.tie_word_embeddings:
@@ -88,19 +107,31 @@ def param_shardings(config: ModelConfig, mesh: Mesh) -> Params:
     def ns(*spec):
         return NamedSharding(mesh, P(*spec))
 
+    layers: Params = {
+        "ln1": ns(None, None),
+        "ln2": ns(None, None),
+        "wq": ns(None, None, "tp"),
+        "wk": ns(None, None, "tp"),
+        "wv": ns(None, None, "tp"),
+        "wo": ns(None, "tp", None),
+    }
+    if config.moe is not None:
+        # experts over ep, expert hidden over tp (wide-EP shape §2.5)
+        layers.update(
+            wr=ns(None, None, None),
+            we_g=ns(None, "ep", None, "tp"),
+            we_u=ns(None, "ep", None, "tp"),
+            we_d=ns(None, "ep", "tp", None),
+        )
+    else:
+        layers.update(
+            wg=ns(None, None, "tp"),
+            wu=ns(None, None, "tp"),
+            wd=ns(None, "tp", None),
+        )
     out: Params = {
         "embed": ns("tp", None),
-        "layers": {
-            "ln1": ns(None, None),
-            "ln2": ns(None, None),
-            "wq": ns(None, None, "tp"),
-            "wk": ns(None, None, "tp"),
-            "wv": ns(None, None, "tp"),
-            "wo": ns(None, "tp", None),
-            "wg": ns(None, None, "tp"),
-            "wu": ns(None, None, "tp"),
-            "wd": ns(None, "tp", None),
-        },
+        "layers": layers,
         "norm_f": ns(None),
     }
     if not config.tie_word_embeddings:
@@ -114,7 +145,8 @@ def param_shardings(config: ModelConfig, mesh: Mesh) -> Params:
 def init_cache(
     config: ModelConfig, num_pages: int, page_size: int, dtype=None
 ) -> Cache:
-    """Paged KV pool. Page 0 is the reserved scratch page (see module doc)."""
+    """Paged KV pool — prefix-cache STORAGE (see module doc). Page 0 is the
+    reserved scratch page for padded pool I/O."""
     c = config
     dtype = dtype or jnp.dtype(c.dtype)
     shape = (c.num_layers, c.num_kv_heads, num_pages, page_size, c.head_dim)
@@ -126,19 +158,35 @@ def cache_shardings(config: ModelConfig, mesh: Mesh) -> Cache:
     return {"k": s, "v": s}
 
 
+def init_ctx(
+    config: ModelConfig, batch: int, ctx_len: int, dtype=None
+) -> Cache:
+    """Contiguous per-slot serving context ``[L, kvh, batch+1, S, hd]``.
+    Lane `batch` is the scratch lane for freed slots' in-flight garbage
+    steps (see module doc / engine dest redirection)."""
+    c = config
+    dtype = dtype or jnp.dtype(c.dtype)
+    shape = (c.num_layers, c.num_kv_heads, batch + 1, ctx_len, c.head_dim)
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+
+def ctx_shardings(config: ModelConfig, mesh: Mesh) -> Cache:
+    s = NamedSharding(mesh, P(None, "tp", None, None, None))
+    return {"k": s, "v": s}
+
+
 def init_ring(
     config: ModelConfig, batch: int, ring_len: int, dtype=None
 ) -> Cache:
     """Per-slot decode write ring ``[L, kv_heads, B, R, head_dim]``.
 
-    Decode steps write their token's KV here (a cheap dynamic-update-slice)
-    instead of scattering into the page pool; `flush` batch-scatters a full
-    ring into the pool once per R steps. This keeps the multi-GB pool out
-    of the per-step program entirely (it is read-only between flushes) —
-    per-step scatter into the pool costs a full pool materialization on
-    backends without in-place buffer aliasing, and a slow scatter even with
-    it. Ring slot r of batch lane b holds the token at position
-    ``ring_base[b] + r``.
+    Decode steps write their token's KV here (a cheap small-buffer
+    update); ``flush_ctx`` scatters a full ring into the ctx region once
+    per round. This keeps the GB-scale ctx region READ-ONLY inside the
+    round program — per-layer writes interleaved with the attention
+    custom calls force XLA to materialize full copies of it (measured:
+    ~7 GB temps, 120 ms/step). Ring slot r of lane b holds the token at
+    position ``ring_base[b] + r``.
     """
     c = config
     dtype = dtype or jnp.dtype(c.dtype)
@@ -164,7 +212,67 @@ def _mlp(h, wg, wu, wd):
     return (jax.nn.silu(h @ wg) * (h @ wu)) @ wd
 
 
-def _layer_body(c: ModelConfig, lp, h, cos, sin, write_kv, attend):
+def _moe_ffn(c: ModelConfig, lp, x: jnp.ndarray,
+             valid=None) -> jnp.ndarray:
+    """GShard-style dense-dispatch MoE FFN ``[T, H] -> [T, H]``.
+
+    Pure einsums with a static per-expert capacity — jittable with static
+    shapes and GSPMD-shardable: experts shard over `ep`, the expert hidden
+    dim over `tp`; XLA inserts the all_to_alls over ICI (idiomatic TPU
+    replacement for the reference's DeepEP dispatch, SURVEY §2.5 EP row).
+    Tokens beyond an expert's capacity are dropped (standard GShard
+    semantics); top-k gate weights are renormalized. `valid` [T] masks
+    tokens OUT of routing entirely — padding / garbage decode lanes must
+    not steal expert capacity from live tokens (and masking makes output
+    independent of the co-batched garbage, keeping decode bit-exact
+    regardless of slot occupancy)."""
+    from dynamo_tpu.models.moe import MoEConfig
+
+    md = c.moe_dict
+    mcfg = MoEConfig(
+        hidden_size=c.hidden_size,
+        intermediate_size=c.intermediate_size,
+        num_experts=md["num_experts"],
+        top_k=md.get("top_k", 2),
+        capacity_factor=md.get("capacity_factor", 1.25),
+    )
+    T = x.shape[0]
+    E, K = mcfg.num_experts, mcfg.top_k
+    C = mcfg.capacity(T)
+
+    logits = jnp.matmul(x, lp["wr"], preferred_element_type=jnp.float32)
+    gates = jax.nn.softmax(logits, axis=-1)            # [T, E]
+    gate_w, sel = jax.lax.top_k(gates, K)              # [T, K]
+    gate_w = gate_w / jnp.maximum(gate_w.sum(-1, keepdims=True), 1e-9)
+    mask = jax.nn.one_hot(sel, E, dtype=jnp.float32)   # [T, K, E]
+    if valid is not None:
+        mask = mask * valid.astype(jnp.float32)[:, None, None]
+    mask_f = mask.reshape(T * K, E)
+    # 1-based arrival order of each (token, pick) in its expert's buffer
+    pos = jnp.cumsum(mask_f, axis=0) * mask_f
+    keep = (pos > 0) & (pos <= C)
+    slot = jax.nn.one_hot(pos - 1, C, dtype=jnp.float32) * keep[..., None]
+    # dispatch: [T*K, E, C] x [T*K, H] -> [E, C, H]
+    x_rep = jnp.broadcast_to(x[:, None], (T, K, c.hidden_size))
+    x_rep = x_rep.reshape(T * K, c.hidden_size)
+    buf = jnp.einsum("sec,sh->ech", slot, x_rep.astype(jnp.float32))
+    buf = buf.astype(x.dtype)
+    y = (jax.nn.silu(jnp.einsum("ech,ehi->eci", buf, lp["we_g"]))
+         * jnp.einsum("ech,ehi->eci", buf, lp["we_u"]))
+    y = jnp.einsum("eci,eih->ech", y, lp["we_d"])      # [E, C, H]
+    out = jnp.einsum("sec,ech->sh", slot, y.astype(jnp.float32))
+    out = out.reshape(T, K, c.hidden_size) * gate_w[..., None]
+    return out.sum(axis=1).astype(x.dtype)
+
+
+def _ffn(c: ModelConfig, lp, x: jnp.ndarray, valid=None) -> jnp.ndarray:
+    if c.moe is not None:
+        return _moe_ffn(c, lp, x, valid)
+    return _mlp(x, lp["wg"], lp["wu"], lp["wd"])
+
+
+def _layer_body(c: ModelConfig, lp, h, cos, sin, write_kv, attend,
+                ffn_valid=None):
     """Shared decoder-layer body for prefill and decode.
 
     `write_kv(k, v)` scatters new KV into the carried cache and returns it;
@@ -182,7 +290,7 @@ def _layer_body(c: ModelConfig, lp, h, cos, sin, write_kv, attend):
     attn = attend(q, new_cache)
     h = h + attn.reshape(N, c.q_dim) @ lp["wo"]
     x2 = rms_norm(h, lp["ln2"], c.rms_norm_eps)
-    h = h + _mlp(x2, lp["wg"], lp["wu"], lp["wd"])
+    h = h + _ffn(c, lp, x2, ffn_valid)
     return h, new_cache
 
 
@@ -199,72 +307,87 @@ def _logits(config: ModelConfig, params: Params, h: jnp.ndarray) -> jnp.ndarray:
 def prefill_impl(
     config: ModelConfig,
     params: Params,
-    cache: Cache,
-    tokens: jnp.ndarray,      # [T] int32, padded to a page-size multiple
-    page_table: jnp.ndarray,  # [max_pages] int32 (pages covering [0, padded end))
-    q_start: jnp.ndarray,     # scalar int32: #tokens already cached (page-aligned)
+    ctx_kv: Cache,
+    tokens: jnp.ndarray,      # [T] int32, bucket-padded
+    slot: jnp.ndarray,        # scalar int32 — destination slot lane
+    q_start: jnp.ndarray,     # scalar int32: #tokens already in the region
     seq_len: jnp.ndarray,     # scalar int32: total valid context length
+    embeds: Optional[jnp.ndarray] = None,       # [T, H] override rows
+    embeds_mask: Optional[jnp.ndarray] = None,  # [T] bool — True: use
+                              # `embeds` instead of the token embedding
+                              # (multimodal image tokens; vision.py)
 ) -> tuple[Cache, jnp.ndarray]:
-    """Run T new tokens through the model, writing their KV into pages.
+    """Run T new tokens through the model, writing their KV into the
+    slot's contiguous context region at [q_start, q_start+T).
 
-    Returns (cache, logits[vocab]) where logits are for the LAST VALID token
-    (position seq_len-1). Supports prefix-cache continuation: with q_start>0
-    the first q_start tokens' KV is already in the pages listed by
-    page_table and is attended to but not recomputed.
+    Returns (ctx_kv, logits[vocab]) where logits are for the LAST VALID
+    token (position seq_len-1). Supports prefix-cache continuation: with
+    q_start>0 the first q_start tokens' KV is already in the region
+    (loaded from the pool by load_ctx_pages) and is attended to but not
+    recomputed.
 
     CALLER CONTRACT (checked host-side by the engine scheduler, not here —
-    lax.dynamic_slice silently clamps under jit): q_start must be
-    page-aligned and q_start//page_size + T//page_size <= len(page_table),
-    with all written entries real (non-zero) pages.
+    dynamic_update_slice silently clamps under jit): q_start+T must fit the
+    region.
     """
     c = config
     T = tokens.shape[0]
-    ps = cache["k"].shape[3]
     inv_freq = jnp.asarray(
         rope_inv_freq(c.head_dim, c.rope_theta, c.rope_scaling_dict)
     )
     positions = q_start + jnp.arange(T, dtype=jnp.int32)
     cos, sin = rope_cos_sin(positions, inv_freq)
 
-    h = params["embed"][tokens].astype(cache["k"].dtype)
+    h = params["embed"][tokens].astype(ctx_kv["k"].dtype)
+    if embeds is not None:
+        h = jnp.where(embeds_mask[:, None], embeds.astype(h.dtype), h)
 
-    # page indices that receive the new tokens' KV
-    n_new_pages = T // ps
-    write_idx = jax.lax.dynamic_slice_in_dim(
-        page_table, q_start // ps, n_new_pages
-    )  # [T/ps]
-
-    # Layers are UNROLLED (python loop, static layer index): XLA's aliasing
-    # analysis keeps the donated cache update chain in place, whereas a
-    # lax.scan carrying the cache re-materializes it every iteration (the
-    # attention read-after-scatter defeats carry aliasing).
+    # Layers are UNROLLED (python loop, static layer index). The region is
+    # READ-ONLY during the layer stack: each layer's chunk KV is carried in
+    # values and attention takes it directly (ctx_prefill_attention); ALL
+    # writes land in one tail pass after the last read, so the donated
+    # update chain aliases in place (interleaved write/read of the GB-
+    # scale buffer would force XLA to materialize copies of it).
+    new_ks: list[jnp.ndarray] = []
+    new_vs: list[jnp.ndarray] = []
     for l in range(c.num_layers):
         lp = jax.tree.map(lambda x: x[l], params["layers"])
 
-        def write_kv(k, v, l=l):
-            # [T, kvh, hd] -> [n_new_pages, kvh, ps, hd]: the int l counts
-            # as an advanced index alongside write_idx (separated by the
-            # slice), so their broadcast dim [n] leads the result
-            def to_pages(x):
-                return x.reshape(
-                    n_new_pages, ps, c.num_kv_heads, c.head_dim
-                ).transpose(0, 2, 1, 3)
+        def write_kv(k, v):
+            new_ks.append(k)
+            new_vs.append(v)
+            return (k, v)
 
-            ck = cache["k"].at[l, :, write_idx].set(to_pages(k))
-            cv = cache["v"].at[l, :, write_idx].set(to_pages(v))
-            return {"k": ck, "v": cv}
-
-        def attend(q, new_cache, l=l):
-            return prefill_attention(
-                q, new_cache["k"], new_cache["v"], jnp.int32(l),
-                page_table, q_start, seq_len,
+        def attend(q, kv, l=l):
+            k_new, v_new = kv
+            k_ctx = jax.lax.dynamic_index_in_dim(
+                ctx_kv["k"][l], slot, axis=1, keepdims=False
+            )  # [kvh, S, hd]
+            v_ctx = jax.lax.dynamic_index_in_dim(
+                ctx_kv["v"][l], slot, axis=1, keepdims=False
+            )
+            return ctx_prefill_attention(
+                q, k_ctx, v_ctx, k_new, v_new, q_start, seq_len
             )
 
-        h, cache = _layer_body(c, lp, h, cos, sin, write_kv, attend)
+        # padding tokens must not claim MoE expert capacity
+        h, _ = _layer_body(c, lp, h, cos, sin, write_kv, attend,
+                           ffn_valid=positions < seq_len)
+
+    # tail: one contiguous span write per buffer (all reads are done)
+    ck, cv = ctx_kv["k"], ctx_kv["v"]
+    upd_k = jnp.stack(new_ks).transpose(0, 2, 1, 3)[:, :, None]
+    upd_v = jnp.stack(new_vs).transpose(0, 2, 1, 3)[:, :, None]
+    ck = jax.lax.dynamic_update_slice(
+        ck, upd_k.astype(ck.dtype), (0, 0, slot, q_start, 0)
+    )
+    cv = jax.lax.dynamic_update_slice(
+        cv, upd_v.astype(cv.dtype), (0, 0, slot, q_start, 0)
+    )
 
     last = seq_len - q_start - 1  # index of last valid token within T
     logits = _logits(c, params, h[last])
-    return cache, logits
+    return {"k": ck, "v": cv}, logits
 
 
 prefill = jax.jit(prefill_impl, static_argnums=(0,), donate_argnums=(2,))
@@ -276,30 +399,32 @@ prefill = jax.jit(prefill_impl, static_argnums=(0,), donate_argnums=(2,))
 def decode_step_impl(
     config: ModelConfig,
     params: Params,
-    cache: Cache,              # page pool — READ-ONLY here (see init_ring)
+    ctx_kv: Cache,             # [L, kvh, B+1, S, hd] — READ-ONLY here
     ring: Cache,               # [L, kvh, B, R, hd] write ring
     tokens: jnp.ndarray,       # [B] int32 — last sampled token per slot
-    page_tables: jnp.ndarray,  # [B, max_pages] int32 (inactive slots: zeros)
     ctx_lens: jnp.ndarray,     # [B] int32 — context length INCLUDING this token
     ring_base: jnp.ndarray,    # [B] int32 — position held by ring slot 0
     ring_pos: jnp.ndarray,     # scalar int32 — ring slot receiving this token
+    live: Optional[jnp.ndarray] = None,  # [B] bool — garbage lanes masked
+                               # out of MoE expert routing
 ) -> tuple[Cache, jnp.ndarray]:
     """One decode step for all slots. Returns (ring, logits [B, vocab]).
 
     The new token's KV lands in ring slot `ring_pos` (its position is
     ``ctx-1 == ring_base + ring_pos`` for live slots); attention covers
-    pool pages for positions < ring_base plus ring entries
-    [ring_base, ctx). The pool is immutable between `flush` calls.
+    the ctx region for positions < ring_base plus ring entries
+    [ring_base, ctx). The ctx region is immutable between `flush_ctx`
+    calls — the write/read interleave on the GB-scale buffer is what
+    forces XLA copies (see init_ring).
     """
     c = config
-    B = tokens.shape[0]
     inv_freq = jnp.asarray(
         rope_inv_freq(c.head_dim, c.rope_theta, c.rope_scaling_dict)
     )
     positions = jnp.maximum(ctx_lens - 1, 0)
     cos, sin = rope_cos_sin(positions, inv_freq)  # [B, hd]
 
-    h = params["embed"][tokens].astype(cache["k"].dtype)  # [B, H]
+    h = params["embed"][tokens].astype(ctx_kv["k"].dtype)  # [B, H]
 
     # unrolled layers — see prefill_impl for why not lax.scan
     for l in range(c.num_layers):
@@ -316,13 +441,14 @@ def decode_step_impl(
             return {"k": put(ring["k"], k), "v": put(ring["v"], v)}
 
         def attend(q, new_ring, l=l):
-            return paged_decode_attention(
-                q, cache["k"], cache["v"],
+            return ctx_decode_attention(
+                q, ctx_kv["k"], ctx_kv["v"],
                 new_ring["k"], new_ring["v"], jnp.int32(l),
-                page_tables, ctx_lens, ring_base,
+                ctx_lens, ring_base,
             )
 
-        h, ring = _layer_body(c, lp, h, cos, sin, write_kv, attend)
+        h, ring = _layer_body(c, lp, h, cos, sin, write_kv, attend,
+                              ffn_valid=live)
 
     logits = _logits(c, params, h)
     return ring, logits
@@ -331,58 +457,125 @@ def decode_step_impl(
 decode_step = jax.jit(decode_step_impl, static_argnums=(0,), donate_argnums=(3,))
 
 
-def flush_impl(
-    config: ModelConfig,
-    cache: Cache,
+def flush_ctx_impl(
+    ctx_kv: Cache,
     ring: Cache,
-    page_tables: jnp.ndarray,  # [B, W] int32 — MUST cover every position
-                               # written this round (see contract below)
-    ring_base: jnp.ndarray,    # [B] int32
-    valid_len: jnp.ndarray,    # [B] int32 — #real tokens in the ring per slot
+    dest: jnp.ndarray,       # [B] int32 — live: own lane; freed: scratch B
+    ring_base: jnp.ndarray,  # [B] int32
+    valid_len: jnp.ndarray,  # [B] int32 — #real tokens in the ring per slot
 ) -> Cache:
-    """Batch-scatter a full ring into the page pool (once per round).
-
-    Ring entry (b, r) holds position ring_base[b]+r and goes to page
-    page_tables[b, pos//ps] at offset pos%ps; entries with r >= valid_len[b]
-    (garbage beyond a finished/clamped slot) are redirected to scratch page
-    0. This is the only writer of the pool besides prefill.
-
-    CONTRACT: the table may be width-bucketed, but every position in
-    [ring_base, ring_base+valid_len) must map inside it — the engine's
-    _ensure_coverage guarantees this. Positions falling OUTSIDE the table
-    width are routed to scratch page 0 (dropped KV -> visibly wrong
-    output), never clamped into another sequence's page (silent KV
-    corruption).
-    """
-    c = config
-    ps = cache["k"].shape[3]
+    """Scatter a full ring into the ctx region (once per round, AFTER all
+    of the round's reads — the single write aliases in place under
+    donation). Ring entry (b, r) holds position ring_base[b]+r and goes to
+    lane dest[b]; entries beyond valid_len[b], beyond the region length,
+    or belonging to freed slots are redirected to the scratch lane."""
     L, kvh, B, R, hd = ring["k"].shape
-    r_idx = jnp.arange(R, dtype=jnp.int32)[None, :]          # [1, R]
-    pos = ring_base[:, None] + r_idx                          # [B, R]
-    page_slot = pos // ps
-    W = page_tables.shape[1]
-    in_range = page_slot < W
-    page = jnp.take_along_axis(
-        page_tables, jnp.clip(page_slot, 0, W - 1), axis=1
-    )  # [B, R]
-    valid = (r_idx < valid_len[:, None]) & in_range
-    page = jnp.where(valid, page, 0)
-    offset = pos % ps
-    pflat = page.reshape(-1)       # [B*R]
-    oflat = offset.reshape(-1)
+    S = ctx_kv["k"].shape[3]
+    scratch = ctx_kv["k"].shape[2] - 1
+    r_idx = jnp.arange(R, dtype=jnp.int32)[None, :]   # [1, R]
+    pos = ring_base[:, None] + r_idx                  # [B, R]
+    valid = (r_idx < valid_len[:, None]) & (pos < S)
+    lane = jnp.where(valid, dest[:, None], scratch)   # [B, R]
+    pos = jnp.where(valid, pos, 0)
+    lflat = lane.reshape(-1)                          # [B*R]
+    pflat = pos.reshape(-1)
 
     out = {}
     for name in ("k", "v"):
-        pool = cache[name]
+        buf = ctx_kv[name]
         upd = ring[name].transpose(0, 2, 3, 1, 4).reshape(L, B * R, kvh, hd)
         for l in range(L):
             # advanced dims ([B*R]) lead: target [B*R, kvh, hd]
-            pool = pool.at[l, :, pflat, oflat].set(upd[l])
-        out[name] = pool
+            buf = buf.at[l, :, lflat, pflat].set(upd[l])
+        out[name] = buf
     return out
 
 
-flush = jax.jit(flush_impl, static_argnums=(0,), donate_argnums=(1,))
+flush_ctx = jax.jit(flush_ctx_impl, donate_argnums=(0,))
+
+
+# ---------------------------------------------------------------------------
+# prefix-cache <-> context copies (admission / block seal)
+
+def load_ctx_pages_impl(
+    ctx_kv: Cache,
+    cache: Cache,
+    slot: jnp.ndarray,      # scalar int32 — destination lane
+    page_ids: jnp.ndarray,  # [n] int32 — pow2-padded; padding = scratch 0
+) -> Cache:
+    """Copy a matched prefix run of pool pages into the slot's context
+    region at [0, n*ps). The admission-side half of prefix reuse: padding
+    pages write scratch-page garbage BEYOND the valid prefix (the engine
+    passes q_start = real_blocks*ps, so garbage is never attended)."""
+    n = page_ids.shape[0]
+    out = {}
+    for name in ("k", "v"):
+        pages = cache[name][:, :, page_ids]      # [L, kvh, n, ps, hd]
+        L, kvh, _, ps, hd = pages.shape
+        span = pages.reshape(L, kvh, n * ps, hd)
+        out[name] = jax.lax.dynamic_update_slice(
+            ctx_kv[name], span[:, :, None],
+            (0, 0, slot, 0, 0),
+        )
+    return out
+
+
+load_ctx_pages = jax.jit(load_ctx_pages_impl, donate_argnums=(0,))
+
+
+def write_ctx_span_impl(
+    ctx_kv: Cache,
+    slot: jnp.ndarray,  # scalar int32
+    kv: Cache,          # {"k","v"}: [L, kvh, T, hd] (e.g. sp_prefill output)
+) -> Cache:
+    """Write a whole computed KV span into a slot's region at [0, T) —
+    how sp_prefill's ring-computed prompt KV enters the serving context
+    (GSPMD gathers the sp-sharded span into the replicated region)."""
+    out = {}
+    for name in ("k", "v"):
+        upd = kv[name][:, :, None]  # [L, kvh, 1, T, hd]
+        out[name] = jax.lax.dynamic_update_slice(
+            ctx_kv[name], upd.astype(ctx_kv[name].dtype),
+            (0, 0, slot, 0, 0),
+        )
+    return out
+
+
+write_ctx_span = jax.jit(write_ctx_span_impl, donate_argnums=(0,))
+
+
+def seal_blocks_impl(
+    cache: Cache,
+    ctx_kv: Cache,
+    slots: jnp.ndarray,   # [n] int32 — source lanes (pow2-padded)
+    starts: jnp.ndarray,  # [n] int32 — block start positions
+    pages: jnp.ndarray,   # [n] int32 — destination pool pages
+                          # (padding entries -> scratch page 0)
+    page_size: int,
+) -> Cache:
+    """Copy sealed blocks ctx->pool (the storage half of commit). Each
+    entry copies ctx_kv[:, :, slots[i], starts[i]:+ps] into pool page
+    pages[i]. Padding rows target scratch page 0 (garbage by contract)."""
+    ps = page_size
+
+    def one(name):
+        def grab(slot, start):
+            lane = jax.lax.dynamic_index_in_dim(
+                ctx_kv[name], slot, axis=2, keepdims=False
+            )  # [L, kvh, S, hd]
+            return jax.lax.dynamic_slice_in_dim(lane, start, ps, axis=2)
+
+        blocks = jax.vmap(grab)(slots, starts)   # [n, L, kvh, ps, hd]
+        return cache[name].at[:, :, pages].set(
+            blocks.transpose(1, 2, 0, 3, 4)
+        )
+
+    return {"k": one("k"), "v": one("v")}
+
+
+seal_blocks = jax.jit(
+    seal_blocks_impl, static_argnames=("page_size",), donate_argnums=(0,)
+)
 
 
 # ---------------------------------------------------------------------------

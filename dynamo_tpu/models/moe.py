@@ -39,7 +39,15 @@ class MoEConfig:
     capacity_factor: float = 1.25
 
     def capacity(self, tokens_per_shard: int) -> int:
-        """Per-expert, per-source-shard token slots."""
+        """Per-expert, per-source-shard token slots. capacity_factor <= 0
+        means DROPLESS: every (token, pick) gets a slot (C = T*K). That is
+        the serving default — capacity drops make a token's activations
+        depend on what it was co-batched with, which breaks prefix-cache
+        reproducibility (a resend recomputing a chunk alone would get
+        different KV than the original). Capacity-bounded mode is for
+        throughput-oriented deployments that accept the approximation."""
+        if self.capacity_factor <= 0:
+            return max(tokens_per_shard * self.top_k, 1)
         c = math.ceil(
             tokens_per_shard * self.top_k * self.capacity_factor
             / self.num_experts

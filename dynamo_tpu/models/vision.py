@@ -1,0 +1,126 @@
+"""ViT-style vision encoder for multimodal serving (reference
+examples/multimodal: LLaVA/Qwen-VL encode worker,
+components/encode_worker.py:148).
+
+TPU-first: patchify via a single reshape+matmul (a conv with
+stride==kernel IS a patch matmul — MXU-friendly), pre-norm transformer
+blocks as one unrolled loop over stacked per-layer weights (same compile
+discipline as models/llama.py), bidirectional attention, and a projector
+to the language model's hidden size. The output is a sequence of image
+tokens the llama prefill consumes in place of ``<image>`` placeholder
+embeddings (llama.prefill token_embeds).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Params = dict[str, Any]
+
+
+@dataclass(frozen=True)
+class VisionConfig:
+    image_size: int = 224
+    patch_size: int = 14
+    hidden_size: int = 1024
+    intermediate_size: int = 4096
+    num_layers: int = 24
+    num_heads: int = 16
+    out_hidden_size: int = 4096   # language model hidden size
+    layer_norm_eps: float = 1e-5
+
+    @property
+    def num_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+    @property
+    def patch_dim(self) -> int:
+        return self.patch_size * self.patch_size * 3
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+    @classmethod
+    def tiny(cls, out_hidden_size: int = 64) -> "VisionConfig":
+        """CPU-test shapes."""
+        return cls(image_size=16, patch_size=4, hidden_size=32,
+                   intermediate_size=64, num_layers=2, num_heads=4,
+                   out_hidden_size=out_hidden_size)
+
+
+def init_vision_params(cfg: VisionConfig, rng: jax.Array | int = 0,
+                       dtype=jnp.float32) -> Params:
+    if isinstance(rng, int):
+        rng = jax.random.PRNGKey(rng)
+    keys = jax.random.split(rng, 10)
+    L, H, I = cfg.num_layers, cfg.hidden_size, cfg.intermediate_size
+
+    def rnd(k, *s):
+        return (jax.random.normal(k, s, jnp.float32)
+                / np.sqrt(s[-2] if len(s) > 1 else s[-1])).astype(dtype)
+
+    return {
+        "patch_embed": rnd(keys[0], cfg.patch_dim, H),
+        "pos_embed": (jax.random.normal(keys[1], (cfg.num_patches, H),
+                                        jnp.float32) * 0.02).astype(dtype),
+        "layers": {
+            "ln1": jnp.ones((L, H), dtype),
+            "ln2": jnp.ones((L, H), dtype),
+            "wq": rnd(keys[2], L, H, H),
+            "wk": rnd(keys[3], L, H, H),
+            "wv": rnd(keys[4], L, H, H),
+            "wo": rnd(keys[5], L, H, H),
+            "w1": rnd(keys[6], L, H, I),
+            "w2": rnd(keys[7], L, I, H),
+        },
+        "ln_f": jnp.ones((H,), dtype),
+        "proj": rnd(keys[8], H, cfg.out_hidden_size),
+    }
+
+
+def _ln(x, w, eps):
+    xf = x.astype(jnp.float32)
+    mu = xf.mean(-1, keepdims=True)
+    var = ((xf - mu) ** 2).mean(-1, keepdims=True)
+    return ((xf - mu) * jax.lax.rsqrt(var + eps)).astype(x.dtype) * w
+
+
+def encode_image_impl(
+    cfg: VisionConfig, params: Params, image: jnp.ndarray
+) -> jnp.ndarray:
+    """[H, W, 3] float image (0..1) -> [num_patches, out_hidden] tokens."""
+    c = cfg
+    p = c.patch_size
+    n = c.image_size // p
+    # patchify: [n, p, n, p, 3] -> [n*n, p*p*3] (stride==kernel conv)
+    patches = image.reshape(n, p, n, p, 3).transpose(0, 2, 1, 3, 4)
+    patches = patches.reshape(n * n, c.patch_dim)
+    h = patches.astype(params["patch_embed"].dtype) @ params["patch_embed"]
+    h = h + params["pos_embed"]
+
+    nh, hd = c.num_heads, c.head_dim
+    for l in range(c.num_layers):
+        lp = jax.tree.map(lambda x: x[l], params["layers"])
+        x = _ln(h, lp["ln1"], c.layer_norm_eps)
+        q = (x @ lp["wq"]).reshape(-1, nh, hd)
+        k = (x @ lp["wk"]).reshape(-1, nh, hd)
+        v = (x @ lp["wv"]).reshape(-1, nh, hd)
+        s = jnp.einsum("qhd,khd->hqk", q, k,
+                       preferred_element_type=jnp.float32) / np.sqrt(hd)
+        w = jax.nn.softmax(s, axis=-1)
+        attn = jnp.einsum("hqk,khd->qhd", w.astype(v.dtype), v,
+                          preferred_element_type=jnp.float32)
+        h = h + attn.astype(h.dtype).reshape(-1, c.hidden_size) @ lp["wo"]
+        x2 = _ln(h, lp["ln2"], c.layer_norm_eps)
+        h = h + jax.nn.gelu(x2 @ lp["w1"]) @ lp["w2"]
+
+    h = _ln(h, params["ln_f"], c.layer_norm_eps)
+    return h @ params["proj"]   # [num_patches, out_hidden]
+
+
+encode_image = jax.jit(encode_image_impl, static_argnums=(0,))

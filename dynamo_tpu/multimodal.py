@@ -1,0 +1,178 @@
+"""Multimodal E/P/D serving graph (reference examples/multimodal:
+encode worker + embedding transfer + prefill consumption,
+components/encode_worker.py:148, disagg_router.py:48-66).
+
+Three-stage flow, TPU-native:
+
+  1. ``EncodeWorker`` — a runtime component serving an ``encode``
+     endpoint: images in, language-model embedding rows out (the vision
+     tower runs as its own worker so encoder and LLM scale
+     independently, exactly the reference's E/P/D split).
+  2. The embeddings travel back over the runtime's streamed push RPC
+     (small: num_patches x hidden rows; the kv_transfer plane can carry
+     them as raw arrays for big batches).
+  3. ``MultimodalEngine`` — an AsyncEngine wrapper in front of a decode
+     engine: resolves a request's images via the encode endpoint,
+     attaches the embedding rows + content digest to
+     ``PreprocessedRequest.multimodal``, and delegates. The TpuEngine
+     injects the rows in place of the ``<image>`` placeholder tokens'
+     embeddings during prefill (models/llama.py prefill `embeds`), and
+     salts the request's block hashes with the digest so prefix caching
+     never serves one image's KV for another.
+
+The caller's prompt must already contain a run of placeholder tokens per
+image; ``images[i]["pos"]`` marks where each run starts (the HTTP
+preprocessor's image_url lowering produces this shape).
+"""
+from __future__ import annotations
+
+import base64
+import dataclasses
+import hashlib
+import logging
+from typing import Any, AsyncIterator, Optional
+
+import numpy as np
+
+from dynamo_tpu.protocols.common import LLMEngineOutput, PreprocessedRequest
+
+log = logging.getLogger(__name__)
+
+
+def encode_image_payload(image: np.ndarray) -> dict[str, Any]:
+    """Pack an [H, W, 3] float32 image for the encode endpoint."""
+    arr = np.ascontiguousarray(image, np.float32)
+    return {
+        "data": base64.b64encode(arr.tobytes()).decode(),
+        "shape": list(arr.shape),
+    }
+
+
+def decode_image_payload(payload: dict[str, Any]) -> np.ndarray:
+    raw = base64.b64decode(payload["data"])
+    return np.frombuffer(raw, np.float32).reshape(payload["shape"]).copy()
+
+
+def images_digest(images: list[dict[str, Any]]) -> str:
+    """Content digest over every image's bytes (prefix-cache salt)."""
+    h = hashlib.sha256()
+    for im in images:
+        h.update(str(im.get("shape")).encode())
+        h.update(base64.b64decode(im["data"]))
+    return h.hexdigest()[:16]
+
+
+class EncodeWorker:
+    """Vision-encoder worker: serves ``encode`` on the runtime
+    (reference encode_worker.py:148)."""
+
+    def __init__(
+        self,
+        rt: Any,
+        vision_cfg: Any = None,
+        params: Any = None,
+        namespace: str = "dynamo",
+        component: str = "encoder",
+        worker_id: str = "encoder-0",
+    ):
+        from dynamo_tpu.models.vision import VisionConfig, init_vision_params
+
+        self.rt = rt
+        self.cfg = vision_cfg or VisionConfig.tiny()
+        self.params = params if params is not None else init_vision_params(
+            self.cfg, 0
+        )
+        self.namespace = namespace
+        self.component = component
+        self.worker_id = worker_id
+        self.images_encoded = 0
+        self._served = None
+
+    async def _handle(self, payload: dict) -> AsyncIterator[dict]:
+        import asyncio
+
+        from dynamo_tpu.models.vision import encode_image
+
+        out = []
+        for im in payload.get("images", []):
+            arr = decode_image_payload(im)
+            emb = await asyncio.to_thread(
+                lambda a=arr: np.asarray(
+                    encode_image(self.cfg, self.params, a), np.float32
+                )
+            )
+            self.images_encoded += 1
+            out.append(emb.tolist())
+        yield {"embeddings": out}
+
+    async def start(self) -> "EncodeWorker":
+        ep = self.rt.namespace(self.namespace).component(
+            self.component
+        ).endpoint("encode")
+        self._served = await ep.serve(self._handle, worker_id=self.worker_id)
+        return self
+
+    async def stop(self) -> None:
+        if self._served is not None:
+            await self._served.shutdown()
+            self._served = None
+
+
+class MultimodalEngine:
+    """AsyncEngine wrapper: encode stage -> embedding attach -> delegate
+    (the reference's 3-stage disaggregation, orchestrated)."""
+
+    def __init__(
+        self,
+        inner: Any,
+        rt: Any = None,
+        namespace: str = "dynamo",
+        component: str = "encoder",
+        local_encoder: Optional[Any] = None,  # EncodeWorker for in-process
+    ):
+        self.inner = inner
+        self.rt = rt
+        self.namespace = namespace
+        self.component = component
+        self.local_encoder = local_encoder
+        self.images_resolved = 0
+        self._client = None
+
+    async def _encode(self, images: list[dict]) -> list[list]:
+        if self.local_encoder is not None:
+            out = None
+            async for item in self.local_encoder._handle({"images": images}):
+                out = item
+            return out["embeddings"]
+        if self._client is None:
+            self._client = await self.rt.namespace(self.namespace).component(
+                self.component
+            ).endpoint("encode").client()
+        async for item in self._client.generate({"images": images}):
+            return item["embeddings"]
+        raise RuntimeError("encode endpoint returned no response")
+
+    async def generate(
+        self, request: PreprocessedRequest
+    ) -> AsyncIterator[LLMEngineOutput]:
+        mm = request.multimodal or {}
+        images = mm.get("images")
+        if images:
+            embs = await self._encode(images)
+            entries = []
+            for im, rows in zip(images, embs):
+                entries.append({"pos": int(im["pos"]), "data": rows})
+            self.images_resolved += len(entries)
+            # resolved COPY: the caller's request keeps its raw images
+            # (idempotent under frontend retry/failover re-dispatch)
+            request = dataclasses.replace(request, multimodal={
+                "embeddings": entries,
+                "digest": images_digest(images),
+            })
+        async for out in self.inner.generate(request):
+            yield out
+
+    async def stop(self) -> None:
+        stop = getattr(self.inner, "stop", None)
+        if stop is not None:
+            await stop()

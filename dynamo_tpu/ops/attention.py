@@ -1,17 +1,21 @@
-"""Attention over a paged KV cache.
+"""Attention over the contiguous per-slot decode context.
 
-The KV cache is a page pool ``k_cache/v_cache: [num_layers, num_kv_heads,
-num_pages, page_size, head_dim]``; a request's context is the concatenation
-of the pages listed in its page table. Attention ops take the FULL cache
-plus a (traced) layer index so the decoder scan can carry the cache and
-update it in place — slicing a layer out of the carry would materialize a
-copy every step (SURVEY.md §7 "Paged attention on TPU" hard part; the
-head-leading page layout makes one (head, page) block a clean TPU tile and
-shards kv_heads over the ``tp`` mesh axis).
+Round-4 layout (see ops/flash_decode.py and models/llama.py): each decode
+slot owns a contiguous KV region ``ctx_kv [L, kvh, B(+1), S, hd]``; the
+paged pool exists only as prefix-cache storage, copied in/out at
+admission/seal. Attention in the hot path therefore reads dense slabs —
+no gathers, no page tables:
 
-Dispatch: on TPU backends decode attention runs the Pallas flash-decoding
-kernel (ops/pallas_attention.py); elsewhere (CPU test mesh) the pure-jnp
-reference implementations below.
+  - decode: the Pallas flash kernel on TPU backends
+    (ops/pallas flash_decode.py), the pure-jnp reference elsewhere
+    (CPU test meshes, interpret checks);
+  - prefill: one dense causal attention over the slot's region — prefill
+    is a large matmul XLA already schedules well; no kernel needed.
+
+This replaces the round-3 paged-attention kernel whose (slot, head, page)
+grid cost 15.9 ms/step in pure invocation overhead (SURVEY.md §7 "Paged
+attention on TPU" hard part; reference analogue is vLLM's paged-attention
+CUDA kernel).
 """
 from __future__ import annotations
 
@@ -20,10 +24,15 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
+from dynamo_tpu.ops.flash_decode import (
+    flash_decode_attention,
+    flash_decode_attention_reference,
+)
+
 NEG_INF = -1e30
 
 # None = auto (pallas iff backend is tpu); True/False force. Tests flip this
-# to validate kernel-vs-reference parity in interpret mode.
+# to validate kernel-vs-reference parity.
 USE_PALLAS: Optional[bool] = None
 
 
@@ -33,124 +42,73 @@ def _pallas_enabled() -> bool:
     return jax.default_backend() == "tpu"
 
 
-def repeat_kv_heads(x: jnp.ndarray, n_rep: int) -> jnp.ndarray:
-    """[kv_heads, ...] -> [kv_heads*n_rep, ...] (GQA head expansion;
-    query head i attends kv head i // n_rep)."""
-    if n_rep == 1:
-        return x
-    return jnp.repeat(x, n_rep, axis=0)
-
-
-def prefill_attention(
-    q: jnp.ndarray,            # [T, n_heads, hd] — new tokens (padded)
-    k_cache: jnp.ndarray,      # [L, kv_heads, P, ps, hd]
-    v_cache: jnp.ndarray,
-    layer: jnp.ndarray,        # scalar int32 layer index
-    page_table: jnp.ndarray,   # [max_pages] int32 — pages covering [0, seq_len)
-    q_start: jnp.ndarray,      # scalar int32 — #tokens already cached (page-aligned)
-    seq_len: jnp.ndarray,      # scalar int32 — total valid context length
+def ctx_decode_attention(
+    q: jnp.ndarray,          # [B, n_heads, hd] — one new token per slot
+    ctx_k: jnp.ndarray,      # [L, kvh, B(+1), S, hd]
+    ctx_v: jnp.ndarray,
+    ring_k: jnp.ndarray,     # [L, kvh, B, R, hd] current-round writes
+    ring_v: jnp.ndarray,
+    layer: jnp.ndarray,      # scalar i32
+    ctx_lens: jnp.ndarray,   # [B] i32 — context length INCL. current token
+    ring_base: jnp.ndarray,  # [B] i32 — position held by ring slot 0
 ) -> jnp.ndarray:
-    """Causal attention of T new tokens (positions q_start..q_start+T) against
-    the full paged context [0, seq_len). Returns [T, n_heads, hd]."""
+    """Decode attention over the two-tier context (ctx region below
+    ring_base + ring above). The current token's KV must already be in the
+    ring. Returns [B, n_heads, hd]."""
+    if _pallas_enabled():
+        return flash_decode_attention(
+            q, ctx_k, ctx_v, ring_k, ring_v, layer, ctx_lens, ring_base
+        )
+    return flash_decode_attention_reference(
+        q, ctx_k, ctx_v, ring_k, ring_v, layer, ctx_lens, ring_base
+    )
+
+
+def ctx_prefill_attention(
+    q: jnp.ndarray,        # [T, n_heads, hd] — new tokens (padded)
+    k_ctx: jnp.ndarray,    # [kvh, S, hd] — slot's PRIOR context (< q_start)
+    v_ctx: jnp.ndarray,
+    k_new: jnp.ndarray,    # [T, kvh, hd] — this chunk's keys
+    v_new: jnp.ndarray,
+    q_start: jnp.ndarray,  # scalar i32 — #tokens already in the region
+    seq_len: jnp.ndarray,  # scalar i32 — total valid context length
+) -> jnp.ndarray:
+    """Causal attention of T new tokens (positions q_start..q_start+T)
+    against prior context [0, q_start) plus the chunk itself (causal).
+    Returns [T, n_heads, hd]. The chunk's KV is passed directly rather
+    than read back from the region — the region write happens ONCE at the
+    end of the prefill program, so XLA never interleaves writes with the
+    custom-call/einsum reads (the copy pathology this layout exists to
+    avoid). Dense T×S einsums — prefill is MXU-friendly as-is."""
     T, n_heads, hd = q.shape
-    _, kv_heads, _, ps, _ = k_cache.shape
+    kv_heads, S, _ = k_ctx.shape
     n_rep = n_heads // kv_heads
 
-    k = k_cache[layer][:, page_table]  # [kvh, n, ps, hd]
-    v = v_cache[layer][:, page_table]
-    S = k.shape[1] * ps
-    k = repeat_kv_heads(k.reshape(kv_heads, S, hd), n_rep)  # [nh, S, hd]
-    v = repeat_kv_heads(v.reshape(kv_heads, S, hd), n_rep)
-
+    k = jnp.concatenate(
+        [k_ctx, k_new.transpose(1, 0, 2).astype(k_ctx.dtype)], axis=1
+    )  # [kvh, S+T, hd]
+    v = jnp.concatenate(
+        [v_ctx, v_new.transpose(1, 0, 2).astype(v_ctx.dtype)], axis=1
+    )
+    k = jnp.repeat(k, n_rep, axis=0)  # [nh, S+T, hd]
+    v = jnp.repeat(v, n_rep, axis=0)
     scale = 1.0 / jnp.sqrt(jnp.asarray(hd, jnp.float32))
     qt = q.transpose(1, 0, 2)  # [nh, T, hd]
     scores = jnp.einsum(
         "nth,nsh->nts", qt, k, preferred_element_type=jnp.float32
     ) * scale
-    q_pos = q_start + jnp.arange(T)[:, None]       # [T, 1]
-    k_pos = jnp.arange(S)[None, :]                 # [1, S]
-    mask = (k_pos <= q_pos) & (k_pos < seq_len)    # causal + validity
+    q_pos = q_start + jnp.arange(T)[:, None]            # [T, 1]
+    ctx_pos = jnp.arange(S)[None, :]                    # [1, S]
+    ctx_ok = jnp.broadcast_to(
+        (ctx_pos < q_start) & (ctx_pos < seq_len), (T, S)
+    )
+    new_pos = q_start + jnp.arange(T)[None, :]          # [1, T]
+    new_ok = (new_pos <= q_pos) & (new_pos < seq_len)   # causal in-chunk
+    mask = jnp.concatenate([ctx_ok, new_ok], axis=1)    # [T, S+T]
     scores = jnp.where(mask[None], scores, NEG_INF)
     probs = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum(
         "nts,nsh->tnh", probs.astype(v.dtype), v,
-        preferred_element_type=jnp.float32,
-    )
-    return out.astype(q.dtype)
-
-
-def paged_decode_attention(
-    q: jnp.ndarray,            # [B, n_heads, hd] — one new token per slot
-    k_cache: jnp.ndarray,      # [L, kv_heads, P, ps, hd] page pool (read-only)
-    v_cache: jnp.ndarray,
-    ring_k: jnp.ndarray,       # [L, kv_heads, B, R, hd] current-round writes
-    ring_v: jnp.ndarray,
-    layer: jnp.ndarray,        # scalar int32
-    page_tables: jnp.ndarray,  # [B, max_pages] int32
-    ctx_lens: jnp.ndarray,     # [B] int32 — context length incl. current token
-    ring_base: jnp.ndarray,    # [B] int32 — position of ring slot 0
-) -> jnp.ndarray:
-    """Single-token attention for a batch of decode slots over the two-tier
-    context: pool pages hold positions < ring_base, the ring holds
-    [ring_base, ctx). Returns [B, n_heads, hd]."""
-    if _pallas_enabled():
-        from dynamo_tpu.ops.pallas_attention import paged_decode_attention_pallas
-
-        return paged_decode_attention_pallas(
-            q, k_cache, v_cache, ring_k, ring_v, layer,
-            page_tables, ctx_lens, ring_base,
-        )
-    return paged_decode_attention_reference(
-        q, k_cache, v_cache, ring_k, ring_v, layer,
-        page_tables, ctx_lens, ring_base,
-    )
-
-
-def paged_decode_attention_reference(
-    q: jnp.ndarray,
-    k_cache: jnp.ndarray,
-    v_cache: jnp.ndarray,
-    ring_k: jnp.ndarray,
-    ring_v: jnp.ndarray,
-    layer: jnp.ndarray,
-    page_tables: jnp.ndarray,
-    ctx_lens: jnp.ndarray,
-    ring_base: jnp.ndarray,
-) -> jnp.ndarray:
-    """Pure-jnp decode attention (gathers the full context — correct
-    everywhere, bandwidth-wasteful; the Pallas kernel is the serving path)."""
-    B, n_heads, hd = q.shape
-    _, kv_heads, _, ps, _ = k_cache.shape
-    n_rep = n_heads // kv_heads
-    max_pages = page_tables.shape[1]
-    R = ring_k.shape[3]
-    S = max_pages * ps
-
-    k = k_cache[layer][:, page_tables]   # [kvh, B, max_pages, ps, hd]
-    v = v_cache[layer][:, page_tables]
-    k = k.reshape(kv_heads, B, S, hd)
-    v = v.reshape(kv_heads, B, S, hd)
-    # append the ring as extra context lanes
-    k = jnp.concatenate([k, ring_k[layer]], axis=2)  # [kvh, B, S+R, hd]
-    v = jnp.concatenate([v, ring_v[layer]], axis=2)
-    k = repeat_kv_heads(k, n_rep)  # [nh, B, S+R, hd]
-    v = repeat_kv_heads(v, n_rep)
-
-    scale = 1.0 / jnp.sqrt(jnp.asarray(hd, jnp.float32))
-    scores = jnp.einsum(
-        "bnh,nbsh->bns", q, k, preferred_element_type=jnp.float32
-    ) * scale
-    # pool lanes valid for positions < ring_base; ring lane r holds
-    # position ring_base + r, valid while < ctx
-    pool_pos = jnp.arange(S)[None, :]                       # [1, S]
-    pool_ok = pool_pos < jnp.minimum(ring_base, ctx_lens)[:, None]
-    ring_pos = ring_base[:, None] + jnp.arange(R)[None, :]  # [B, R]
-    ring_ok = ring_pos < ctx_lens[:, None]
-    mask = jnp.concatenate([pool_ok, ring_ok], axis=1)      # [B, S+R]
-    scores = jnp.where(mask[:, None, :], scores, NEG_INF)
-    probs = jax.nn.softmax(scores, axis=-1)
-    out = jnp.einsum(
-        "bns,nbsh->bnh", probs.astype(v.dtype), v,
         preferred_element_type=jnp.float32,
     )
     return out.astype(q.dtype)

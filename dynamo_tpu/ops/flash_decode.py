@@ -1,22 +1,28 @@
-"""Pallas TPU kernel: flash decode attention over CONTIGUOUS per-slot KV.
+"""Pallas TPU kernel: flash decode attention over CONTIGUOUS per-slot KV
+plus a small per-round write ring.
 
-Round-4 redesign of the decode hot path. The round-3 kernel walked the
-paged pool with grid (slot, kv-head, page): 36k kernel invocations per
-step at ~0.4 µs each — 15.9 ms/step of pure grid overhead (tools/
-profile_decode.py). The fix is layout, not tuning: decode context lives in
-a contiguous per-slot region ``ctx_kv [L, kvh, B, S, hd]`` (the paged pool
-remains as prefix-cache *storage*; the engine copies pages in at admission
-and out at block-seal), so attention streams big linear blocks:
+Round-4 redesign of the decode hot path. Two lessons drive the design
+(measured on v5e, tools history in git):
 
-  grid = (kvh, S/CHUNK) — 8 invocations per layer at S=CHUNK=512. Each
-  block is ``ctx_kv[l, h, :, chunk, :]`` — for CHUNK == S a fully
-  CONTIGUOUS 2 MB slab covering every slot — streamed through VMEM with
-  online softmax per (slot, q-head) in scratch. Chunks beyond every slot's
-  context repeat the previous block index, so their DMA is elided.
+  1. The round-3 kernel walked the paged pool with grid (slot, kv-head,
+     page): 36k kernel invocations per step at ~0.4 µs each — 15.9 ms/step
+     of pure grid overhead. Fix: decode context lives in a contiguous
+     per-slot region ``ctx_kv [L, kvh, B+1, S, hd]`` (the paged pool
+     remains prefix-cache *storage*; engine copies pages in/out at
+     admission/seal), so attention streams big dense blocks:
+     grid (B, S/CHUNK + 1) — ~32-130 invocations per layer.
+  2. Writing the multi-GB ctx buffer per layer (scatter) while custom
+     calls read it forces XLA to materialize copies (~7 GB temps,
+     119 ms/step). Fix: steps write a tiny per-slot RING
+     ``[L, kvh, B, R, hd]`` instead; the engine flushes ring->ctx once
+     per round, AFTER all reads, where the update aliases in place.
 
 Position semantics: ctx_kv[l, :, b, p] holds position p of slot b, valid
-while p < ctx_lens[b]. The CURRENT token's KV must be written (scattered)
-before the call — the kernel masks with ``pos < ctx``, covering it.
+while p < ring_base[b]; ring[l, :, b, r] holds position ring_base[b]+r,
+valid while < ctx_lens[b] (the current token INCLUDED — the decode step
+writes its KV to the ring before attending). Chunks beyond a slot's
+ring_base repeat the previous block index, so their DMA is elided — cost
+tracks the LIVE context, not the padded capacity.
 
 This replaces what vLLM's paged-attention CUDA kernel does for the
 reference (SURVEY.md §7 "Paged attention on TPU" hard part); paging moved
@@ -39,23 +45,29 @@ DEFAULT_CHUNK = 512
 def _kernel(
     # scalar prefetch
     layer_ref,   # [1] i32
-    nlive_ref,   # [1] i32 — number of chunks covering max(ctx)
+    ctx_sm,      # [B] i32
+    base_sm,     # [B] i32 — ring base positions
     # blocks
-    q_ref,       # [1, B, G, HD]       (kv head squeezed via index map)
-    k_ref,       # [1, 1, B, CHUNK, HD]
+    q_ref,       # [1, nkv, G, HD]      (slot squeezed via index map)
+    k_ref,       # [1, nkv, 1, CHUNK, HD]
     v_ref,
-    ctx_ref,     # [B, 1] i32 (VMEM copy of ctx for vectorized masking)
-    o_ref,       # [1, B, G, HD]
+    rk_ref,      # [1, nkv, 1, R, HD]   ring lane
+    rv_ref,
+    o_ref,       # [1, nkv, G, HD]
     # scratch
-    m_ref,       # [B, G, 128] f32 running max
-    l_ref,       # [B, G, 128] f32 running denom
-    acc_ref,     # [B, G, HD] f32 running numerator
+    m_ref,       # [nkv, G, 128] f32 running max
+    l_ref,       # [nkv, G, 128] f32 running denom
+    acc_ref,     # [nkv, G, HD] f32 running numerator
     *,
     scale: float,
     chunk: int,
 ):
+    b = pl.program_id(0)
     i = pl.program_id(1)
-    n_chunks = pl.num_programs(1)
+    n_chunks = pl.num_programs(1)  # ctx chunks + 1 ring chunk
+    ctx = ctx_sm[b]
+    base = base_sm[b]
+    is_ring = i == n_chunks - 1
 
     @pl.when(i == 0)
     def _():
@@ -63,36 +75,47 @@ def _kernel(
         l_ref[:] = jnp.zeros_like(l_ref)
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    @pl.when(i < nlive_ref[0])
-    def _():
-        pos = i * chunk + jax.lax.broadcasted_iota(
-            jnp.int32, (1, 1, chunk), 2)                   # [1, 1, chunk]
-        valid = pos < ctx_ref[:][:, :, None]               # [B, 1, chunk]
-        q = q_ref[0]                                       # [B, G, HD]
-        k = k_ref[0, 0]                                    # [B, chunk, HD]
-        v = v_ref[0, 0]
-        # batched over slots: one dot_general, no per-slot unroll
+    def accumulate(k, v, start, limit, length):
+        # k/v [nkv, length, HD]; positions start + iota valid below limit
+        pos = start + jax.lax.broadcasted_iota(
+            jnp.int32, (1, 1, length), 2)
+        valid = pos < limit
+        q = q_ref[0]                                       # [nkv, G, HD]
         s = jax.lax.dot_general(
             q, k, (((2,), (2,)), ((0,), (0,))),
             preferred_element_type=jnp.float32,
-        ) * scale                                          # [B, G, chunk]
+        ) * scale                                          # [nkv, G, length]
         s = jnp.where(valid, s, NEG_INF)
-        m_prev = m_ref[:, :, :1]                           # [B, G, 1]
+        m_prev = m_ref[:, :, :1]
         row_max = jnp.max(s, axis=2, keepdims=True)
         m_new = jnp.maximum(m_prev, row_max)
-        p = jnp.exp(s - m_new)                             # [B, G, chunk]
-        alpha = jnp.exp(m_prev - m_new)                    # [B, G, 1]
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
         l_new = l_ref[:, :, :1] * alpha + jnp.sum(p, axis=2, keepdims=True)
         acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
             p.astype(v.dtype), v, (((2,), (1,)), ((0,), (0,))),
             preferred_element_type=jnp.float32,
-        )                                                  # [B, G, HD]
+        )
         m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
         l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
 
+    # ctx chunk: positions [i*chunk, +chunk), valid below ring_base
+    @pl.when(jnp.logical_and(jnp.logical_not(is_ring), i * chunk < base))
+    def _():
+        accumulate(
+            k_ref[0, :, 0], v_ref[0, :, 0],
+            i * chunk, jnp.minimum(base, ctx), chunk,
+        )
+
+    # ring chunk: slot r holds position base + r, valid below ctx
+    @pl.when(is_ring)
+    def _():
+        accumulate(rk_ref[0, :, 0], rv_ref[0, :, 0], base, ctx,
+                   rk_ref.shape[3])
+
     @pl.when(i == n_chunks - 1)
     def _():
-        denom = jnp.maximum(l_ref[:, :, :1], 1e-30)        # [B, G, 1]
+        denom = jnp.maximum(l_ref[:, :, :1], 1e-30)
         o_ref[0] = (acc_ref[:] / denom).astype(o_ref.dtype)
 
 
@@ -100,97 +123,111 @@ def _kernel(
     jax.jit, static_argnames=("chunk", "interpret")
 )
 def flash_decode_attention(
-    q: jnp.ndarray,         # [B, n_heads, HD]
-    ctx_k: jnp.ndarray,     # [L, kvh, B, S, HD] contiguous per-slot KV
+    q: jnp.ndarray,          # [B, n_heads, HD]
+    ctx_k: jnp.ndarray,      # [L, kvh, B(+1), S, HD] contiguous per-slot KV
     ctx_v: jnp.ndarray,
-    layer: jnp.ndarray,     # scalar i32
-    ctx_lens: jnp.ndarray,  # [B] i32 — context length INCL. current token
+    ring_k: jnp.ndarray,     # [L, kvh, B, R, HD] current-round writes
+    ring_v: jnp.ndarray,
+    layer: jnp.ndarray,      # scalar i32
+    ctx_lens: jnp.ndarray,   # [B] i32 — context length INCL. current token
+    ring_base: jnp.ndarray,  # [B] i32 — position held by ring slot 0
     chunk: int = DEFAULT_CHUNK,
     interpret: bool = False,
 ) -> jnp.ndarray:
-    """Flash decode attention over contiguous KV. Returns [B, n_heads, HD].
-
-    The current token's KV must already be at position ctx-1 (the engine
-    scatters it before attending)."""
+    """Flash decode attention over contiguous KV + ring. Returns
+    [B, n_heads, HD]. The current token's KV must already be in the ring
+    (position ctx-1 == ring_base + r for the step's ring slot r)."""
     B, n_heads, hd = q.shape
     L, nkv, _, S, _ = ctx_k.shape
+    R = ring_k.shape[3]
     g = n_heads // nkv
-    chunk = min(chunk, S)
-    assert S % chunk == 0, (S, chunk)
+    # chunk must tile S exactly; gcd rounds it down to a divisor (legal
+    # configs can make S a non-multiple of the default chunk)
+    import math
+
+    chunk = math.gcd(min(chunk, S), S)
     scale = float(1.0 / (hd ** 0.5))
-    # head-major q: [nkv, B, g, hd] so one grid step holds one kv head
-    qg = q.reshape(B, nkv, g, hd).transpose(1, 0, 2, 3)
+    qg = q.reshape(B, nkv, g, hd)
     n_chunks = S // chunk
     ctx_i32 = ctx_lens.astype(jnp.int32)
-    n_live = jnp.maximum(
-        (jnp.max(ctx_i32) + chunk - 1) // chunk, 1
-    ).reshape(1)
+    base_i32 = ring_base.astype(jnp.int32)
+    last = n_chunks  # ring chunk index
 
-    def q_map(h, i, layer, nlive):
-        return (h, 0, 0, 0)
+    def q_map(b, i, layer, ctx, base):
+        return (b, 0, 0, 0)
 
-    def kv_map(h, i, layer, nlive):
-        # chunks beyond every slot's context repeat the previous index so
-        # the pipeline skips the (unused) DMA
-        return (layer[0], h, 0, jnp.minimum(i, nlive[0] - 1), 0)
+    def kv_map(b, i, layer, ctx, base):
+        # chunks beyond this slot's ctx repeat the previous index so the
+        # pipeline skips the (unused) DMA; the ring grid step clamps too
+        live = jnp.maximum((base[b] + chunk - 1) // chunk - 1, 0)
+        return (layer[0], 0, b, jnp.minimum(i, live), 0)
 
-    def ctx_map(h, i, layer, nlive):
-        return (0, 0)
-
-    def o_map(h, i, layer, nlive):
-        return (h, 0, 0, 0)
+    def ring_map(b, i, layer, ctx, base):
+        return (layer[0], 0, b, 0, 0)
 
     out = pl.pallas_call(
         functools.partial(_kernel, scale=scale, chunk=chunk),
         grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=2,
-            grid=(nkv, n_chunks),
+            num_scalar_prefetch=3,
+            grid=(B, n_chunks + 1),
             in_specs=[
-                pl.BlockSpec((1, B, g, hd), q_map),
-                pl.BlockSpec((1, 1, B, chunk, hd), kv_map),
-                pl.BlockSpec((1, 1, B, chunk, hd), kv_map),
-                pl.BlockSpec((B, 1), ctx_map),
+                pl.BlockSpec((1, nkv, g, hd), q_map),
+                pl.BlockSpec((1, nkv, 1, chunk, hd), kv_map),
+                pl.BlockSpec((1, nkv, 1, chunk, hd), kv_map),
+                pl.BlockSpec((1, nkv, 1, R, hd), ring_map),
+                pl.BlockSpec((1, nkv, 1, R, hd), ring_map),
             ],
-            out_specs=pl.BlockSpec((1, B, g, hd), o_map),
+            out_specs=pl.BlockSpec((1, nkv, g, hd), q_map),
             scratch_shapes=[
-                pltpu.VMEM((B, g, 128), jnp.float32),
-                pltpu.VMEM((B, g, 128), jnp.float32),
-                pltpu.VMEM((B, g, hd), jnp.float32),
+                pltpu.VMEM((nkv, g, 128), jnp.float32),
+                pltpu.VMEM((nkv, g, 128), jnp.float32),
+                pltpu.VMEM((nkv, g, hd), jnp.float32),
             ],
         ),
-        out_shape=jax.ShapeDtypeStruct((nkv, B, g, hd), q.dtype),
-        # the all-slot block pair (k+v, double-buffered) slightly exceeds
-        # the default 16M scoped-vmem budget; v5e has far more VMEM
+        out_shape=jax.ShapeDtypeStruct((B, nkv, g, hd), q.dtype),
+        # generous scoped-vmem budget for the chunked block pipeline
         compiler_params=pltpu.CompilerParams(
             vmem_limit_bytes=64 * 1024 * 1024,
         ),
         interpret=interpret,
     )(
         jnp.asarray(layer, jnp.int32).reshape(1),
-        n_live,
-        qg, ctx_k, ctx_v, ctx_i32[:, None],
+        ctx_i32,
+        base_i32,
+        qg, ctx_k, ctx_v, ring_k, ring_v,
     )
-    # [nkv, B, g, hd] -> [B, nkv*g, hd]
-    return out.transpose(1, 0, 2, 3).reshape(B, n_heads, hd)
+    return out.reshape(B, n_heads, hd)
 
 
 def flash_decode_attention_reference(
     q: jnp.ndarray,
     ctx_k: jnp.ndarray,
     ctx_v: jnp.ndarray,
+    ring_k: jnp.ndarray,
+    ring_v: jnp.ndarray,
     layer: jnp.ndarray,
     ctx_lens: jnp.ndarray,
+    ring_base: jnp.ndarray,
 ) -> jnp.ndarray:
     """Pure-jnp equivalent (CPU tests / kernel parity checks)."""
     B, n_heads, hd = q.shape
     L, nkv, _, S, _ = ctx_k.shape
+    R = ring_k.shape[3]
     n_rep = n_heads // nkv
-    k = jnp.repeat(ctx_k[layer], n_rep, axis=0)  # [nh, B, S, hd]
-    v = jnp.repeat(ctx_v[layer], n_rep, axis=0)
+    k = jnp.repeat(ctx_k[layer][:, :B], n_rep, axis=0)  # [nh, B, S, hd]
+    v = jnp.repeat(ctx_v[layer][:, :B], n_rep, axis=0)
+    rk = jnp.repeat(ring_k[layer], n_rep, axis=0)       # [nh, B, R, hd]
+    rv = jnp.repeat(ring_v[layer], n_rep, axis=0)
+    k = jnp.concatenate([k, rk], axis=2)                # [nh, B, S+R, hd]
+    v = jnp.concatenate([v, rv], axis=2)
     scores = jnp.einsum(
         "bnh,nbsh->bns", q, k, preferred_element_type=jnp.float32
     ) / (hd ** 0.5)
-    mask = jnp.arange(S)[None, :] < ctx_lens[:, None]
+    ctx_pos = jnp.arange(S)[None, :]                    # [1, S]
+    ctx_ok = ctx_pos < jnp.minimum(ring_base, ctx_lens)[:, None]
+    ring_pos = ring_base[:, None] + jnp.arange(R)[None, :]
+    ring_ok = ring_pos < ctx_lens[:, None]
+    mask = jnp.concatenate([ctx_ok, ring_ok], axis=1)   # [B, S+R]
     scores = jnp.where(mask[:, None, :], scores, NEG_INF)
     probs = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum(

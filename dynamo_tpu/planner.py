@@ -218,19 +218,31 @@ async def run_planner(args) -> None:
     sla = _build_sla(args)
     host, _, port = args.control_plane.partition(":")
     kv = await KvClient(host or "127.0.0.1", int(port or 7111)).connect()
-    worker_cmd = [sys.executable, "-m", "dynamo_tpu.cli", "run",
-                  "in=endpoint", f"out={args.engine}",
-                  "--control-plane", args.control_plane,
-                  "--model-name", args.model_name,
-                  "--namespace", args.namespace]
-    connector = LocalConnector(worker_cmd)
+    if getattr(args, "connector", "local") == "kubernetes":
+        # scale the worker Deployment through the k8s API (reference
+        # kubernetes_connector.py; in-cluster SA credentials by default)
+        from dynamo_tpu.k8s import KubernetesConnector
+
+        if not args.k8s_deployment:
+            raise SystemExit("--connector kubernetes needs --k8s-deployment")
+        connector = await KubernetesConnector(
+            args.k8s_deployment, args.k8s_namespace
+        ).start()
+    else:
+        worker_cmd = [sys.executable, "-m", "dynamo_tpu.cli", "run",
+                      "in=endpoint", f"out={args.engine}",
+                      "--control-plane", args.control_plane,
+                      "--model-name", args.model_name,
+                      "--namespace", args.namespace]
+        connector = LocalConnector(worker_cmd)
     cfg = PlannerConfig(
         adjustment_interval_s=args.adjustment_interval,
         min_replicas=args.min_replicas,
         max_replicas=args.max_replicas,
         predictor=getattr(args, "predictor", "constant"),
     )
-    await connector.set_replicas(cfg.min_replicas)
+    if connector.current_replicas() < cfg.min_replicas:
+        await connector.set_replicas(cfg.min_replicas)
     planner = await Planner(kv, connector, cfg, sla=sla).start()
     mode = "sla" if sla else "load"
     print(f"planner ({mode}) managing '{args.model_name}' workers "
@@ -240,7 +252,8 @@ async def run_planner(args) -> None:
             await asyncio.sleep(3600)
     finally:
         await planner.stop()
-        await connector.shutdown()
+        down = getattr(connector, "shutdown", None) or connector.close
+        await down()
         await kv.close()
 
 

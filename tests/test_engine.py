@@ -121,38 +121,29 @@ async def collect(engine, req):
 
 
 def manual_greedy(cfg, params, ecfg, prompt, n_new):
-    """Hand-driven reference loop on the raw model."""
-    cache = llama.init_cache(cfg, ecfg.num_pages, ecfg.page_size, jnp.float32)
-    ps = ecfg.page_size
-    n_pages = (len(prompt) + ps - 1) // ps
-    table = np.zeros(ecfg.max_pages_per_seq, np.int32)
-    table[:n_pages] = np.arange(1, n_pages + 1)
+    """Hand-driven reference loop on the raw model (contiguous ctx)."""
+    ctx = llama.init_ctx(cfg, 1, ecfg.max_context, jnp.float32)
     pad = ((len(prompt) + 31) // 32) * 32
     toks = np.zeros(pad, np.int32)
     toks[: len(prompt)] = prompt
-    cache, logits = llama.prefill(
-        cfg, params, cache, jnp.asarray(toks), jnp.asarray(table),
+    ctx, logits = llama.prefill(
+        cfg, params, ctx, jnp.asarray(toks), jnp.int32(0),
         jnp.int32(0), jnp.int32(len(prompt)),
     )
     out = [int(np.argmax(np.asarray(logits)))]
     seq_len = len(prompt)
-    ptb = np.zeros((1, ecfg.max_pages_per_seq), np.int32)
     ring = llama.init_ring(cfg, 1, 1, dtype=jnp.float32)  # 1-step rounds
     for _ in range(n_new - 1):
         seq_len += 1
-        pos = seq_len - 1
-        if pos // ps >= n_pages:
-            n_pages += 1
-            table[n_pages - 1] = n_pages
-        ptb[0] = table
-        ring_base = jnp.asarray([pos], jnp.int32)
+        ring_base = jnp.asarray([seq_len - 1], jnp.int32)
         ring, lg = llama.decode_step(
-            cfg, params, cache, ring,
-            jnp.asarray([out[-1]], jnp.int32), jnp.asarray(ptb),
-            jnp.asarray([seq_len], jnp.int32), ring_base, jnp.int32(0),
+            cfg, params, ctx, ring,
+            jnp.asarray([out[-1]], jnp.int32),
+            jnp.asarray([seq_len], jnp.int32),
+            ring_base, jnp.int32(0),
         )
-        cache = llama.flush(
-            cfg, cache, ring, jnp.asarray(ptb), ring_base,
+        ctx = llama.flush_ctx(
+            ctx, ring, jnp.asarray([0], jnp.int32), ring_base,
             jnp.asarray([1], jnp.int32),
         )
         out.append(int(np.argmax(np.asarray(lg)[0])))

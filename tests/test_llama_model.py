@@ -1,9 +1,10 @@
-"""Golden tests: our paged-KV llama forward vs HuggingFace transformers.
+"""Golden tests: our contiguous-ctx llama forward vs HuggingFace.
 
 The reference gets model correctness for free from vLLM; we validate ours
 against the HF torch implementation on a tiny random-init config (float32 so
-comparisons are tight). Covers: full prefill, paged decode steps, prefix-hit
-continuation prefill, and GSPMD-sharded execution on the CPU test mesh.
+comparisons are tight). Covers: full prefill, decode steps, prefix-hit
+continuation prefill, pool<->ctx copies (load_ctx_pages/seal_blocks), and
+GSPMD-sharded execution on the CPU test mesh.
 """
 import numpy as np
 import pytest
@@ -17,7 +18,7 @@ from dynamo_tpu.models.config import ModelConfig
 from dynamo_tpu.parallel.mesh import MeshConfig, make_mesh
 
 PAGE = 8
-MAX_PAGES = 8  # covers 64 tokens
+S_MAX = 64
 
 
 @pytest.fixture(scope="module")
@@ -65,15 +66,11 @@ def test_prefill_matches_hf(pair):
     rng = np.random.RandomState(1)
     prompt = rng.randint(1, cfg.vocab_size, size=21).tolist()
 
-    cache = llama.init_cache(cfg, num_pages=16, page_size=PAGE, dtype=jnp.float32)
-    page_table = np.zeros(MAX_PAGES, np.int32)
-    page_table[:3] = [1, 2, 3]  # 21 tokens -> 3 pages (page 0 reserved)
-
-    cache, logits = llama.prefill(
-        cfg, params, cache,
+    ctx = llama.init_ctx(cfg, 1, S_MAX, dtype=jnp.float32)
+    ctx, logits = llama.prefill(
+        cfg, params, ctx,
         jnp.asarray(pad_to(prompt, PAGE)),
-        jnp.asarray(page_table),
-        jnp.int32(0), jnp.int32(len(prompt)),
+        jnp.int32(0), jnp.int32(0), jnp.int32(len(prompt)),
     )
     ref = hf_logits(model, prompt)[-1]
     np.testing.assert_allclose(np.asarray(logits), ref, rtol=2e-3, atol=2e-3)
@@ -84,42 +81,35 @@ def test_decode_matches_hf(pair):
     rng = np.random.RandomState(2)
     prompt = rng.randint(1, cfg.vocab_size, size=13).tolist()
 
-    cache = llama.init_cache(cfg, num_pages=16, page_size=PAGE, dtype=jnp.float32)
-    pt = np.zeros(MAX_PAGES, np.int32)
-    pt[:4] = [1, 2, 3, 4]
-    cache, logits = llama.prefill(
-        cfg, params, cache,
+    # B=2 slots; slot 1 inactive (scratch-destined garbage lane)
+    B = 2
+    ctx = llama.init_ctx(cfg, B, S_MAX, dtype=jnp.float32)
+    ctx, logits = llama.prefill(
+        cfg, params, ctx,
         jnp.asarray(pad_to(prompt, PAGE)),
-        jnp.asarray(pt), jnp.int32(0), jnp.int32(len(prompt)),
+        jnp.int32(0), jnp.int32(0), jnp.int32(len(prompt)),
     )
-
-    # decode 6 tokens greedily with B=2 slots; slot 1 inactive. Rounds of
-    # R=2 ring steps followed by a flush — exercises the two-tier decode
-    # (ring attention within a round, pool after flush).
-    B, R = 2, 2
-    page_tables = np.zeros((B, MAX_PAGES), np.int32)
-    page_tables[0] = pt
-    ptd = jnp.asarray(page_tables)
-    ring = llama.init_ring(cfg, B, R, dtype=jnp.float32)
     seq = list(prompt)
     tok = int(np.argmax(np.asarray(logits)))
-    for round_start in range(0, 6, R):
-        ring_base = jnp.asarray([len(seq), 0], jnp.int32)  # pos of ring slot 0
+    R = 2  # rounds of 2 ring steps then a flush: exercises both tiers
+    ring = llama.init_ring(cfg, B, R, dtype=jnp.float32)
+    dest = jnp.asarray([0, B], jnp.int32)  # slot 1 -> scratch lane
+    for _ in range(3):
+        ring_base = jnp.asarray([len(seq), 0], jnp.int32)
         for s in range(R):
             seq.append(tok)
             tokens = jnp.asarray([tok, 0], jnp.int32)
-            ctx = jnp.asarray([len(seq), 1], jnp.int32)
+            ctx_lens = jnp.asarray([len(seq), 1], jnp.int32)
             ring, logits = llama.decode_step(
-                cfg, params, cache, ring, tokens, ptd, ctx,
+                cfg, params, ctx, ring, tokens, ctx_lens,
                 ring_base, jnp.int32(s),
             )
             ref = hf_logits(model, seq)[-1]
             got = np.asarray(logits)[0]
             np.testing.assert_allclose(got, ref, rtol=2e-3, atol=2e-3)
             tok = int(np.argmax(got))
-        cache = llama.flush(
-            cfg, cache, ring, ptd, ring_base,
-            jnp.asarray([R, 0], jnp.int32),
+        ctx = llama.flush_ctx(
+            ctx, ring, dest, ring_base, jnp.asarray([R, 0], jnp.int32),
         )
 
 
@@ -130,20 +120,56 @@ def test_prefix_continuation_matches_hf(pair):
     rng = np.random.RandomState(3)
     full = rng.randint(1, cfg.vocab_size, size=21).tolist()
 
-    cache = llama.init_cache(cfg, num_pages=16, page_size=PAGE, dtype=jnp.float32)
-    pt = np.zeros(MAX_PAGES, np.int32)
-    pt[:3] = [5, 6, 7]
+    ctx = llama.init_ctx(cfg, 1, S_MAX, dtype=jnp.float32)
     # stage 1: the "cached prefix" (16 tokens = 2 pages, page-aligned)
-    cache, _ = llama.prefill(
-        cfg, params, cache,
+    ctx, _ = llama.prefill(
+        cfg, params, ctx,
         jnp.asarray(pad_to(full[:16], PAGE)),
-        jnp.asarray(pt), jnp.int32(0), jnp.int32(16),
+        jnp.int32(0), jnp.int32(0), jnp.int32(16),
     )
     # stage 2: continuation of the remaining 5 tokens
-    cache, logits = llama.prefill(
-        cfg, params, cache,
+    ctx, logits = llama.prefill(
+        cfg, params, ctx,
         jnp.asarray(pad_to(full[16:], PAGE)),
-        jnp.asarray(pt), jnp.int32(16), jnp.int32(21),
+        jnp.int32(0), jnp.int32(16), jnp.int32(21),
+    )
+    ref = hf_logits(model, full)[-1]
+    np.testing.assert_allclose(np.asarray(logits), ref, rtol=2e-3, atol=2e-3)
+
+
+def test_seal_and_reload_roundtrip(pair):
+    """seal_blocks (ctx->pool) then load_ctx_pages (pool->ctx) on another
+    lane must reproduce the continuation logits exactly — the admission/
+    commit data path of the prefix cache."""
+    cfg, model, params = pair
+    rng = np.random.RandomState(5)
+    full = rng.randint(1, cfg.vocab_size, size=21).tolist()
+
+    ctx = llama.init_ctx(cfg, 2, S_MAX, dtype=jnp.float32)
+    cache = llama.init_cache(cfg, num_pages=8, page_size=PAGE,
+                             dtype=jnp.float32)
+    # prefill the 16-token page-aligned prefix on lane 0
+    ctx, _ = llama.prefill(
+        cfg, params, ctx,
+        jnp.asarray(pad_to(full[:16], PAGE)),
+        jnp.int32(0), jnp.int32(0), jnp.int32(16),
+    )
+    # seal its two blocks into pool pages 3 and 4
+    cache = llama.seal_blocks(
+        cache, ctx,
+        jnp.asarray([0, 0], jnp.int32),
+        jnp.asarray([0, PAGE], jnp.int32),
+        jnp.asarray([3, 4], jnp.int32),
+        page_size=PAGE,
+    )
+    # load them into lane 1 and continue there
+    ctx = llama.load_ctx_pages(
+        ctx, cache, jnp.int32(1), jnp.asarray([3, 4], jnp.int32)
+    )
+    ctx, logits = llama.prefill(
+        cfg, params, ctx,
+        jnp.asarray(pad_to(full[16:], PAGE)),
+        jnp.int32(1), jnp.int32(16), jnp.int32(21),
     )
     ref = hf_logits(model, full)[-1]
     np.testing.assert_allclose(np.asarray(logits), ref, rtol=2e-3, atol=2e-3)
@@ -157,21 +183,19 @@ def test_sharded_prefill_matches_unsharded(pair):
     params_sh = jax.tree.map(
         lambda x, s: jax.device_put(x, s), params, shardings
     )
-    cache = llama.init_cache(cfg, num_pages=16, page_size=PAGE, dtype=jnp.float32)
-    cache_sh = jax.tree.map(
+    ctx = llama.init_ctx(cfg, 1, S_MAX, dtype=jnp.float32)
+    ctx_sh = jax.tree.map(
         lambda x, s: jax.device_put(x, s),
-        llama.init_cache(cfg, num_pages=16, page_size=PAGE, dtype=jnp.float32),
-        llama.cache_shardings(cfg, mesh),
+        llama.init_ctx(cfg, 1, S_MAX, dtype=jnp.float32),
+        llama.ctx_shardings(cfg, mesh),
     )
     rng = np.random.RandomState(4)
     prompt = rng.randint(1, cfg.vocab_size, size=10).tolist()
-    pt = np.zeros(MAX_PAGES, np.int32)
-    pt[:2] = [1, 2]
     args = (
-        jnp.asarray(pad_to(prompt, PAGE)), jnp.asarray(pt),
+        jnp.asarray(pad_to(prompt, PAGE)), jnp.int32(0),
         jnp.int32(0), jnp.int32(len(prompt)),
     )
-    _, ref = llama.prefill(cfg, params, cache, *args)
+    _, ref = llama.prefill(cfg, params, ctx, *args)
     with mesh:
-        _, got = llama.prefill(cfg, params_sh, cache_sh, *args)
+        _, got = llama.prefill(cfg, params_sh, ctx_sh, *args)
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=1e-4, atol=1e-4)

@@ -46,20 +46,24 @@ async def test_engine_logprobs_greedy_consistency():
         stop_conditions=StopConditions(max_tokens=6, ignore_eos=True),
         output_options=OutputOptions(logprobs=3),
     )
-    outs = []
+    # outputs may batch several tokens (round-granular emission); the
+    # per-token logprob lists stay aligned with token_ids
+    tokens, lps, tops_all = [], [], []
     async for out in eng.generate(req):
         if out.token_ids:
-            outs.append(out)
-    assert len(outs) == 6
-    for out in outs:
-        assert out.log_probs is not None and len(out.log_probs) == 1
-        assert out.top_logprobs is not None and len(out.top_logprobs) == 1
-        tops = out.top_logprobs[0]
+            assert out.log_probs is not None
+            assert len(out.log_probs) == len(out.token_ids)
+            assert len(out.top_logprobs) == len(out.token_ids)
+            tokens.extend(out.token_ids)
+            lps.extend(out.log_probs)
+            tops_all.extend(out.top_logprobs)
+    assert len(tokens) == 6 and len(lps) == 6
+    for tok_id, lp, tops in zip(tokens, lps, tops_all):
         assert len(tops) == 3
         # greedy: the chosen token IS the top-1 alternative, same logprob
-        assert tops[0][0] == out.token_ids[0]
-        assert abs(tops[0][1] - out.log_probs[0]) < 1e-5
-        assert out.log_probs[0] <= 0.0
+        assert tops[0][0] == tok_id
+        assert abs(tops[0][1] - lp) < 1e-5
+        assert lp <= 0.0
         # top list is sorted descending
         assert tops[0][1] >= tops[1][1] >= tops[2][1]
 

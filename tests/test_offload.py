@@ -224,7 +224,10 @@ async def test_offload_evict_onboard_bit_exact(setup):
     assert len(eng.offload) >= 3
 
     # pressure: different prompts large enough to evict A's blocks from HBM
-    for base in (100, 200, 300):
+    # (active requests hold no pool pages in the round-4 layout, so the
+    # pressure must come entirely from committed prefix blocks: 4 prompts
+    # x 3 blocks > the 12-page pool)
+    for base in (100, 200, 300, 400):
         await collect(eng, req_for(list(range(base, base + 49))))
     from dynamo_tpu.tokens import TokenBlockSequence
 

@@ -66,12 +66,10 @@ def test_sp_prefill_matches_single_device():
     toks = np.zeros(T, np.int32)
     toks[: len(prompt)] = prompt
 
-    # reference: single-device paged prefill (pages 1..4 cover 64 tokens)
-    ps = 16
-    cache = llama.init_cache(cfg, 8, ps, jnp.float32)
-    table = np.asarray([1, 2, 3, 4], np.int32)
+    # reference: single-device contiguous-ctx prefill
+    ctx = llama.init_ctx(cfg, 1, T, jnp.float32)
     _, ref_logits = llama.prefill(
-        cfg, params, cache, jnp.asarray(toks), jnp.asarray(table),
+        cfg, params, ctx, jnp.asarray(toks), jnp.int32(0),
         jnp.int32(0), jnp.int32(len(prompt)),
     )
 

@@ -1,0 +1,115 @@
+"""Decompose the fused engine round at bench shapes on the real chip.
+
+Times engine_round at (B=32, S_max=1024) with: the serving chunk config,
+a bigger chunk, no-flush, and flush-only — to attribute device ms/step.
+Run: PYTHONPATH=/root/.axon_site:/root/repo python tools/profile_round.py
+"""
+from __future__ import annotations
+
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dynamo_tpu.engine import sampling
+from dynamo_tpu.models import llama
+from dynamo_tpu.models.config import ModelConfig
+
+N = 16
+B, S = 32, 1024
+CTX = 356
+
+
+def timeit(name, fn, state, reps=5):
+    out = fn(*state)
+    jax.block_until_ready(out)
+    state = (out[0], out[1], *state[2:])
+    t0 = time.monotonic()
+    for _ in range(reps):
+        out = fn(*state)
+        state = (out[0], out[1], *state[2:])
+        jax.block_until_ready(out)
+    dt = (time.monotonic() - t0) / reps
+    print(f"{name:34s} {dt * 1e3 / N:8.3f} ms/step  ({dt * 1e3:8.2f} ms/round)")
+
+
+def main():
+    c = ModelConfig.llama3_1b()
+    params = jax.device_put(llama.init_params(c, 0))
+
+    def make_state():
+        ctx_kv = jax.device_put(llama.init_ctx(c, B, S, jnp.bfloat16))
+        ring = jax.device_put(llama.init_ring(c, B, N, jnp.bfloat16))
+        return ctx_kv, ring
+
+    tokens = jnp.ones(B, jnp.int32)
+    ctx0 = jnp.full((B,), CTX, jnp.int32)
+    dest = jnp.arange(B, dtype=jnp.int32)
+
+    import dynamo_tpu.ops.flash_decode as fd
+    from dynamo_tpu.ops import attention as attn_mod
+
+    def make_round(chunk, with_flush=True):
+        # thread chunk for real: decode_step_impl reaches the kernel
+        # through ctx_decode_attention, which uses the kernel's default —
+        # wrap it (mutating fd.DEFAULT_CHUNK after import would be a no-op:
+        # the default was bound at def time)
+        attn_mod.USE_PALLAS = True
+
+        def attend(q, ck, cv, rk, rv, layer, ctx, base):
+            return fd.flash_decode_attention(
+                q, ck, cv, rk, rv, layer, ctx, base, chunk=chunk)
+
+        attn_mod.ctx_decode_attention = attend
+        import dynamo_tpu.models.llama as llama_mod
+        llama_mod.ctx_decode_attention = attend
+        @functools.partial(jax.jit, donate_argnums=(0, 1))
+        def rnd(ctx_kv, ring, tokens, ctx, dest):
+            ring_base = jnp.maximum(ctx - 1, 0)
+
+            def body(s, carry):
+                ring, toks, cl = carry
+                ring, logits = llama.decode_step_impl(
+                    c, params, ctx_kv, ring, toks, cl, ring_base, s)
+                toks = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                return ring, toks, cl + 1
+
+            ring, toks, cl = jax.lax.fori_loop(
+                0, N, body, (ring, tokens, ctx))
+            if with_flush:
+                new_ctx = llama.flush_ctx_impl(
+                    ctx_kv, ring, dest, ring_base,
+                    jnp.full((B,), N, jnp.int32))
+            else:
+                new_ctx = ctx_kv
+            return new_ctx, ring, toks
+
+        return rnd
+
+    for chunk in (256, 512, 1024):
+        fd.DEFAULT_CHUNK = chunk
+        st = make_state()
+        timeit(f"round chunk={chunk} +flush", make_round(chunk),
+               (st[0], st[1], tokens, ctx0, dest))
+
+    fd.DEFAULT_CHUNK = 512
+    st = make_state()
+    timeit("round chunk=512 NO flush", make_round(512, with_flush=False),
+           (st[0], st[1], tokens, ctx0, dest))
+
+    # flush alone
+    st = make_state()
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def flush_only(ctx_kv, ring, dest, base):
+        return llama.flush_ctx_impl(ctx_kv, ring, dest, base,
+                                    jnp.full((B,), N, jnp.int32)), ring
+
+    timeit("flush only", flush_only,
+           (st[0], st[1], dest, ctx0 - 1))
+
+
+if __name__ == "__main__":
+    main()
