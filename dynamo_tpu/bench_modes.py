@@ -1,0 +1,116 @@
+"""Secondary benchmark modes (BASELINE configs beyond single-chip agg).
+
+``routing`` — the KV-aware-routing TTFT experiment (the reference's
+headline "3x TTFT improvement from KV-aware routing",
+docs/architecture/architecture.md:91): a multi-turn, shared-prefix
+workload over N mocker workers, KV-aware routing vs random routing,
+reporting mean TTFT for each. Mockers simulate prefill cost proportional
+to the UNCACHED suffix (mocker.py), so routing turns onto warm workers is
+exactly what the experiment measures — CPU-only, seconds to run.
+
+Run standalone (``python -m dynamo_tpu.bench_modes``) or via bench.py,
+which shells out with JAX_PLATFORMS=cpu and merges the JSON fields.
+"""
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+
+import numpy as np
+
+
+async def _drive_ttft(engine_call, req) -> float:
+    t0 = time.monotonic()
+    async for out in engine_call(req):
+        if out.token_ids:
+            return time.monotonic() - t0
+    return time.monotonic() - t0
+
+
+async def routing_experiment(
+    n_workers: int = 3,
+    n_sessions: int = 12,
+    turns: int = 4,
+    prefix_tokens: int = 192,
+    block_size: int = 16,
+) -> dict:
+    """Mean TTFT, KV-aware vs random routing, on a shared-prefix
+    multi-turn workload."""
+    from dynamo_tpu.kv_router.router import KvPushRouter, KvRouter
+    from dynamo_tpu.kv_router.scheduler import KvRouterConfig
+    from dynamo_tpu.mocker import MockerArgs, MockerEngine
+    from dynamo_tpu.protocols.common import (
+        PreprocessedRequest,
+        StopConditions,
+    )
+
+    rng = np.random.RandomState(7)
+
+    def build_fleet():
+        """Fresh fleet + KV-aware push router with events wired in."""
+        router = KvRouter(block_size, KvRouterConfig(router_temperature=0.0))
+        push = KvPushRouter(router)
+        for i in range(n_workers):
+            wid = f"w{i}"
+            eng = MockerEngine(
+                MockerArgs(
+                    num_pages=512, page_size=block_size,
+                    max_decode_slots=16, worker_id=wid,
+                    # realistic-ish ratios, sped up for the harness
+                    prefill_time_per_token_s=0.0005,
+                    decode_time_per_step_s=0.002,
+                    speedup_ratio=10.0,
+                ),
+                on_kv_event=router.indexer.apply_event,
+            )
+            push.add_worker(wid, eng)
+        return push
+
+    def sessions():
+        out = []
+        for s in range(n_sessions):
+            prefix = rng.randint(1, 10_000, size=prefix_tokens).tolist()
+            out.append(prefix)
+        return out
+
+    async def run(mode: str) -> float:
+        push = build_fleet()
+        ttfts = []
+        convs = sessions()
+        for turn in range(turns):
+            for s, prefix in enumerate(convs):
+                # conversation grows each turn (shared prefix + new tail)
+                tail = rng.randint(1, 10_000, size=24).tolist()
+                convs[s] = prefix + tail
+                req = PreprocessedRequest(
+                    token_ids=convs[s],
+                    stop_conditions=StopConditions(max_tokens=8,
+                                                   ignore_eos=True),
+                )
+                if mode == "kv":
+                    ttfts.append(await _drive_ttft(push.generate, req))
+                else:
+                    wid = f"w{rng.randint(n_workers)}"
+                    eng = push.workers[wid]
+                    ttfts.append(await _drive_ttft(eng.generate, req))
+        for eng in push.workers.values():
+            await eng.stop()
+        return float(np.mean(ttfts))
+
+    random_ttft = await run("random")
+    kv_ttft = await run("kv")
+    return {
+        "routing_kv_ttft_ms": round(kv_ttft * 1e3, 2),
+        "routing_random_ttft_ms": round(random_ttft * 1e3, 2),
+        "routing_ttft_speedup": round(random_ttft / max(kv_ttft, 1e-9), 2),
+    }
+
+
+def main():
+    out = asyncio.run(routing_experiment())
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

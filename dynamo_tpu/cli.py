@@ -12,6 +12,9 @@ Subcommands:
         (reference `dynamo serve`): serve graph.yaml
   metrics  standalone Prometheus re-exporter of the worker load plane
         (reference components/metrics): metrics --control-plane HOST:PORT
+  router   standalone KV-router service: find_best endpoint other
+        processes query (reference components/router binary):
+        router --control-plane HOST:PORT
   planner  load-based autoscaler managing a local worker pool
         (reference components/planner): planner --control-plane HOST:PORT
   llmctl   list/add/remove model registrations on the store
@@ -108,6 +111,8 @@ def main(argv: list[str] | None = None) -> int:
             return asyncio.run(serve_main(rest[0]))
         except KeyboardInterrupt:
             return 0
+    if cmd == "router":
+        return _run_router(rest)
     if cmd == "metrics":
         return _run_metrics(rest)
     if cmd == "planner":
@@ -266,6 +271,29 @@ def _run_metrics(rest: list[str]) -> int:
 
     try:
         asyncio.run(run_exporter(args))
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+def _run_router(rest: list[str]) -> int:
+    """Standalone KV-router service (reference components/router binary,
+    src/main.rs:53-77)."""
+    import argparse
+    import asyncio
+
+    p = argparse.ArgumentParser(prog="dynamo-tpu router")
+    p.add_argument("--control-plane", required=True, metavar="HOST:PORT")
+    p.add_argument("--namespace", default="dynamo")
+    p.add_argument("--component", default="backend")
+    p.add_argument("--endpoint-name", default="generate")
+    p.add_argument("--block-size", type=int, default=64)
+    p.add_argument("--router-temperature", type=float, default=0.0)
+    args = p.parse_args(rest)
+    from dynamo_tpu.router_service import run_router
+
+    try:
+        asyncio.run(run_router(args))
     except KeyboardInterrupt:
         pass
     return 0

@@ -703,7 +703,13 @@ class TpuEngine:
         self._dispatch_offloads()
         self._admit()
 
-        active = [i for i, s in enumerate(self._slots) if s is not None]
+        # dispatch only for LIVE requests: a round for finished-awaiting-
+        # release slots is pure garbage work that also queues ahead of the
+        # next arrival's prefill (isolated-TTFT cost on an idling engine)
+        active = [
+            i for i, s in enumerate(self._slots)
+            if s is not None and not s.finished
+        ]
         did_work = bool(self._entries)
         rounds_in_flight = sum(1 for en in self._entries if en.kind == "round")
         if active and rounds_in_flight <= e.max_inflight_rounds:
@@ -726,21 +732,18 @@ class TpuEngine:
         """Dispatch flush_every fused steps + one stacked-token fetch."""
         e = self.ecfg
         n = e.flush_every
+        # `active` is pre-filtered to live (non-finished) slots by _round
         want_lp = any(
-            self._slots[i] is not None
-            and not self._slots[i].finished
-            and self._slots[i].req.output_options.logprobs is not None
+            self._slots[i].req.output_options.logprobs is not None
             for i in active
         )
+
         # plain-greedy rounds skip the full sampler (argmax only). A slot
         # needs the sampler if it samples OR carries penalties — penalties
         # apply to greedy decoding too, and the counts histogram must
         # advance for them to be correct
         def needs_sampler(i: int) -> bool:
-            r = self._slots[i]
-            if r is None or r.finished:
-                return False
-            so = r.req.sampling_options
+            so = self._slots[i].req.sampling_options
             return ((so.temperature or 0.0) > 0.0
                     or (so.frequency_penalty or 0.0) != 0.0
                     or (so.presence_penalty or 0.0) != 0.0
@@ -1112,26 +1115,42 @@ class TpuEngine:
     # ---- processing side (lagged results) ----
 
     def _process_entries(self, block: bool = False) -> None:
+        # first-token / offload entries are independent of round ordering
+        # (a round dispatched before an admission doesn't contain the
+        # request; one dispatched after is behind it in the queue) —
+        # process them as soon as their fetch lands instead of behind up
+        # to max_inflight_rounds stacked round fetches. This is the TTFT
+        # lever: the first token no longer waits out the decode pipeline.
+        remaining = []
+        for entry in self._entries:
+            if entry.kind != "round" and entry.handle.is_ready():
+                self._consume_entry(entry)
+            else:
+                remaining.append(entry)
+        self._entries = remaining
         while self._entries:
             entry = self._entries[0]
             if not block and not entry.handle.is_ready():
                 return
             self._entries.pop(0)
-            data = np.asarray(entry.handle)
-            if entry.kind == "first":
-                lp = None
-                if entry.lp_handle is not None:
-                    chosen, ids, lps = (np.asarray(a) for a in entry.lp_handle)
-                    lp = (float(chosen[0]), ids[0], lps[0])
-                self._process_first(entry.request, int(data[0]), lp)
-            elif entry.kind == "offload":
-                self.offload.put_batch(
-                    entry.hashes, entry.parents,
-                    data[:, :, :, : entry.n_steps],
-                )
-            else:
-                self._process_round(entry, data)
+            self._consume_entry(entry)
             block = False  # only force at most one blocking wait
+
+    def _consume_entry(self, entry: _Entry) -> None:
+        data = np.asarray(entry.handle)
+        if entry.kind == "first":
+            lp = None
+            if entry.lp_handle is not None:
+                chosen, ids, lps = (np.asarray(a) for a in entry.lp_handle)
+                lp = (float(chosen[0]), ids[0], lps[0])
+            self._process_first(entry.request, int(data[0]), lp)
+        elif entry.kind == "offload":
+            self.offload.put_batch(
+                entry.hashes, entry.parents,
+                data[:, :, :, : entry.n_steps],
+            )
+        else:
+            self._process_round(entry, data)
 
     def _lp_payload(self, r: _Request, lp) -> dict:
         """LLMEngineOutput logprob fields for one emitted token."""

@@ -1,0 +1,107 @@
+"""Standalone router component tests (reference components/router
+src/main.rs:53-77): routing as its own runtime service — callers query
+find_best and direct-route themselves."""
+import asyncio
+import json
+
+from dynamo_tpu.kv_router.protocols import (
+    KvCacheEvent,
+    KvEventKind,
+    StoredBlock,
+)
+from dynamo_tpu.mocker import MockerArgs, MockerEngine
+from dynamo_tpu.router_service import RouterService
+from dynamo_tpu.runtime.component import DistributedRuntime
+from dynamo_tpu.runtime.publisher import KV_EVENTS_TOPIC
+from dynamo_tpu.runtime.remote_engine import serve_engine
+from dynamo_tpu.runtime.store import serve_store
+from dynamo_tpu.tokens import TokenBlockSequence
+
+BS = 4
+
+
+async def test_router_service_routes_and_follows_events():
+    server, store = await serve_store(port=0, sweep_interval_s=0.05)
+    port = server.sockets[0].getsockname()[1]
+
+    # two mocker workers on the watched endpoint
+    rts, served = [], []
+    for i in range(2):
+        rt = await DistributedRuntime.connect(port=port)
+        ep = rt.namespace("rs").component("backend").endpoint("generate")
+        s = await serve_engine(
+            ep, MockerEngine(MockerArgs(speedup_ratio=100.0, page_size=BS)),
+            worker_id=f"w{i}",
+        )
+        rts.append(rt)
+        served.append(s)
+
+    rt_router = await DistributedRuntime.connect(port=port)
+    svc = await RouterService(
+        rt_router, namespace="rs", component="backend",
+        endpoint="generate", block_size=BS,
+    ).start()
+
+    rt_client = await DistributedRuntime.connect(port=port)
+    try:
+        # wait until the router sees both workers
+        for _ in range(100):
+            if len(svc.router.sequences._workers) == 2:
+                break
+            await asyncio.sleep(0.02)
+        assert len(svc.router.sequences._workers) == 2
+
+        client = await rt_client.namespace("rs").component(
+            "backend-router"
+        ).endpoint("find_best").client()
+        for _ in range(100):
+            if client.instances:
+                break
+            await asyncio.sleep(0.02)
+
+        async def find_best(tokens, rid="r1"):
+            async for item in client.generate(
+                {"token_ids": tokens, "request_id": rid}
+            ):
+                return item
+
+        tokens = list(range(1, 13))  # 3 blocks
+        out = await find_best(tokens)
+        assert out["worker_id"] in {str(served[0].lease_id),
+                                    str(served[1].lease_id)}
+        assert out["overlap_blocks"] == 0
+
+        # publish KV events claiming worker 0 holds this prefix; routing
+        # must now prefer it with the right overlap count
+        wid0 = str(served[0].lease_id)
+        seq = TokenBlockSequence.from_tokens(tokens, BS, salt="")
+        hashes = seq.block_hashes()
+        parent = 0
+        for i, h in enumerate(hashes):
+            ev = KvCacheEvent(
+                kind=KvEventKind.STORED, worker_id=wid0,
+                parent_hash=parent,
+                blocks=[StoredBlock(block_hash=h)],
+            )
+            await rt_client.kv.publish(
+                f"{KV_EVENTS_TOPIC}.{wid0}", json.dumps(ev.to_dict())
+            )
+            parent = h
+        for _ in range(100):
+            if svc.router.indexer.total_blocks() >= 3:
+                break
+            await asyncio.sleep(0.02)
+
+        out2 = await find_best(tokens, rid="r2")
+        assert out2["worker_id"] == wid0
+        assert out2["overlap_blocks"] == 3
+        assert svc.requests_routed == 2
+    finally:
+        await svc.stop()
+        await rt_client.close()
+        await rt_router.close()
+        for s in served:
+            await s.shutdown()
+        for rt in rts:
+            await rt.close()
+        server.close()
