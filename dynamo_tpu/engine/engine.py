@@ -160,11 +160,29 @@ class TpuEngine:
         rng_seed: int = 0,
         on_kv_event: Optional[Callable[[KvCacheEvent], None]] = None,
         on_metrics: Optional[Callable[[ForwardPassMetrics], None]] = None,
+        on_dispatch: Optional[Callable[[str, dict], None]] = None,
     ):
         self.config = model_config
         self.ecfg = engine_config or EngineConfig()
         self.mesh = mesh or make_mesh(mesh_config)
         self.on_metrics = on_metrics
+        # multihost leader hook: every device dispatch is broadcast to the
+        # follower hosts BEFORE being issued locally (engine/multihost.py).
+        # Followers replay the identical jit sequence; host-offload tiers,
+        # the page-transfer plane, sp prefill and multimodal injection are
+        # single-host features and are rejected below/at their call sites.
+        self.on_dispatch = on_dispatch
+        if on_dispatch is not None:
+            if (self.ecfg.host_offload_pages > 0
+                    or self.ecfg.disk_offload_pages > 0):
+                raise ValueError(
+                    "multihost engine: host/disk offload tiers are "
+                    "single-host features"
+                )
+            if self.ecfg.sp_prefill_threshold is not None:
+                raise ValueError(
+                    "multihost engine: sp prefill is a single-host feature"
+                )
 
         c, e = self.config, self.ecfg
         cache_dtype = jnp.dtype(e.cache_dtype)
@@ -534,6 +552,10 @@ class TpuEngine:
         self._xfer_op("import", page_ids, data)
 
     def _xfer_op(self, kind: str, page_ids: list[int], data) -> Any:
+        if self.on_dispatch is not None and kind in ("export", "import"):
+            raise RuntimeError(
+                "multihost engine: the page transfer plane is single-host"
+            )
         if self._stop.is_set():
             raise RuntimeError("engine stopped")
         if not self._started:
@@ -604,6 +626,13 @@ class TpuEngine:
         call from any thread, concurrent with serving. Bounded by
         max_context: the O(T^2) one-shot attention would otherwise let one
         long input OOM the device serving everyone."""
+        if self.on_dispatch is not None:
+            # llama.encode is an SPMD program over the global mesh; it is
+            # not in the broadcast command set, so dispatching it on the
+            # leader alone would deadlock the cross-host collectives
+            raise RuntimeError(
+                "multihost engine: embeddings are a single-host feature"
+            )
         if not token_ids:
             raise ValueError("empty input")
         if len(token_ids) > self.ecfg.max_context:
@@ -750,6 +779,11 @@ class TpuEngine:
                     or (so.repetition_penalty or 1.0) != 1.0)
 
         want_sample = any(needs_sampler(i) for i in active)
+        if self.on_dispatch is not None:
+            self.on_dispatch("round", {
+                "n_steps": n, "want_lp": want_lp,
+                "want_sample": want_sample,
+            })
         # one fused program: n decode+sample steps + flush (engine_round)
         self.ctx, self.ring, self._dev, stacked, lp_stacked = (
             self._engine_round(
@@ -778,6 +812,14 @@ class TpuEngine:
         clear_slots: list[int] = (),
         admit: Optional[dict[str, Any]] = None,
     ) -> None:
+        if self.on_dispatch is not None:
+            a = dict(admit or {})
+            a.pop("tok", None)  # followers use their own sample_first result
+            if "keys" in a:
+                a["keys"] = np.asarray(a["keys"]).tolist()
+            self.on_dispatch("patch", {
+                "clear_slots": list(clear_slots), "admit": a,
+            })
         B = self._B
         clear = np.zeros(B, bool)
         for s in clear_slots:
@@ -833,6 +875,11 @@ class TpuEngine:
         pages = np.zeros(w, np.int32)  # padding -> scratch page 0
         for i, (s, st, pg) in enumerate(batch):
             slots[i], starts[i], pages[i] = s, st, pg
+        if self.on_dispatch is not None:
+            self.on_dispatch("seal", {
+                "slots": slots.tolist(), "starts": starts.tolist(),
+                "pages": pages.tolist(),
+            })
         self.cache = llama.seal_blocks(
             self.cache, self.ctx,
             jnp.asarray(slots), jnp.asarray(starts), jnp.asarray(pages),
@@ -970,6 +1017,10 @@ class TpuEngine:
                 w = pow2_cover(len(matched_pages))
                 padded = np.zeros(w, np.int32)  # padding -> scratch page 0
                 padded[: len(matched_pages)] = matched_pages
+                if self.on_dispatch is not None:
+                    self.on_dispatch("load_ctx", {
+                        "slot": slot, "pages": padded.tolist(),
+                    })
                 self.ctx = llama.load_ctx_pages(
                     self.ctx, self.cache, jnp.int32(slot),
                     jnp.asarray(padded),
@@ -1006,6 +1057,16 @@ class TpuEngine:
             if msk.any():
                 embeds = jnp.asarray(ov)
                 embeds_mask = jnp.asarray(msk)
+        if self.on_dispatch is not None:
+            if embeds is not None:
+                r.emit(ValueError(
+                    "multimodal requests are single-host only"))
+                self._abort_prefill(r)
+                return "failed"
+            self.on_dispatch("prefill", {
+                "tokens": toks.tolist(), "slot": r.slot,
+                "start": start, "end": start + len(chunk),
+            })
         self.ctx, logits = llama.prefill(
             self.config, self.params, self.ctx,
             jnp.asarray(toks), jnp.int32(r.slot),
@@ -1074,6 +1135,14 @@ class TpuEngine:
             )
             step_keys = nonce
         want_lp = r.req.output_options.logprobs is not None
+        if self.on_dispatch is not None:
+            self.on_dispatch("sample_first", {
+                "key": first_key.tolist(),
+                "temp": float(so.temperature or 0.0),
+                "top_k": int(so.top_k or 0),
+                "top_p": float(so.top_p if so.top_p is not None else 1.0),
+                "want_lp": want_lp,
+            })
         first_tok, first_lp = self._sample_first(
             logits,
             jnp.asarray(first_key),

@@ -1,0 +1,222 @@
+"""Cross-host single-engine controller (BASELINE config 4; reference
+MultiNodeConfig launch/dynamo-run/src/flags.rs:86-101 +
+leader_worker_barrier.rs:137,230 — vLLM uses ray, TRT-LLM uses MPI; the
+TPU-native answer is jax.distributed + SPMD lockstep).
+
+One logical worker backed by N host processes over a single
+``jax.distributed`` mesh:
+
+  - Every host builds the SAME engine state (params from the same seed or
+    checkpoint, ctx/ring/pool) sharded over the GLOBAL mesh.
+  - The LEADER runs the full host scheduler (admission, rounds, seals) and
+    broadcasts every device dispatch as a compact JSON command over the
+    control-plane store's durable per-follower FIFO queues BEFORE issuing
+    it locally.
+  - FOLLOWERS replay the commands in order, issuing the identical jits.
+    XLA's collectives inside the programs (tp/ep shardings span hosts)
+    form the actual lockstep: the leader's device work blocks until every
+    follower dispatches the matching program, so followers can lag on the
+    host side without correctness impact.
+  - Only the leader fetches results / talks to clients — follower hosts
+    never read device data (their shards' contribution flows through the
+    collectives).
+
+Scope: the multihost engine serves the dense/MoE decode+prefill paths;
+host-offload tiers, the page transfer plane, and sp/multimodal prefill
+are single-host features this round (asserted at init).
+
+Bring-up uses the store-backed leader/worker barrier (runtime/barrier.py)
+so all hosts enter the replay loop only after every process has built its
+engine state.
+"""
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import threading
+from typing import Any, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+
+def cmd_queue(namespace: str, engine_id: str, run_id: str,
+              host: int) -> str:
+    # run_id (a fresh uuid per leader incarnation, distributed through the
+    # bring-up barrier) scopes the durable queues: a restarted follower
+    # must never replay a DEAD run's leftover commands onto a fresh engine
+    return f"{namespace}.mh.{engine_id}.{run_id}.cmds.{host}"
+
+
+def leader_key(namespace: str, engine_id: str, run_id: str) -> str:
+    return f"dynamo://{namespace}/mh/{engine_id}/{run_id}/leader"
+
+
+class CommandStream:
+    """Leader-side dispatch broadcaster: thread-safe (the engine loop is a
+    plain thread), pumped onto the runtime's asyncio loop."""
+
+    def __init__(self, kv: Any, loop: asyncio.AbstractEventLoop,
+                 namespace: str, engine_id: str, run_id: str,
+                 n_followers: int):
+        self.kv = kv
+        self.loop = loop
+        self.namespace = namespace
+        self.engine_id = engine_id
+        self.run_id = run_id
+        self.queues = [
+            cmd_queue(namespace, engine_id, run_id, h + 1)
+            for h in range(n_followers)
+        ]
+        self.seq = 0
+        self._err: Optional[BaseException] = None
+
+    async def announce(self, ttl_s: float = 5.0) -> None:
+        """Publish the leader liveness key (lease-bound): followers poll
+        it while idle and exit when the leader is gone."""
+        lease = await self.kv.lease_grant(ttl_s)
+        await self.kv.put(
+            leader_key(self.namespace, self.engine_id, self.run_id),
+            "up", lease=lease.id,
+        )
+
+    def emit(self, op: str, payload: dict) -> None:
+        self.seq += 1
+        raw = json.dumps({"seq": self.seq, "op": op, **payload})
+
+        async def push():
+            try:
+                for q in self.queues:
+                    await self.kv.qpush(q, raw)
+            except BaseException as e:  # noqa: BLE001
+                # surfaced on the NEXT emit; if the leader's device work is
+                # already blocked on a follower that never got this
+                # command, recovery is the liveness teardown (leader key
+                # expiry -> followers exit -> jax runtime collapse)
+                log.exception("multihost command broadcast failed")
+                self._err = e
+
+        asyncio.run_coroutine_threadsafe(push(), self.loop)
+        if self._err is not None:
+            raise RuntimeError(f"command broadcast failed: {self._err}")
+
+
+def make_dispatch_sink(stream: CommandStream):
+    """The TpuEngine on_dispatch hook."""
+
+    def sink(op: str, payload: dict) -> None:
+        stream.emit(op, payload)
+
+    return sink
+
+
+class Follower:
+    """Replays the leader's dispatch stream on this host's engine replica.
+
+    The engine must be constructed with the same configs/params/mesh as
+    the leader's and NEVER started (its host loop stays off); this class
+    drives its jits directly.
+    """
+
+    def __init__(self, engine: Any, kv: Any, namespace: str,
+                 engine_id: str, run_id: str, host_index: int):
+        self.engine = engine
+        self.kv = kv
+        self.queue = cmd_queue(namespace, engine_id, run_id, host_index)
+        self.leader_key = leader_key(namespace, engine_id, run_id)
+        self.commands_applied = 0
+        self._expected_seq = 1
+
+    async def run(self) -> None:
+        """Replay until a `stop` command or leader death (liveness key
+        expiry — a crashed leader must not leave followers holding the
+        jax runtime forever)."""
+        while True:
+            raw = await self.kv.qpop(self.queue, timeout_s=10.0)
+            if raw is None:
+                if await self.kv.get(self.leader_key) is None:
+                    log.warning("multihost leader gone; follower exiting")
+                    return
+                continue
+            cmd = json.loads(raw)
+            seq = cmd.get("seq", -1)
+            if seq != self._expected_seq:
+                raise RuntimeError(
+                    f"command stream gap: expected {self._expected_seq}, "
+                    f"got {seq} — follower state is no longer lockstep"
+                )
+            self._expected_seq += 1
+            if cmd["op"] == "stop":
+                return
+            self.apply(cmd)
+            self.commands_applied += 1
+
+    def apply(self, cmd: dict) -> None:
+        eng = self.engine
+        op = cmd["op"]
+        if op == "round":
+            out = eng._engine_round(
+                eng.params, eng.ctx, eng.ring, eng._dev,
+                cmd["n_steps"], cmd["want_lp"], cmd["want_sample"],
+            )
+            eng.ctx, eng.ring, eng._dev = out[0], out[1], out[2]
+        elif op == "patch":
+            admit = dict(cmd.get("admit") or {})
+            if admit:
+                # the admitted first token is this host's own sample_first
+                # replay result (same program + key -> same token)
+                admit["tok"] = eng._mh_last_first_tok
+                admit["keys"] = np.asarray(admit["keys"], np.uint32)
+            eng._dispatch_patch(
+                clear_slots=cmd.get("clear_slots") or [],
+                admit=admit or None,
+            )
+        elif op == "prefill":
+            from dynamo_tpu.models import llama
+
+            eng.ctx, eng._mh_last_logits = llama.prefill(
+                eng.config, eng.params, eng.ctx,
+                jnp.asarray(np.asarray(cmd["tokens"], np.int32)),
+                jnp.int32(cmd["slot"]),
+                jnp.int32(cmd["start"]), jnp.int32(cmd["end"]),
+            )
+        elif op == "sample_first":
+            toks, _lp = eng._sample_first(
+                eng._mh_last_logits,
+                jnp.asarray(np.asarray(cmd["key"], np.uint32)),
+                jnp.float32(cmd["temp"]),
+                jnp.int32(cmd["top_k"]),
+                jnp.float32(cmd["top_p"]),
+                eng.config.vocab_size,
+                cmd["want_lp"],
+            )
+            eng._mh_last_first_tok = toks
+        elif op == "load_ctx":
+            from dynamo_tpu.models import llama
+
+            eng.ctx = llama.load_ctx_pages(
+                eng.ctx, eng.cache, jnp.int32(cmd["slot"]),
+                jnp.asarray(np.asarray(cmd["pages"], np.int32)),
+            )
+        elif op == "seal":
+            from dynamo_tpu.models import llama
+
+            eng.cache = llama.seal_blocks(
+                eng.cache, eng.ctx,
+                jnp.asarray(np.asarray(cmd["slots"], np.int32)),
+                jnp.asarray(np.asarray(cmd["starts"], np.int32)),
+                jnp.asarray(np.asarray(cmd["pages"], np.int32)),
+                page_size=eng.ecfg.page_size,
+            )
+        else:
+            raise RuntimeError(f"unknown multihost command {op!r}")
+
+
+async def stop_followers(kv: Any, namespace: str, engine_id: str,
+                         run_id: str, n_followers: int, seq: int) -> None:
+    raw = json.dumps({"seq": seq + 1, "op": "stop"})
+    for h in range(n_followers):
+        await kv.qpush(cmd_queue(namespace, engine_id, run_id, h + 1), raw)

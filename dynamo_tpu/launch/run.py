@@ -149,16 +149,21 @@ def multi_host_bootstrap(args) -> None:
                     raise SystemExit(
                         "--leader-addr required on node-rank 0"
                     )
+                import uuid as _uuid
+
+                args._mh_run_id = _uuid.uuid4().hex[:12]
                 lb = LeaderBarrier(kv, barrier_id, args.num_nodes - 1)
                 await lb.sync(_json.dumps({
                     "coordinator": args.leader_addr,
                     "num_nodes": args.num_nodes,
+                    "run_id": args._mh_run_id,
                 }))
                 await lb.close()
                 return args.leader_addr
             wb = WorkerBarrier(kv, barrier_id, f"node-{args.node_rank}")
             data = _json.loads(await wb.sync())
             await wb.close()
+            args._mh_run_id = data.get("run_id", "r0")
             if data["num_nodes"] != args.num_nodes:
                 raise SystemExit(
                     f"node count mismatch: leader says {data['num_nodes']}, "
@@ -178,6 +183,64 @@ def multi_host_bootstrap(args) -> None:
         f"multi-host engine up: node {args.node_rank}/{args.num_nodes}, "
         f"{jax.device_count()} global devices"
     )
+
+
+def _crosshost_prologue(args, cfg, ecfg, params):
+    """Cross-host single-engine wiring. On rank 0, returns the dispatch
+    sink (command broadcaster on its own background loop). On other ranks,
+    builds the engine replica, REPLAYS the leader's commands forever, and
+    exits the process when the stream stops — followers never serve."""
+    import threading
+
+    import jax
+
+    from dynamo_tpu.engine.engine import TpuEngine
+    from dynamo_tpu.engine.multihost import (
+        CommandStream,
+        Follower,
+        make_dispatch_sink,
+    )
+    from dynamo_tpu.parallel.mesh import MeshConfig, make_mesh
+    from dynamo_tpu.runtime.client import KvClient
+
+    host, port = _cp_addr(args)
+    engine_id = f"{args.component}"
+    run_id = getattr(args, "_mh_run_id", "r0")
+    mesh = make_mesh(MeshConfig(tp=args.tensor_parallel_size), jax.devices())
+
+    if args.node_rank == 0:
+        # dedicated loop thread: the engine thread emits commands without
+        # touching the serving loop
+        stream_loop = asyncio.new_event_loop()
+        threading.Thread(
+            target=stream_loop.run_forever, name="mh-cmd-stream", daemon=True
+        ).start()
+        kv = asyncio.run_coroutine_threadsafe(
+            KvClient(host, port).connect(), stream_loop
+        ).result(timeout=30)
+        stream = CommandStream(
+            kv, stream_loop, args.namespace, engine_id, run_id,
+            args.num_nodes - 1,
+        )
+        # leader liveness key: followers exit when it expires
+        asyncio.run_coroutine_threadsafe(
+            stream.announce(), stream_loop
+        ).result(timeout=30)
+        return make_dispatch_sink(stream)
+
+    async def follow() -> None:
+        kv = await KvClient(host, port).connect()
+        engine = TpuEngine(cfg, ecfg, params=params, mesh=mesh)
+        print(
+            f"cross-host follower rank {args.node_rank}: replaying "
+            f"{args.namespace}/{engine_id} run {run_id} dispatch stream"
+        )
+        await Follower(
+            engine, kv, args.namespace, engine_id, run_id, args.node_rank
+        ).run()
+
+    asyncio.run(follow())
+    raise SystemExit(0)
 
 
 def build_chain(args) -> "Any":
@@ -213,25 +276,33 @@ def build_chain(args) -> "Any":
         from dynamo_tpu.parallel.mesh import MeshConfig
 
         local_devices = None
+        cross_host = False
         if args.num_nodes > 1:
             if not args.control_plane:
                 raise SystemExit("--num-nodes > 1 requires --control-plane")
             multi_host_bootstrap(args)
-            # Each rank serves an engine over its OWN chips as an
-            # independent DP replica (discovered + routed via the store) —
-            # the multi-host scale-out story of SURVEY §2.5's DP row.
-            # Cross-host TP inside ONE engine requires every rank to
-            # dispatch identical programs in lockstep (the engine loop is
-            # host-driven), so tp is capped at the local device count.
             import jax
 
             local_devices = jax.local_devices()
-            if args.tensor_parallel_size > len(local_devices):
-                raise SystemExit(
-                    f"--tensor-parallel-size {args.tensor_parallel_size} "
-                    f"exceeds this host's {len(local_devices)} chips; "
-                    "cross-host TP needs lockstep dispatch (not yet wired)"
-                )
+            # tp within one host's chips: each rank is an independent DP
+            # replica (SURVEY §2.5 DP row). tp BEYOND the local chips: ONE
+            # logical engine spans every host — rank 0 runs the scheduler
+            # and broadcasts each dispatch, other ranks replay in lockstep
+            # (engine/multihost.py; BASELINE config 4).
+            cross_host = args.tensor_parallel_size > len(local_devices)
+            if cross_host:
+                if getattr(args, "role", None) in ("decode", "prefill"):
+                    raise SystemExit(
+                        "cross-host TP engines cannot join the disagg "
+                        "data plane (the page transfer plane is "
+                        "single-host); drop --role"
+                    )
+                if args.tensor_parallel_size > jax.device_count():
+                    raise SystemExit(
+                        f"--tensor-parallel-size {args.tensor_parallel_size}"
+                        f" exceeds the {jax.device_count()} global chips"
+                    )
+                local_devices = None  # global mesh
 
         if args.model_path:
             cfg = ModelConfig.from_pretrained(args.model_path)
@@ -255,12 +326,16 @@ def build_chain(args) -> "Any":
             params = llama.load_hf_params(cfg, args.model_path)
         from dynamo_tpu.parallel.mesh import make_mesh
 
+        on_dispatch = None
+        if cross_host:
+            on_dispatch = _crosshost_prologue(args, cfg, ecfg, params)
         engine = TpuEngine(
             cfg, ecfg, params=params,
             mesh=make_mesh(
                 MeshConfig(tp=args.tensor_parallel_size), local_devices
             ) if local_devices is not None else None,
             mesh_config=MeshConfig(tp=args.tensor_parallel_size),
+            on_dispatch=on_dispatch,
         )
     else:
         raise SystemExit(f"unknown engine out={out!r}")
