@@ -1,0 +1,251 @@
+"""GGUF metadata + tokenizer reader (reference lib/llm/src/gguf/:
+content.rs metadata extraction + gguf_tokenizer.rs:587 tokenizer
+conversion). Pure-python reader of the public GGUF v2/v3 container:
+header, typed metadata KV table, and tensor descriptors (tensor DATA is
+not loaded — the reference uses GGUF for model metadata + tokenizer the
+same way).
+
+Provides:
+  - ``read_gguf(path)`` -> (metadata dict, tensor descriptors)
+  - ``config_from_gguf(metadata)`` -> ModelConfig (llama-family keys)
+  - ``GgufTokenizer`` — a faithful SentencePiece-unigram
+    encoder/decoder built from ``tokenizer.ggml.tokens``/``scores``
+    (Viterbi segmentation + byte fallback, the llama tokenizer family's
+    actual algorithm); BPE-style GGUF vocabs are detected and rejected
+    with a clear error rather than approximated.
+"""
+from __future__ import annotations
+
+import struct
+from typing import Any, BinaryIO, Optional
+
+GGUF_MAGIC = b"GGUF"
+
+# metadata value types (spec)
+_T_U8, _T_I8, _T_U16, _T_I16, _T_U32, _T_I32, _T_F32, _T_BOOL = range(8)
+_T_STRING, _T_ARRAY, _T_U64, _T_I64, _T_F64 = 8, 9, 10, 11, 12
+
+_SCALAR_FMT = {
+    _T_U8: "<B", _T_I8: "<b", _T_U16: "<H", _T_I16: "<h",
+    _T_U32: "<I", _T_I32: "<i", _T_F32: "<f", _T_U64: "<Q",
+    _T_I64: "<q", _T_F64: "<d",
+}
+
+
+def _read_fmt(f: BinaryIO, fmt: str):
+    size = struct.calcsize(fmt)
+    data = f.read(size)
+    if len(data) != size:
+        raise ValueError("truncated GGUF file")
+    return struct.unpack(fmt, data)[0]
+
+
+def _read_string(f: BinaryIO) -> str:
+    n = _read_fmt(f, "<Q")
+    if n > 1 << 30:
+        raise ValueError("implausible GGUF string length")
+    return f.read(n).decode("utf-8", errors="replace")
+
+
+def _read_value(f: BinaryIO, vtype: int) -> Any:
+    if vtype in _SCALAR_FMT:
+        return _read_fmt(f, _SCALAR_FMT[vtype])
+    if vtype == _T_BOOL:
+        return bool(_read_fmt(f, "<B"))
+    if vtype == _T_STRING:
+        return _read_string(f)
+    if vtype == _T_ARRAY:
+        etype = _read_fmt(f, "<I")
+        count = _read_fmt(f, "<Q")
+        if count > 1 << 28:
+            raise ValueError("implausible GGUF array length")
+        return [_read_value(f, etype) for _ in range(count)]
+    raise ValueError(f"unknown GGUF value type {vtype}")
+
+
+def read_gguf(path: str) -> tuple[dict[str, Any], list[dict[str, Any]]]:
+    """Parse header + metadata + tensor descriptors (no tensor data)."""
+    with open(path, "rb") as f:
+        if f.read(4) != GGUF_MAGIC:
+            raise ValueError(f"{path}: not a GGUF file")
+        version = _read_fmt(f, "<I")
+        if version not in (2, 3):
+            raise ValueError(f"unsupported GGUF version {version}")
+        n_tensors = _read_fmt(f, "<Q")
+        n_kv = _read_fmt(f, "<Q")
+        metadata: dict[str, Any] = {"gguf.version": version}
+        for _ in range(n_kv):
+            key = _read_string(f)
+            vtype = _read_fmt(f, "<I")
+            metadata[key] = _read_value(f, vtype)
+        tensors = []
+        for _ in range(n_tensors):
+            name = _read_string(f)
+            n_dims = _read_fmt(f, "<I")
+            dims = [_read_fmt(f, "<Q") for _ in range(n_dims)]
+            dtype = _read_fmt(f, "<I")
+            offset = _read_fmt(f, "<Q")
+            tensors.append({
+                "name": name, "dims": dims, "dtype": dtype,
+                "offset": offset,
+            })
+        return metadata, tensors
+
+
+def config_from_gguf(md: dict[str, Any]) -> "Any":
+    """ModelConfig from llama-family GGUF metadata keys."""
+    from dynamo_tpu.models.config import ModelConfig
+
+    arch = md.get("general.architecture", "llama")
+    if arch not in ("llama", "llama2", "llama3"):
+        raise ValueError(f"unsupported GGUF architecture {arch!r}")
+
+    def k(name, default=None):
+        return md.get(f"{arch}.{name}", default)
+
+    heads = int(k("attention.head_count"))
+    emb = int(k("embedding_length"))
+    n_vocab = md.get(f"{arch}.vocab_size")
+    if n_vocab is None:
+        n_vocab = len(md.get("tokenizer.ggml.tokens", []) or [])
+    return ModelConfig(
+        vocab_size=int(n_vocab),
+        hidden_size=emb,
+        intermediate_size=int(k("feed_forward_length")),
+        num_layers=int(k("block_count")),
+        num_heads=heads,
+        num_kv_heads=int(k("attention.head_count_kv", heads)),
+        head_dim=int(k("attention.key_length", emb // heads)),
+        rope_theta=float(k("rope.freq_base", 10000.0)),
+        rms_norm_eps=float(k("attention.layer_norm_rms_epsilon", 1e-5)),
+        max_position_embeddings=int(k("context_length", 8192)),
+    )
+
+
+class GgufTokenizer:
+    """SentencePiece-unigram tokenizer from GGUF vocab tables.
+
+    Encode = Viterbi segmentation maximizing summed piece scores (the SPM
+    algorithm), with byte-fallback pieces (<0xNN>) for uncovered bytes.
+    Decode maps pieces back, translating the U+2581 space marker."""
+
+    SPACE = "▁"
+
+    def __init__(self, tokens: list[str], scores: list[float],
+                 bos_id: Optional[int] = None, eos_id: Optional[int] = None,
+                 add_bos: bool = True, unk_id: int = 0):
+        self.tokens = tokens
+        self.scores = scores
+        self.bos_id = bos_id
+        self.eos_id = eos_id
+        self.add_bos = add_bos and bos_id is not None
+        self.unk_id = unk_id
+        self.piece_to_id = {t: i for i, t in enumerate(tokens)}
+        self.max_piece_len = max((len(t) for t in tokens), default=1)
+        self._byte_ids = {}
+        for i, t in enumerate(tokens):
+            if len(t) == 6 and t.startswith("<0x") and t.endswith(">"):
+                self._byte_ids[int(t[3:5], 16)] = i
+
+    @classmethod
+    def from_metadata(cls, md: dict[str, Any]) -> "GgufTokenizer":
+        model = md.get("tokenizer.ggml.model", "llama")
+        if model not in ("llama", "spm"):
+            raise ValueError(
+                f"GGUF tokenizer model {model!r} is not supported "
+                "(SentencePiece-unigram only; BPE GGUFs need their "
+                "original HF tokenizer)"
+            )
+        tokens = md.get("tokenizer.ggml.tokens")
+        scores = md.get("tokenizer.ggml.scores")
+        if not tokens:
+            raise ValueError("GGUF file carries no tokenizer vocab")
+        if not scores:
+            scores = [0.0] * len(tokens)
+        return cls(
+            list(tokens), [float(s) for s in scores],
+            bos_id=md.get("tokenizer.ggml.bos_token_id"),
+            eos_id=md.get("tokenizer.ggml.eos_token_id"),
+            add_bos=bool(md.get("tokenizer.ggml.add_bos_token", True)),
+            unk_id=int(md.get("tokenizer.ggml.unknown_token_id", 0) or 0),
+        )
+
+    # ---- encode (Viterbi over piece scores) ----
+
+    def _segment(self, text: str) -> list[int]:
+        n = len(text)
+        NEG = -1e18
+        best = [NEG] * (n + 1)
+        back: list[Optional[tuple[int, int]]] = [None] * (n + 1)
+        best[0] = 0.0
+        for i in range(n):
+            if best[i] <= NEG / 2:
+                continue
+            hi = min(n, i + self.max_piece_len)
+            for j in range(i + 1, hi + 1):
+                pid = self.piece_to_id.get(text[i:j])
+                if pid is None:
+                    continue
+                s = best[i] + self.scores[pid]
+                if s > best[j]:
+                    best[j] = s
+                    back[j] = (i, pid)
+            # byte fallback keeps segmentation total (scored far below
+            # any real piece, as SPM does)
+            bts = text[i].encode("utf-8")
+            if all(b in self._byte_ids for b in bts):
+                s = best[i] - 1e6 * len(bts)
+                if s > best[i + 1]:
+                    best[i + 1] = s
+                    back[i + 1] = (i, -1)
+        if back[n] is None:
+            return [self.unk_id]
+        out: list[int] = []
+        pos = n
+        while pos > 0:
+            i, pid = back[pos]
+            if pid == -1:
+                out.extend(reversed([
+                    self._byte_ids[b] for b in text[i:pos].encode("utf-8")
+                ]))
+            else:
+                out.append(pid)
+            pos = i
+        out.reverse()
+        return out
+
+    def encode(self, text: str) -> list[int]:
+        norm = self.SPACE + text.replace(" ", self.SPACE)
+        ids = self._segment(norm)
+        if self.add_bos:
+            return [self.bos_id] + ids
+        return ids
+
+    # ---- decode ----
+
+    def decode(self, ids: list[int]) -> str:
+        parts: list[str] = []
+        pending: list[int] = []
+
+        def flush_bytes():
+            if pending:
+                parts.append(bytes(pending).decode("utf-8",
+                                                   errors="replace"))
+                pending.clear()
+
+        for i in ids:
+            if i in (self.bos_id, self.eos_id):
+                continue
+            t = self.tokens[i] if 0 <= i < len(self.tokens) else ""
+            if len(t) == 6 and t.startswith("<0x") and t.endswith(">"):
+                pending.append(int(t[3:5], 16))
+                continue
+            flush_bytes()
+            parts.append(t.replace(self.SPACE, " "))
+        flush_bytes()
+        text = "".join(parts)
+        return text[1:] if text.startswith(" ") else text
+
+    @property
+    def stop_token_ids(self) -> list[int]:
+        return [self.eos_id] if self.eos_id is not None else []

@@ -253,6 +253,18 @@ def build_chain(args) -> "Any":
     inp, out = _parse_io(args.io)
 
     if args.model_path:
+        # path | cached hub id | .gguf (reference local_model.rs:39; no
+        # downloads — serving hosts have zero egress)
+        from dynamo_tpu.model_resolver import resolve_model
+
+        resolved = resolve_model(args.model_path)
+        if resolved.kind == "gguf":
+            raise SystemExit(
+                "GGUF serving: weights dequantization is not wired yet — "
+                "use `dynamo_tpu.gguf` for metadata/tokenizer and a "
+                "safetensors model dir for serving"
+            )
+        args.model_path = resolved.path
         tok = HfTokenizer.from_dir(args.model_path)
         fmt = PromptFormatter.from_dir(args.model_path)
         name = args.model_name or os.path.basename(args.model_path.rstrip("/"))

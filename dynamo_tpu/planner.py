@@ -125,17 +125,21 @@ class Planner:
         self._pred_streams = make_predictor(self.config.predictor)
 
     async def start(self) -> "Planner":
+        from dynamo_tpu.runtime.tasks import CriticalTask
+
         sub = await self.kv.subscribe(f"{METRICS_TOPIC}.>")
-        self._sub_task = asyncio.get_running_loop().create_task(
-            self._follow(sub)
-        )
-        self._task = asyncio.get_running_loop().create_task(self._loop())
+        # supervised: a dead metrics follower or decide loop must restart,
+        # not silently stop autoscaling (reference utils/task.rs:42)
+        self._sub_task = CriticalTask(
+            lambda: self._follow(sub), "planner-metrics-follow"
+        ).start()
+        self._task = CriticalTask(self._loop, "planner-adjust-loop").start()
         return self
 
     async def stop(self) -> None:
         for t in (self._task, self._sub_task):
             if t is not None:
-                t.cancel()
+                await t.stop()
         self._task = self._sub_task = None
 
     async def _follow(self, sub) -> None:

@@ -58,8 +58,35 @@ class _CommonRequest(BaseModel):
     @field_validator("stop")
     @classmethod
     def _cap_stops(cls, v):
-        if isinstance(v, list) and len(v) > 8:
+        stops = [v] if isinstance(v, str) else (v or [])
+        if len(stops) > 8:
             raise ValueError("at most 8 stop sequences")
+        for s in stops:
+            if not s:
+                raise ValueError("stop sequences must be non-empty")
+            if len(s) > 256:
+                raise ValueError("stop sequences are capped at 256 chars")
+        return v
+
+    @field_validator("seed")
+    @classmethod
+    def _seed_range(cls, v):
+        if v is not None and not (0 <= v < 2**63):
+            raise ValueError("seed must be in [0, 2^63)")
+        return v
+
+    @field_validator("user")
+    @classmethod
+    def _user_len(cls, v):
+        if v is not None and len(v) > 256:
+            raise ValueError("user is capped at 256 chars")
+        return v
+
+    @field_validator("max_tokens", "max_completion_tokens")
+    @classmethod
+    def _max_tokens_cap(cls, v):
+        if v is not None and v > 1_000_000:
+            raise ValueError("max_tokens is capped at 1e6")
         return v
 
     def stop_list(self) -> list[str]:
@@ -107,6 +134,15 @@ class ChatCompletionRequest(_CommonRequest):
     def _nonempty(cls, v):
         if not v:
             raise ValueError("messages must be non-empty")
+        if len(v) > 1024:
+            raise ValueError("at most 1024 messages")
+        allowed = {"system", "developer", "user", "assistant", "tool"}
+        for m in v:
+            if m.role not in allowed:
+                raise ValueError(
+                    f"unknown message role {m.role!r} "
+                    f"(expected one of {sorted(allowed)})"
+                )
         return v
 
 
@@ -114,7 +150,23 @@ class CompletionRequest(_CommonRequest):
     prompt: Union[str, list[str], list[int], list[list[int]]]
     echo: bool = False
     suffix: Optional[str] = None
-    best_of: Optional[int] = None
+    best_of: Optional[int] = Field(default=None, ge=1, le=8)
+
+    @field_validator("prompt")
+    @classmethod
+    def _prompt_valid(cls, v):
+        if v == "" or v == []:
+            raise ValueError("prompt must be non-empty")
+        # token-id prompts: the engine's chained block hashing is uint32
+        flat = []
+        if isinstance(v, list):
+            flat = v if v and isinstance(v[0], int) else [
+                t for sub in v if isinstance(sub, list) for t in sub
+            ]
+        for t in flat:
+            if not (0 <= t < 2**32):
+                raise ValueError("token ids must be in [0, 2^32)")
+        return v
 
 
 class EmbeddingRequest(BaseModel):

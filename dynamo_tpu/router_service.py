@@ -74,19 +74,23 @@ class RouterService:
         self._sync_fleet(
             {str(i.id) for i in self._client.instances.values()}
         )
-        # follow the KV-event plane (all workers of the watched component)
+        # follow the KV-event plane (all workers of the watched component);
+        # supervised — routing quality decays silently if this loop dies
+        # (reference utils/task.rs:42)
+        from dynamo_tpu.runtime.tasks import CriticalTask
+
         sub = await self.rt.kv.subscribe(f"{KV_EVENTS_TOPIC}.>")
-        self._sub_task = asyncio.get_running_loop().create_task(
-            self._follow(sub)
-        )
+        self._sub_task = CriticalTask(
+            lambda: self._follow(sub), "router-kv-events"
+        ).start()
         # serve find_best
         ep = self.rt.namespace(self.namespace).component(
             f"{self.component}-router"
         ).endpoint("find_best")
         self._served = await ep.serve(self._handle, worker_id=self.worker_id)
-        self._sweep_task = asyncio.get_running_loop().create_task(
-            self._sweep_loop()
-        )
+        self._sweep_task = CriticalTask(
+            self._sweep_loop, "router-ttl-sweep"
+        ).start()
         return self
 
     def _sync_fleet(self, fleet: set[str]) -> None:
@@ -150,7 +154,7 @@ class RouterService:
     async def stop(self) -> None:
         for t in (self._sub_task, self._sweep_task):
             if t is not None:
-                t.cancel()
+                await t.stop()
         self._sub_task = self._sweep_task = None
         if self._served is not None:
             await self._served.shutdown()

@@ -1,0 +1,42 @@
+"""Model resolution tests (reference local_model.rs:39): local dir, GGUF
+file, cached hub id, and the zero-egress error path."""
+import os
+
+import pytest
+
+from dynamo_tpu.model_resolver import resolve_model
+
+
+def test_local_dir(tmp_path):
+    d = tmp_path / "model"
+    d.mkdir()
+    r = resolve_model(str(d))
+    assert r.kind == "dir" and r.path == str(d)
+
+
+def test_gguf_file(tmp_path):
+    p = tmp_path / "m.gguf"
+    p.write_bytes(b"GGUF")
+    r = resolve_model(str(p))
+    assert r.kind == "gguf"
+
+
+def test_cached_hub_id(tmp_path, monkeypatch):
+    snap = tmp_path / "hub" / "models--org--name" / "snapshots" / "abc123"
+    snap.mkdir(parents=True)
+    (snap / "config.json").write_text("{}")
+    monkeypatch.setenv("HF_HUB_CACHE", str(tmp_path / "hub"))
+    r = resolve_model("org/name")
+    assert r.kind == "dir" and r.path == str(snap)
+
+
+def test_uncached_hub_id_errors_with_guidance(tmp_path, monkeypatch):
+    monkeypatch.setenv("HF_HUB_CACHE", str(tmp_path / "empty"))
+    monkeypatch.setenv("HF_HOME", str(tmp_path / "empty2"))
+    with pytest.raises(FileNotFoundError, match="no egress"):
+        resolve_model("org/missing-model")
+
+
+def test_bogus_path_errors():
+    with pytest.raises(FileNotFoundError, match="does not exist"):
+        resolve_model("/no/such/dir")

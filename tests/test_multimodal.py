@@ -192,3 +192,69 @@ async def test_multimodal_over_distributed_runtime(setup):
         await rt_dec.close()
         await rt_enc.close()
         server.close()
+
+
+async def test_multimodal_http_image_lowering(setup):
+    """HTTP surface: a chat message with an image content part is lowered
+    to placeholder tokens + encode-worker payload by the preprocessor,
+    resolved by the MultimodalEngine, and served end to end."""
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from dynamo_tpu.backend import Backend
+    from dynamo_tpu.frontend import HttpService, ModelChain, ModelManager
+    from dynamo_tpu.preprocessor import OpenAIPreprocessor, PromptFormatter
+    from dynamo_tpu.tokenizer import make_test_tokenizer
+
+    cfg, vcfg, params, vparams, ecfg = setup
+    tok = make_test_tokenizer([f"w{i}" for i in range(60)])
+    fmt = PromptFormatter(
+        template="{% for m in messages %}{{ m.content }}{% endfor %}"
+    )
+    inner = TpuEngine(cfg, ecfg, params=params, mesh_config=MeshConfig(tp=1))
+    eng = MultimodalEngine(inner, local_encoder=EncodeWorker(None, vcfg, vparams))
+    chain = ModelChain(
+        name="mm",
+        preprocessor=OpenAIPreprocessor(
+            tokenizer=tok, formatter=fmt, model_name="mm",
+            image_token_id=IMG_TOK, image_token_count=vcfg.num_patches,
+        ),
+        engine=eng,
+        backend=Backend(tok),
+    )
+    manager = ModelManager()
+    manager.register(chain)
+    svc = HttpService(manager)
+    client = TestClient(TestServer(svc.app))
+    await client.start_server()
+    try:
+        img = image(3)
+        payload = encode_image_payload(img)
+        r = await client.post("/v1/chat/completions", json={
+            "model": "mm",
+            "messages": [{"role": "user", "content": [
+                {"type": "text", "text": "w1 w2 "},
+                {"type": "image_data", "data": payload["data"],
+                 "shape": payload["shape"]},
+                {"type": "text", "text": " w3"},
+            ]}],
+            "max_tokens": 5,
+        })
+        assert r.status == 200
+        body = await r.json()
+        assert body["usage"]["completion_tokens"] == 5
+        # prompt tokens include the placeholder run
+        assert body["usage"]["prompt_tokens"] >= vcfg.num_patches + 3
+        assert eng.images_resolved == 1
+        # non-data image URLs are rejected (zero-egress host)
+        r2 = await client.post("/v1/chat/completions", json={
+            "model": "mm",
+            "messages": [{"role": "user", "content": [
+                {"type": "image_url",
+                 "image_url": {"url": "https://example.com/x.png"}},
+            ]}],
+            "max_tokens": 2,
+        })
+        assert r2.status == 400
+    finally:
+        await client.close()
+        await eng.stop()

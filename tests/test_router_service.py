@@ -92,10 +92,17 @@ async def test_router_service_routes_and_follows_events():
                 break
             await asyncio.sleep(0.02)
 
-        out2 = await find_best(tokens, rid="r2")
-        assert out2["worker_id"] == wid0
-        assert out2["overlap_blocks"] == 3
-        assert svc.requests_routed == 2
+        # events settle asynchronously; poll until the routing reflects
+        # the warm worker (slow-1-core-box tolerance)
+        out2 = None
+        for i in range(50):
+            out2 = await find_best(tokens, rid=f"r2-{i}")
+            if out2["overlap_blocks"] == 3:
+                break
+            await asyncio.sleep(0.05)
+        assert out2["worker_id"] == wid0, out2
+        assert out2["overlap_blocks"] == 3, out2
+        assert svc.requests_routed >= 2
     finally:
         await svc.stop()
         await rt_client.close()

@@ -1,0 +1,87 @@
+"""Supervised critical tasks (reference utils/task.rs:42): restart with
+backoff, budget exhaustion, clean stop."""
+import asyncio
+
+from dynamo_tpu.runtime.tasks import CriticalTask
+
+
+async def test_restarts_with_backoff_then_recovers():
+    runs = []
+
+    async def flaky():
+        runs.append(1)
+        if len(runs) < 3:
+            raise RuntimeError("boom")
+        await asyncio.sleep(30)  # healthy long-runner
+
+    t = CriticalTask(flaky, "t", backoff_base_s=0.01).start()
+    for _ in range(200):
+        if len(runs) >= 3:
+            break
+        await asyncio.sleep(0.01)
+    assert len(runs) == 3 and t.running
+    assert t.restarts == 2
+    await t.stop()
+    assert not t.running
+
+
+async def test_gives_up_after_budget():
+    gave_up = []
+
+    async def always_fails():
+        raise RuntimeError("nope")
+
+    t = CriticalTask(
+        always_fails, "t", max_restarts=2, backoff_base_s=0.01,
+        on_give_up=gave_up.append,
+    ).start()
+    for _ in range(200):
+        if gave_up:
+            break
+        await asyncio.sleep(0.01)
+    assert len(gave_up) == 1
+    assert t.failures == 3  # initial + 2 restarts
+
+
+async def test_clean_completion_not_restarted():
+    runs = []
+
+    async def once():
+        runs.append(1)
+
+    t = CriticalTask(once, "t").start()
+    await asyncio.sleep(0.05)
+    assert runs == [1] and not t.running
+
+
+async def test_planner_and_router_loops_supervised():
+    """The adopting components expose supervised handles."""
+    from dynamo_tpu.runtime.store import serve_store
+    from dynamo_tpu.runtime.component import DistributedRuntime
+    from dynamo_tpu.router_service import RouterService
+    from dynamo_tpu.planner import Planner, PlannerConfig
+    from dynamo_tpu.runtime.client import KvClient
+
+    server, _ = await serve_store(port=0, sweep_interval_s=0.05)
+    port = server.sockets[0].getsockname()[1]
+    rt = await DistributedRuntime.connect(port=port)
+    svc = await RouterService(rt, namespace="sv").start()
+    assert svc._sub_task.running and svc._sweep_task.running
+    await svc.stop()
+
+    kv = await KvClient(port=port).connect()
+
+    class _Conn:
+        def current_replicas(self):
+            return 1
+
+        async def set_replicas(self, n):
+            pass
+
+    planner = await Planner(kv, _Conn(),
+                            PlannerConfig(adjustment_interval_s=0.05)).start()
+    assert planner._task.running and planner._sub_task.running
+    await planner.stop()
+    await kv.close()
+    await rt.close()
+    server.close()
