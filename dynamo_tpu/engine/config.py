@@ -14,17 +14,22 @@ def _default_buckets() -> tuple[int, ...]:
 class EngineConfig:
     """Knobs of the continuous-batching TPU engine."""
 
-    # paged KV
-    num_pages: int = 512          # total pages incl. reserved page 0
+    # prefix-cache pool (round-4 layout: the paged pool is prefix-cache
+    # STORAGE; the serving context is a contiguous per-slot region —
+    # models/llama.py module doc)
+    num_pages: int = 512          # pool capacity incl. reserved page 0
     page_size: int = 64           # tokens per page (also the router block size)
-    max_pages_per_seq: int = 64   # static page-table width = max context/page_size
+    # per-slot context capacity in pages: max_context = this * page_size
+    # (sizes the contiguous ctx region, (slots+1) * max_context * kv)
+    max_pages_per_seq: int = 64
 
     # batching
     max_decode_slots: int = 8     # fixed decode batch width
     prefill_buckets: tuple[int, ...] = field(default_factory=_default_buckets)
 
-    # pipelining: steps per dispatched round (one stacked token fetch per
-    # round) and rounds allowed in flight before the loop blocks on results.
+    # pipelining: steps per dispatched round (one fused jit + one stacked
+    # token fetch + one ring->ctx flush per round) and rounds allowed in
+    # flight before the loop blocks on results.
     # Effective host lag = flush_every * (max_inflight_rounds + 1) steps —
     # finished requests garbage-decode for up to that many steps, so raise
     # these only when D2H latency is high relative to step time.

@@ -317,9 +317,9 @@ class HttpService:
         accounting (requests_total/duration), 499 on cancellation.
         `fn(body, env)` does the endpoint-specific work and sets
         env["model"] as soon as it is known."""
-        env = {"model": ""}
+        env = {"model": "", "t0": time.monotonic()}
         status = "500"
-        t0 = time.monotonic()
+        t0 = env["t0"]
         try:
             try:
                 body = await request.json()
@@ -455,7 +455,8 @@ class HttpService:
             try:
                 if req.stream:
                     return await self._stream_response(
-                        request, req, chain, pre, chat)
+                        request, req, chain, pre, chat,
+                        t_received=env["t0"])
                 return await self._unary_response(req, chain, pre, chat)
             finally:
                 self.metrics.inflight.labels(req.model).dec()
@@ -559,7 +560,8 @@ class HttpService:
         return web.json_response(body)
 
     async def _stream_response(
-        self, request: web.Request, req, chain, pre, chat: bool
+        self, request: web.Request, req, chain, pre, chat: bool,
+        t_received: Optional[float] = None,
     ) -> web.StreamResponse:
         resp = web.StreamResponse(
             status=200,
@@ -573,6 +575,19 @@ class HttpService:
         gen = DeltaGenerator(req.model, chat=chat, n=max(1, req.n))
         streams = self._fanout(req, chain, pre)
         completion_tokens = 0
+        # in-band per-request metrics annotation (reference
+        # ANNOTATION_LLM_METRICS, preprocessor.rs:68-90): opt in via
+        # nvext {"annotations": ["llm_metrics"]} — the preprocessor has
+        # already normalized them onto the request
+        want_llm_metrics = "llm_metrics" in pre.annotations
+        # per-stream first/last token times: ITL must be per generation,
+        # not the n-way interleave; TTFT runs from request RECEIPT
+        # (envelope entry — includes preprocess/route time, matching the
+        # reference's measurement point)
+        t_start = t_received if t_received is not None else time.monotonic()
+        t_first: dict[int, float] = {}
+        t_last: dict[int, float] = {}
+        tok_counts: dict[int, int] = {}
         # tool-call detection: hold back tool-shaped text until it parses
         tool_accs: dict[int, Any] = {}
         if chat and getattr(req, "tools", None):
@@ -617,6 +632,10 @@ class HttpService:
                         encode_event({"error": {"message": str(item)}})
                     )
                     continue
+                if item.token_ids:
+                    t_last[i] = time.monotonic()
+                    t_first.setdefault(i, t_last[i])
+                    tok_counts[i] = tok_counts.get(i, 0) + len(item.token_ids)
                 completion_tokens += len(item.token_ids)
                 text = item.text or ""
                 if i in tool_accs and text:
@@ -657,6 +676,22 @@ class HttpService:
                         gen.usage_chunk(len(pre.token_ids), completion_tokens)
                     )
                 )
+            if want_llm_metrics:
+                ttft = (min(t_first.values()) - t_start) if t_first else None
+                itls = [
+                    (t_last[i] - t_first[i]) / (tok_counts[i] - 1)
+                    for i in t_first
+                    if tok_counts.get(i, 0) > 1
+                ]
+                itl = sum(itls) / len(itls) if itls else None
+                await resp.write(encode_event({
+                    "nvext": {"annotation": "llm_metrics", "metrics": {
+                        "prompt_tokens": len(pre.token_ids),
+                        "completion_tokens": completion_tokens,
+                        "ttft_s": round(ttft, 6) if ttft is not None else None,
+                        "itl_avg_s": round(itl, 6) if itl is not None else None,
+                    }}
+                }))
             await resp.write(encode_done())
         except ConnectionResetError:
             # routine client disconnect: not an error; the prepared

@@ -289,3 +289,39 @@ async def test_service_keeps_empty_manager():
     r = await client.get("/v1/models")
     assert [m["id"] for m in (await r.json())["data"]] == ["echo"]
     await client.close()
+
+
+async def test_llm_metrics_annotation_stream():
+    """In-band per-request metrics (reference ANNOTATION_LLM_METRICS):
+    opting in via nvext annotations appends a metrics event to the SSE
+    stream before [DONE]."""
+    client = await with_client(make_echo_service())
+    r = await client.post(
+        "/v1/chat/completions",
+        json={
+            "model": "echo",
+            "messages": [{"role": "user", "content": "hello world"}],
+            "max_tokens": 2,
+            "stream": True,
+            "nvext": {"annotations": ["llm_metrics"]},
+        },
+    )
+    assert r.status == 200
+    events = await sse_events(r)
+    metric_events = [e.json() for e in events
+                     if not e.is_done and "nvext" in e.data]
+    assert len(metric_events) == 1
+    m = metric_events[0]["nvext"]["metrics"]
+    assert m["completion_tokens"] == 2
+    assert m["prompt_tokens"] > 0
+    assert m["ttft_s"] is not None and m["ttft_s"] >= 0
+    # without the annotation: no metrics event
+    r2 = await client.post(
+        "/v1/chat/completions",
+        json={"model": "echo",
+              "messages": [{"role": "user", "content": "hello"}],
+              "max_tokens": 2, "stream": True},
+    )
+    events2 = await sse_events(r2)
+    assert not [e for e in events2 if not e.is_done and "nvext" in e.data]
+    await client.close()
