@@ -135,6 +135,13 @@ def multi_host_bootstrap(args) -> None:
 
     import jax
 
+    # honor JAX_PLATFORMS=cpu even when the axon TPU plugin force-registers
+    # itself ahead of it (it rewrites the platform list to "axon,cpu" —
+    # with jax.distributed, the spurious extra backend corrupts the
+    # coordination-service topology exchange)
+    if "cpu" in os.environ.get("JAX_PLATFORMS", ""):
+        jax.config.update("jax_platforms", "cpu")
+
     from dynamo_tpu.runtime.barrier import LeaderBarrier, WorkerBarrier
     from dynamo_tpu.runtime.client import KvClient
 

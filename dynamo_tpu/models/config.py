@@ -105,6 +105,14 @@ class ModelConfig:
         return cls(**base)
 
     @classmethod
+    def tiny_wide(cls, **kw) -> "ModelConfig":
+        """Toy model with 4 kv heads — shardable to tp=4 (multi-host CPU
+        tests / the cross-host CLI path)."""
+        base = dict(num_kv_heads=4, num_heads=8)
+        base.update(kw)
+        return cls.tiny(**base)
+
+    @classmethod
     def tiny_moe(cls, **kw) -> "ModelConfig":
         """Toy MoE model (8 experts, top-2, dropless) for CPU tests / the
         dryrun — the served stand-in for the reference's wide-EP DeepSeek
