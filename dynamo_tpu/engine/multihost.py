@@ -72,16 +72,25 @@ class CommandStream:
             for h in range(n_followers)
         ]
         self.seq = 0
+        self.lease: Optional[Any] = None
         self._err: Optional[BaseException] = None
 
     async def announce(self, ttl_s: float = 5.0) -> None:
         """Publish the leader liveness key (lease-bound): followers poll
         it while idle and exit when the leader is gone."""
-        lease = await self.kv.lease_grant(ttl_s)
+        self.lease = await self.kv.lease_grant(ttl_s)
         await self.kv.put(
             leader_key(self.namespace, self.engine_id, self.run_id),
-            "up", lease=lease.id,
+            "up", lease=self.lease.id,
         )
+
+    async def close(self) -> None:
+        """Revoke the liveness key (followers see the leader as gone
+        immediately) and stop the keep-alive task."""
+        if self.lease is not None:
+            await self.lease.revoke()
+            self.lease = None
+        await self.kv.close()
 
     def emit(self, op: str, payload: dict) -> None:
         self.seq += 1

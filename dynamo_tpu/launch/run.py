@@ -233,6 +233,35 @@ def _crosshost_prologue(args, cfg, ecfg, params):
         asyncio.run_coroutine_threadsafe(
             stream.announce(), stream_loop
         ).result(timeout=30)
+
+        def teardown() -> None:
+            """Leader-exit discipline: tell followers to stop, then drop
+            the liveness lease (close the kv client so its keep-alive
+            dies). Without this the leader's atexit jax.distributed
+            shutdown barrier waits on followers that are themselves
+            waiting on the still-renewed liveness key — a deadlock that
+            held the old CLI past test timeouts."""
+            from dynamo_tpu.engine.multihost import stop_followers
+
+            try:
+                asyncio.run_coroutine_threadsafe(
+                    stop_followers(
+                        kv, args.namespace, engine_id, run_id,
+                        args.num_nodes - 1, stream.seq,
+                    ),
+                    stream_loop,
+                ).result(timeout=30)
+            finally:
+                # the lease revoke must happen even if the stop push
+                # failed — followers fall back to liveness expiry
+                try:
+                    asyncio.run_coroutine_threadsafe(
+                        stream.close(), stream_loop
+                    ).result(timeout=10)
+                finally:
+                    stream_loop.call_soon_threadsafe(stream_loop.stop)
+
+        args._mh_teardown = teardown
         return make_dispatch_sink(stream)
 
     async def follow() -> None:
@@ -698,11 +727,42 @@ async def _serve_http_dynamic(args) -> None:
         await rt.close()
 
 
+def _shutdown_chain(args, chain) -> None:
+    """Tear the engine + cross-host stream down BEFORE interpreter exit.
+
+    Order matters: stop the engine first (so no further dispatches are
+    broadcast), then the cross-host teardown (stop command + liveness
+    lease drop). Skipping this leaves the engine's daemon thread racing
+    jax's atexit distributed shutdown — the backend cache is cleared
+    mid-round and the next jnp op re-initializes the cpu client, which
+    re-publishes its coordination-service topology key and dies with
+    ALREADY_EXISTS; the liveness lease then deadlocks the shutdown
+    barrier (leader waits for followers; followers wait for the lease)."""
+    try:
+        if chain is not None:
+            stop = getattr(chain.engine, "stop", None)
+            if stop is not None:
+                try:
+                    asyncio.run(stop())
+                except Exception as e:  # noqa: BLE001 - teardown proceeds
+                    print(f"engine stop failed: {e}", file=sys.stderr)
+    finally:
+        # must run even if engine stop is interrupted (a second Ctrl-C):
+        # skipping it reinstates the liveness-lease/atexit deadlock
+        teardown = getattr(args, "_mh_teardown", None)
+        if teardown is not None:
+            try:
+                teardown()
+            except Exception as e:  # noqa: BLE001
+                print(f"cross-host teardown failed: {e}", file=sys.stderr)
+
+
 def run_cli(argv: list[str]) -> int:
     # intermixed: in=/out= positionals may appear between/after flags
     # (graph files and scripts compose argv in any order)
     args = build_parser().parse_intermixed_args(argv)
     inp, _ = _parse_io(args.io)
+    chain = None
     try:
         if inp == "http" and args.control_plane:
             asyncio.run(_serve_http_dynamic(args))
@@ -732,4 +792,6 @@ def run_cli(argv: list[str]) -> int:
             raise SystemExit(f"unknown input in={inp!r}")
     except KeyboardInterrupt:
         pass
+    finally:
+        _shutdown_chain(args, chain)
     return 0
