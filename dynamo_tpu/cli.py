@@ -123,8 +123,61 @@ def main(argv: list[str] | None = None) -> int:
         return _run_profile(rest)
     if cmd == "datagen":
         return _run_datagen(rest)
+    if cmd == "operator":
+        return _run_operator(rest)
     print(f"dynamo-tpu: unknown subcommand {cmd!r}", file=sys.stderr)
     return 2
+
+
+def _run_operator(rest: list[str]) -> int:
+    """Operator-lite: reconcile a store-held serve-graph spec into k8s
+    Deployments/Services (reference deploy/cloud/operator controller).
+    ``--apply graph.yaml`` writes the spec key first, then watches."""
+    import argparse
+    import asyncio
+    import json as _json
+
+    p = argparse.ArgumentParser(prog="dynamo-tpu operator")
+    p.add_argument("--control-plane", required=True, metavar="HOST:PORT")
+    p.add_argument("--namespace", default="dynamo")
+    p.add_argument("--api-base", default=None,
+                   help="k8s API base URL (default: in-cluster)")
+    p.add_argument("--k8s-namespace", default="default")
+    p.add_argument("--image", default="dynamo-tpu:latest")
+    p.add_argument("--resync-s", type=float, default=30.0)
+    p.add_argument("--no-verify-ssl", action="store_true")
+    p.add_argument("--apply", default=None, metavar="GRAPH_FILE",
+                   help="write this graph spec to the store, then watch")
+    args = p.parse_args(rest)
+
+    from dynamo_tpu.k8s import DynamoOperator, graph_key
+    from dynamo_tpu.launch.serve import load_graph
+    from dynamo_tpu.runtime.client import KvClient
+
+    host, _, port = args.control_plane.partition(":")
+
+    async def run() -> None:
+        kv = await KvClient(host or "127.0.0.1", int(port or 7111)).connect()
+        op = DynamoOperator(
+            api_base=args.api_base, k8s_namespace=args.k8s_namespace,
+            image=args.image, resync_s=args.resync_s,
+            verify_ssl=not args.no_verify_ssl,
+        )
+        try:
+            if args.apply:
+                graph = load_graph(args.apply)
+                await kv.put(graph_key(args.namespace), _json.dumps(graph))
+                print(f"graph spec applied to {graph_key(args.namespace)}")
+            await op.run(kv, args.namespace)
+        finally:
+            await op.close()
+            await kv.close()
+
+    try:
+        asyncio.run(run())
+    except KeyboardInterrupt:
+        pass
+    return 0
 
 
 def _run_llmctl(rest: list[str]) -> int:

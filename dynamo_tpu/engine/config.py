@@ -39,6 +39,15 @@ class EngineConfig:
     # round can stall decode behind prompt processing (the ITL-interference
     # problem disagg solves globally; this bounds it locally)
     prefill_chunks_per_round: int = 2
+    # batched multi-request prefill (models/llama.py batch_prefill — the
+    # vLLM max_num_batched_tokens analogue): concurrent same-bucket chunks
+    # run as ONE [K, T] program. K is compiled at
+    # min(prefill_batch_max, prefill_token_budget // T) and short groups
+    # are padded with scratch-lane dummies — one compilation per (T, ctx)
+    # shape instead of one per group size (compiles cost 20-40s on the
+    # tunneled dev chip). 1 disables batching.
+    prefill_batch_max: int = 8
+    prefill_token_budget: int = 8192
 
     # sampling
     max_top_k: int = 64           # static top-k width for top-p/top-k sampling

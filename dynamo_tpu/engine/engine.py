@@ -168,9 +168,10 @@ class TpuEngine:
         self.on_metrics = on_metrics
         # multihost leader hook: every device dispatch is broadcast to the
         # follower hosts BEFORE being issued locally (engine/multihost.py).
-        # Followers replay the identical jit sequence; host-offload tiers,
-        # the page-transfer plane, sp prefill and multimodal injection are
-        # single-host features and are rejected below/at their call sites.
+        # Followers replay the identical jit sequence (incl. the sp ring
+        # prefill, its own command); host-offload tiers, the page-transfer
+        # plane and multimodal injection are single-host features and are
+        # rejected below/at their call sites.
         self.on_dispatch = on_dispatch
         if on_dispatch is not None:
             if (self.ecfg.host_offload_pages > 0
@@ -178,10 +179,6 @@ class TpuEngine:
                 raise ValueError(
                     "multihost engine: host/disk offload tiers are "
                     "single-host features"
-                )
-            if self.ecfg.sp_prefill_threshold is not None:
-                raise ValueError(
-                    "multihost engine: sp prefill is a single-host feature"
                 )
 
         c, e = self.config, self.ecfg
@@ -283,6 +280,12 @@ class TpuEngine:
 
         self._intake: queue_mod.Queue = queue_mod.Queue()
         self._xfer: queue_mod.Queue = queue_mod.Queue()  # page export/import
+        # G4 remote tier: pages fetched from peer pools land here (from
+        # the serving asyncio thread) and drain into the G2 host tier on
+        # the engine loop before admission (kv_transfer.RemoteKvFetcher)
+        self.remote_kv: Any = None
+        self._host_ingest: queue_mod.Queue = queue_mod.Queue()
+        self.remote_onboard_blocks = 0
         self._waiting: list[_Request] = []
         self._entries: list[_Entry] = []
         # sealed blocks awaiting the batched ctx->pool copy:
@@ -295,6 +298,7 @@ class TpuEngine:
         self.step_count = 0
         self.tokens_generated = 0
         self.sp_prefills = 0
+        self.batch_prefills = 0     # batched-prefill dispatches (K >= 2)
 
     # ------------------------------------------------------------------
     # jitted programs
@@ -495,6 +499,8 @@ class TpuEngine:
             loop=asyncio.get_running_loop(),
             tokens=list(request.token_ids),
         )
+        if self.remote_kv is not None and self.offload is not None:
+            await self._remote_prefetch(r)
         self._intake.put(r)
         try:
             while True:
@@ -551,8 +557,19 @@ class TpuEngine:
         """Scatter host pages into the pool (inverse of export_pages)."""
         self._xfer_op("import", page_ids, data)
 
+    def export_pages_by_hash(
+        self, hashes: list[int]
+    ) -> tuple[int, Optional[np.ndarray]]:
+        """G4 serving side: the longest committed run of the chained-hash
+        prefix this pool holds, as (found, pages [2, L, kvh, found, ps,
+        hd]). Thread-safe (serviced by the engine loop like
+        export_pages)."""
+        return self._xfer_op("export_hash", [int(h) for h in hashes], None)
+
     def _xfer_op(self, kind: str, page_ids: list[int], data) -> Any:
-        if self.on_dispatch is not None and kind in ("export", "import"):
+        if self.on_dispatch is not None and kind in (
+            "export", "import", "export_hash",
+        ):
             raise RuntimeError(
                 "multihost engine: the page transfer plane is single-host"
             )
@@ -592,6 +609,18 @@ class TpuEngine:
                 if kind == "export":
                     out = self._gather_padded(ids)
                     box["result"] = np.asarray(out)[:, :, :, : len(ids)]
+                elif kind == "export_hash":
+                    # G4 peer-serving side: ids are chained block hashes;
+                    # resolve the longest committed run, export it, drop
+                    # the refs the match pinned
+                    pages = self.allocator.match_prefix(ids)
+                    if not pages:
+                        box["result"] = (0, None)
+                    else:
+                        out = self._gather_padded(pages)
+                        data = np.asarray(out)[:, :, :, : len(pages)]
+                        self.allocator.free(pages)
+                        box["result"] = (len(pages), data)
                 elif kind == "clear":
                     n = self.allocator.clear()
                     self._offload_cands.clear()  # parked refs now stale
@@ -651,6 +680,17 @@ class TpuEngine:
 
     def metrics(self) -> ForwardPassMetrics:
         a = self.allocator
+        # "gpu cache usage" must reflect LIVE serving occupancy, not the
+        # pool: in the contiguous-ctx design the paged pool holds parked
+        # (refcount-0, reclaimable) prefix blocks, so a.usage() reads ~0
+        # under full decode load and the planner would never scale up.
+        # The live analogue of vLLM's metric is ctx-region token
+        # occupancy, floored by pool pressure.
+        live_tokens = sum(
+            int(self._ctx_disp[i])
+            for i, s in enumerate(self._slots) if s is not None
+        )
+        ctx_usage = live_tokens / float(self._B * self.ecfg.max_context)
         return ForwardPassMetrics(
             worker_id=self.ecfg.worker_id,
             worker_stats=WorkerStats(
@@ -669,7 +709,7 @@ class TpuEngine:
             kv_stats=KvStats(
                 kv_active_blocks=a.active_pages,
                 kv_total_blocks=a.total_pages,
-                gpu_cache_usage_perc=a.usage(),
+                gpu_cache_usage_perc=max(a.usage(), ctx_usage),
                 gpu_prefix_cache_hit_rate=a.hit_rate(),
                 host_blocks=len(self.offload) if self.offload else 0,
                 host_total_blocks=(
@@ -730,6 +770,7 @@ class TpuEngine:
         self._apply_releases()
         self._process_transfers()
         self._dispatch_offloads()
+        self._drain_host_ingest()  # G4 pages land before admission
         self._admit()
 
         # dispatch only for LIVE requests: a round for finished-awaiting-
@@ -939,6 +980,60 @@ class TpuEngine:
         log.debug("onboarded %d blocks from host tier", len(pages))
         return matched_pages + pages
 
+    # ---- G4 remote tier (kv_transfer.RemoteKvFetcher) ----
+
+    async def _remote_prefetch(self, r: _Request) -> None:
+        """Before admission: if the prompt's block-hash run is uncovered
+        by G1/G2/G3, ask peer workers for it (G4). Fetched pages are
+        queued for the engine loop to land in the G2 host tier, where the
+        normal onboard path (_onboard_from_host) picks them up — the
+        remote tier needs no scatter path of its own. Coverage checks
+        here are read-only hints from another thread; a stale answer
+        costs one wasted fetch or one recompute, never correctness."""
+        ps = self.ecfg.page_size
+        blocks = r.seq.blocks
+        matchable = blocks[: max(0, (len(r.tokens) - 1) // ps)]
+        if not matchable:
+            return
+        covered = self.allocator.cached_prefix_len(
+            [b.block_hash for b in matchable]
+        )
+        off = self.offload
+        i = covered
+        while i < len(matchable) and (
+            matchable[i].block_hash in off
+            or (off.spill is not None and matchable[i].block_hash in off.spill)
+        ):
+            i += 1
+        missing = matchable[i:]
+        if not missing:
+            return
+        try:
+            found, data = await self.remote_kv.fetch(
+                [b.block_hash for b in missing]
+            )
+        except Exception:  # noqa: BLE001 — G4 is best-effort
+            log.exception("G4 remote fetch failed")
+            return
+        if not found or data is None:
+            return
+        self._host_ingest.put((
+            [b.block_hash for b in missing[:found]],
+            [b.parent_hash for b in missing[:found]],
+            np.asarray(data, dtype=off.dtype),
+        ))
+
+    def _drain_host_ingest(self) -> None:
+        while True:
+            try:
+                hashes, parents, data = self._host_ingest.get_nowait()
+            except queue_mod.Empty:
+                return
+            if self.offload is None:
+                return
+            n = self.offload.put_batch(hashes, parents, data)
+            self.remote_onboard_blocks += n
+
     # ---- admission / prefill ----
 
     def _admit(self) -> None:
@@ -951,16 +1046,138 @@ class TpuEngine:
         self._waiting = kept
         # bounded prefill budget per round: a long prompt advances one
         # chunk at a time with decode rounds in between (ITL isolation,
-        # the local form of what disagg provides globally)
+        # the local form of what disagg provides globally). Concurrent
+        # same-bucket chunks batch into ONE [K, T] program (batch_prefill)
+        # — the TTFT lever under bursty arrivals.
         budget = max(1, self.ecfg.prefill_chunks_per_round)
         while budget > 0 and self._waiting:
-            r = self._waiting[0]
-            if r.slot < 0 and self._free_slot() is None:
-                return  # no lane to prefill into
-            status = self._prefill_step(r)
-            budget -= 1
-            if status in ("done", "failed"):
-                self._waiting.pop(0)
+            group, width = self._collect_prefill_group(budget)
+            if not group:
+                return  # head is blocked on a free lane
+            if len(group) == 1:
+                r = group[0]
+                status = self._prefill_step(r)
+                budget -= 1
+                if status in ("done", "failed"):
+                    self._waiting.remove(r)
+            else:
+                budget -= len(group)
+                for r in self._batch_prefill_group(group, width):
+                    self._waiting.remove(r)
+
+    def _needs_solo_prefill(self, r: _Request) -> bool:
+        """Paths the batched program doesn't carry: multimodal embedding
+        injection and the sequence-parallel ring prefill."""
+        if (r.req.multimodal or {}).get("embeddings"):
+            return True
+        e = self.ecfg
+        if (r.prefill_pos < 0
+                and e.sp_prefill_threshold is not None
+                and self.mesh.shape.get("sp", 1) > 1):
+            ps = e.page_size
+            hashes = r.seq.block_hashes()
+            matchable = hashes[: max(0, (len(r.tokens) - 1) // ps)]
+            cached = self.allocator.cached_prefix_len(matchable)
+            if len(r.tokens) - cached * ps >= e.sp_prefill_threshold:
+                return True
+        return False
+
+    def _chunk_width(self, remaining: int) -> int:
+        """Padded (bucketed, page-aligned) width of the next chunk for a
+        request with `remaining` unprefilled tokens — mirrors
+        _prefill_step's chunk shape exactly."""
+        e = self.ecfg
+        ps = e.page_size
+        max_chunk = ((e.prefill_buckets[-1] + ps - 1) // ps) * ps
+        pad_t = e.bucket_for(min(remaining, max_chunk)) or max_chunk
+        return ((pad_t + ps - 1) // ps) * ps
+
+    def _collect_prefill_group(
+        self, budget: int
+    ) -> tuple[list[_Request], int]:
+        """Walk the waiting queue head and collect a FIFO prefix of
+        requests whose next chunks share one bucket width (one compiled
+        [K, T] shape). Requests are *begun* (lane + prefix match) as they
+        are considered — a member whose bucket diverges stays begun and
+        leads the next group. Returns (group, T); a solo group routes
+        through the per-request path."""
+        e = self.ecfg
+        group: list[_Request] = []
+        width = 0
+        cap = min(budget, max(1, e.prefill_batch_max))
+        for r in self._waiting:
+            if len(group) >= cap:
+                break
+            if self._needs_solo_prefill(r):
+                break
+            if r.prefill_pos < 0:
+                if self._free_slot() is None:
+                    break
+                self._prefill_begin(r)
+            t = self._chunk_width(len(r.tokens) - r.prefill_pos)
+            if not group:
+                width = t
+                cap = min(cap, max(1, e.prefill_token_budget // t))
+            elif t != width:
+                break
+            group.append(r)
+        if not group and self._waiting:
+            head = self._waiting[0]
+            if self._needs_solo_prefill(head) and (
+                head.prefill_pos >= 0 or self._free_slot() is not None
+            ):
+                return [head], 0
+        return group, width
+
+    def _batch_prefill_group(
+        self, group: list[_Request], width: int
+    ) -> list[_Request]:
+        """Dispatch one batched prefill for the group's next chunks and
+        finish the requests whose prompts complete. The compiled batch
+        width is the CAP for this bucket (not the group size): short
+        groups pad with scratch-lane dummies so each (T, ctx_span) shape
+        compiles once."""
+        e = self.ecfg
+        K = max(len(group),
+                min(e.prefill_batch_max,
+                    max(1, e.prefill_token_budget // width)))
+        toks = np.zeros((K, width), np.int32)
+        slots = np.full(K, self._B, np.int32)   # dummies -> scratch lane
+        q_starts = np.zeros(K, np.int32)
+        seq_lens = np.zeros(K, np.int32)        # dummy seq_len 0: all
+        chunk_lens = []                         # tokens masked out
+        for i, r in enumerate(group):
+            start = r.prefill_pos
+            chunk = r.tokens[start : start + width]
+            toks[i, : len(chunk)] = chunk
+            slots[i] = r.slot
+            q_starts[i] = start
+            seq_lens[i] = start + len(chunk)
+            chunk_lens.append(len(chunk))
+        max_qs = int(q_starts.max())
+        ctx_span = 0
+        if max_qs > 0:
+            ctx_span = min(e.max_context, pow2_cover(max_qs))
+        self.batch_prefills += 1
+        if self.on_dispatch is not None:
+            self.on_dispatch("prefill_batch", {
+                "tokens": toks.tolist(), "slots": slots.tolist(),
+                "q_starts": q_starts.tolist(),
+                "seq_lens": seq_lens.tolist(), "ctx_span": ctx_span,
+            })
+        self.ctx, logits = llama.batch_prefill(
+            self.config, self.params, self.ctx, jnp.asarray(toks),
+            jnp.asarray(slots), jnp.asarray(q_starts),
+            jnp.asarray(seq_lens), ctx_span,
+        )
+        done: list[_Request] = []
+        for i, r in enumerate(group):
+            r.prefill_pos = int(q_starts[i]) + chunk_lens[i]
+            if r.prefill_pos < len(r.tokens):
+                continue  # multi-chunk: next chunk in a later round
+            if self._finish_prefill(r, logits[i], index=i) == "done":
+                done.append(r)
+        return done
 
     def _free_slot(self) -> Optional[int]:
         for i, s in enumerate(self._slots):
@@ -974,6 +1191,39 @@ class TpuEngine:
             del self._prefilling[r.slot]
         r.slot = -1
         r.prefill_pos = -1
+
+    def _prefill_begin(self, r: _Request) -> None:
+        """Start a request's prefill: reserve a lane, prefix-match (HBM,
+        then host tiers) and copy the matched run pool -> ctx. Seals
+        queued by other requests must be flushed first — their pool pages
+        are matchable but the copy may not be dispatched yet."""
+        ps = self.ecfg.page_size
+        prompt = r.tokens
+        self._flush_seals()
+        slot = self._free_slot()
+        assert slot is not None, "caller checks slot availability"
+        r.slot = slot
+        self._prefilling[slot] = r
+        hashes = r.seq.block_hashes()
+        matchable = hashes[: max(0, (len(prompt) - 1) // ps)]
+        matched_pages = self.allocator.match_prefix(matchable)
+        matched_pages = self._onboard_from_host(matchable, matched_pages)
+        r.matched_blocks = len(matched_pages)
+        if matched_pages:
+            w = pow2_cover(len(matched_pages))
+            padded = np.zeros(w, np.int32)  # padding -> scratch page 0
+            padded[: len(matched_pages)] = matched_pages
+            if self.on_dispatch is not None:
+                self.on_dispatch("load_ctx", {
+                    "slot": slot, "pages": padded.tolist(),
+                })
+            self.ctx = llama.load_ctx_pages(
+                self.ctx, self.cache, jnp.int32(slot),
+                jnp.asarray(padded),
+            )
+            # copy dispatched — device order lets us drop the refs now
+            self.allocator.free(matched_pages)
+        r.prefill_pos = len(matched_pages) * ps
 
     def _prefill_step(self, r: _Request) -> str:
         """Advance one prefill chunk; on the final chunk, sample the first
@@ -999,35 +1249,7 @@ class TpuEngine:
                 return self._sp_prefill_full(r)
 
         if r.prefill_pos < 0:
-            # start: reserve a lane, then prefix match (HBM, then host
-            # tiers) and copy the matched run pool -> ctx. Seals queued by
-            # other requests must be flushed first — their pool pages are
-            # matchable but the copy may not be dispatched yet.
-            self._flush_seals()
-            slot = self._free_slot()
-            assert slot is not None, "caller checks slot availability"
-            r.slot = slot
-            self._prefilling[slot] = r
-            hashes = r.seq.block_hashes()
-            matchable = hashes[: max(0, (len(prompt) - 1) // ps)]
-            matched_pages = self.allocator.match_prefix(matchable)
-            matched_pages = self._onboard_from_host(matchable, matched_pages)
-            r.matched_blocks = len(matched_pages)
-            if matched_pages:
-                w = pow2_cover(len(matched_pages))
-                padded = np.zeros(w, np.int32)  # padding -> scratch page 0
-                padded[: len(matched_pages)] = matched_pages
-                if self.on_dispatch is not None:
-                    self.on_dispatch("load_ctx", {
-                        "slot": slot, "pages": padded.tolist(),
-                    })
-                self.ctx = llama.load_ctx_pages(
-                    self.ctx, self.cache, jnp.int32(slot),
-                    jnp.asarray(padded),
-                )
-                # copy dispatched — device order lets us drop the refs now
-                self.allocator.free(matched_pages)
-            r.prefill_pos = len(matched_pages) * ps
+            self._prefill_begin(r)
 
         # one page-aligned continuation chunk (q_start advances); only the
         # final chunk's logits matter
@@ -1100,6 +1322,10 @@ class TpuEngine:
         pad = -len(prompt) % sp_n
         toks = np.zeros(len(prompt) + pad, np.int32)
         toks[: len(prompt)] = prompt
+        if self.on_dispatch is not None:
+            self.on_dispatch("sp_prefill", {
+                "tokens": toks.tolist(), "slot": slot, "n": len(prompt),
+            })
         kv, logits = llama.sp_prefill(
             self.config, self.params,
             sp_shard(jnp.asarray(toks), self.mesh),
@@ -1111,9 +1337,11 @@ class TpuEngine:
         self.sp_prefills += 1
         return self._finish_prefill(r, logits)
 
-    def _finish_prefill(self, r: _Request, logits) -> str:
+    def _finish_prefill(self, r: _Request, logits, index: int = None) -> str:
         """Shared prefill tail: commit prompt blocks, sample the first
-        token on device, activate the slot."""
+        token on device, activate the slot. `index` is the request's row
+        when `logits` was sliced from a batched prefill — broadcast so
+        followers slice their own replayed [K, V] logits identically."""
         prompt = r.tokens
         # copy-commit complete prompt blocks beyond the match into the
         # prefix cache
@@ -1142,6 +1370,7 @@ class TpuEngine:
                 "top_k": int(so.top_k or 0),
                 "top_p": float(so.top_p if so.top_p is not None else 1.0),
                 "want_lp": want_lp,
+                "index": index,
             })
         first_tok, first_lp = self._sample_first(
             logits,

@@ -21,9 +21,11 @@ One logical worker backed by N host processes over a single
     never read device data (their shards' contribution flows through the
     collectives).
 
-Scope: the multihost engine serves the dense/MoE decode+prefill paths;
-host-offload tiers, the page transfer plane, and sp/multimodal prefill
-are single-host features this round (asserted at init).
+Scope: the multihost engine serves the dense/MoE decode+prefill paths,
+batched prefill, and the sp ring prefill (its own broadcast command);
+host-offload tiers, the page transfer plane, and multimodal injection
+remain single-host (asserted at init) — they materialize host copies of
+device arrays, which a multi-process mesh shards across hosts.
 
 Bring-up uses the store-backed leader/worker barrier (runtime/barrier.py)
 so all hosts enter the replay loop only after every process has built its
@@ -74,6 +76,9 @@ class CommandStream:
         self.seq = 0
         self.lease: Optional[Any] = None
         self._err: Optional[BaseException] = None
+        self._pending: list[str] = []
+        self._lock = threading.Lock()
+        self._flushing = False
 
     async def announce(self, ttl_s: float = 5.0) -> None:
         """Publish the leader liveness key (lease-bound): followers poll
@@ -84,6 +89,17 @@ class CommandStream:
             "up", lease=self.lease.id,
         )
 
+    async def drain(self) -> None:
+        """Wait until every emitted command is on the wire (call before
+        pushing an out-of-band stop: a stop overtaking a pending batch
+        would open a seq gap on the followers)."""
+        while True:
+            with self._lock:
+                idle = not self._pending and not self._flushing
+            if idle or self._err is not None:
+                return
+            await asyncio.sleep(0.005)
+
     async def close(self) -> None:
         """Revoke the liveness key (followers see the leader as gone
         immediately) and stop the keep-alive task."""
@@ -93,24 +109,58 @@ class CommandStream:
         await self.kv.close()
 
     def emit(self, op: str, payload: dict) -> None:
-        self.seq += 1
-        raw = json.dumps({"seq": self.seq, "op": op, **payload})
-
-        async def push():
-            try:
-                for q in self.queues:
-                    await self.kv.qpush(q, raw)
-            except BaseException as e:  # noqa: BLE001
-                # surfaced on the NEXT emit; if the leader's device work is
-                # already blocked on a follower that never got this
-                # command, recovery is the liveness teardown (leader key
-                # expiry -> followers exit -> jax runtime collapse)
-                log.exception("multihost command broadcast failed")
-                self._err = e
-
-        asyncio.run_coroutine_threadsafe(push(), self.loop)
+        """Thread-safe. Commands are COALESCED: every emit appends to a
+        pending batch, and one flush task per wakeup of the stream loop
+        drains the whole batch as a single array frame per follower,
+        pushed to all followers CONCURRENTLY — per round the leader pays
+        one store round-trip, not #commands x #followers (the v5p-64
+        scaling concern: 31 followers, several commands per round)."""
+        with self._lock:
+            self.seq += 1
+            raw = json.dumps({"seq": self.seq, "op": op, **payload})
+            self._pending.append(raw)
+        self.loop.call_soon_threadsafe(self._schedule_flush)
         if self._err is not None:
             raise RuntimeError(f"command broadcast failed: {self._err}")
+
+    def _schedule_flush(self) -> None:
+        # stream-loop thread: one flush task at a time keeps per-queue
+        # FIFO order (batches are drained in emit order)
+        if self._flushing:
+            return
+        self._flushing = True
+        asyncio.ensure_future(self._flush(), loop=self.loop)
+
+    async def _flush(self) -> None:
+        try:
+            while True:
+                with self._lock:
+                    batch = self._pending
+                    self._pending = []
+                if not batch:
+                    return
+                frame = (
+                    batch[0] if len(batch) == 1
+                    else "[" + ",".join(batch) + "]"
+                )
+                try:
+                    await asyncio.gather(*[
+                        self.kv.qpush(q, frame) for q in self.queues
+                    ])
+                except BaseException as e:  # noqa: BLE001
+                    # surfaced on the NEXT emit; if the leader's device
+                    # work is already blocked on a follower that never got
+                    # this batch, recovery is the liveness teardown
+                    # (leader key expiry -> followers exit -> jax runtime
+                    # collapse)
+                    log.exception("multihost command broadcast failed")
+                    self._err = e
+                    return
+        finally:
+            self._flushing = False
+            with self._lock:
+                if self._pending and self._err is None:
+                    self._schedule_flush()
 
 
 def make_dispatch_sink(stream: CommandStream):
@@ -150,18 +200,22 @@ class Follower:
                     log.warning("multihost leader gone; follower exiting")
                     return
                 continue
-            cmd = json.loads(raw)
-            seq = cmd.get("seq", -1)
-            if seq != self._expected_seq:
-                raise RuntimeError(
-                    f"command stream gap: expected {self._expected_seq}, "
-                    f"got {seq} — follower state is no longer lockstep"
-                )
-            self._expected_seq += 1
-            if cmd["op"] == "stop":
-                return
-            self.apply(cmd)
-            self.commands_applied += 1
+            decoded = json.loads(raw)
+            # the leader coalesces a round's commands into one frame
+            batch = decoded if isinstance(decoded, list) else [decoded]
+            for cmd in batch:
+                seq = cmd.get("seq", -1)
+                if seq != self._expected_seq:
+                    raise RuntimeError(
+                        f"command stream gap: expected "
+                        f"{self._expected_seq}, got {seq} — follower "
+                        f"state is no longer lockstep"
+                    )
+                self._expected_seq += 1
+                if cmd["op"] == "stop":
+                    return
+                self.apply(cmd)
+                self.commands_applied += 1
 
     def apply(self, cmd: dict) -> None:
         eng = self.engine
@@ -192,9 +246,23 @@ class Follower:
                 jnp.int32(cmd["slot"]),
                 jnp.int32(cmd["start"]), jnp.int32(cmd["end"]),
             )
+        elif op == "prefill_batch":
+            from dynamo_tpu.models import llama
+
+            eng.ctx, eng._mh_last_logits = llama.batch_prefill(
+                eng.config, eng.params, eng.ctx,
+                jnp.asarray(np.asarray(cmd["tokens"], np.int32)),
+                jnp.asarray(np.asarray(cmd["slots"], np.int32)),
+                jnp.asarray(np.asarray(cmd["q_starts"], np.int32)),
+                jnp.asarray(np.asarray(cmd["seq_lens"], np.int32)),
+                int(cmd["ctx_span"]),
+            )
         elif op == "sample_first":
+            logits = eng._mh_last_logits
+            if cmd.get("index") is not None:
+                logits = logits[cmd["index"]]
             toks, _lp = eng._sample_first(
-                eng._mh_last_logits,
+                logits,
                 jnp.asarray(np.asarray(cmd["key"], np.uint32)),
                 jnp.float32(cmd["temp"]),
                 jnp.int32(cmd["top_k"]),
@@ -203,6 +271,19 @@ class Follower:
                 cmd["want_lp"],
             )
             eng._mh_last_first_tok = toks
+        elif op == "sp_prefill":
+            from dynamo_tpu.models import llama
+            from dynamo_tpu.ops.ring_attention import sp_shard
+
+            toks = jnp.asarray(np.asarray(cmd["tokens"], np.int32))
+            kv, logits = llama.sp_prefill(
+                eng.config, eng.params, sp_shard(toks, eng.mesh),
+                jnp.int32(cmd["n"]), eng.mesh,
+            )
+            eng.ctx = llama.write_ctx_span(
+                eng.ctx, jnp.int32(cmd["slot"]), kv
+            )
+            eng._mh_last_logits = logits
         elif op == "load_ctx":
             from dynamo_tpu.models import llama
 

@@ -89,6 +89,10 @@ def read_gguf(path: str) -> tuple[dict[str, Any], list[dict[str, Any]]]:
                 "name": name, "dims": dims, "dtype": dtype,
                 "offset": offset,
             })
+        # tensor DATA begins here, aligned — recorded so loaders don't
+        # re-walk the header (tensor offsets are relative to this)
+        align = int(metadata.get("general.alignment", 32) or 32)
+        metadata["gguf.data_offset"] = (f.tell() + align - 1) // align * align
         return metadata, tensors
 
 
@@ -214,16 +218,16 @@ class GgufTokenizer:
         out.reverse()
         return out
 
-    def encode(self, text: str) -> list[int]:
+    def encode(self, text: str, add_special_tokens: bool = True) -> list[int]:
         norm = self.SPACE + text.replace(" ", self.SPACE)
         ids = self._segment(norm)
-        if self.add_bos:
+        if self.add_bos and add_special_tokens:
             return [self.bos_id] + ids
         return ids
 
     # ---- decode ----
 
-    def decode(self, ids: list[int]) -> str:
+    def decode(self, ids: list[int], skip_special_tokens: bool = True) -> str:
         parts: list[str] = []
         pending: list[int] = []
 
@@ -249,3 +253,492 @@ class GgufTokenizer:
     @property
     def stop_token_ids(self) -> list[int]:
         return [self.eos_id] if self.eos_id is not None else []
+
+    @property
+    def eos_token_ids(self) -> list[int]:
+        return self.stop_token_ids
+
+    @property
+    def vocab_size(self) -> int:
+        return len(self.tokens)
+
+
+# ---------------------------------------------------------------------------
+# BPE ("gpt2"-model) GGUF tokenizer — the llama-3-family vocab form
+# (reference gguf_tokenizer.rs:111,222 converts these to HF tokenizers;
+# here the byte-level BPE is implemented directly).
+
+def _bytes_to_unicode() -> dict[int, str]:
+    """GPT-2's printable-byte table: every byte maps to a unicode char
+    (printable ASCII/latin-1 map to themselves; the rest to U+0100+i)."""
+    bs = (list(range(ord("!"), ord("~") + 1))
+          + list(range(0xA1, 0xAD)) + list(range(0xAE, 0x100)))
+    cs = bs[:]
+    n = 0
+    for b in range(256):
+        if b not in bs:
+            bs.append(b)
+            cs.append(256 + n)
+            n += 1
+    return dict(zip(bs, (chr(c) for c in cs)))
+
+
+_BYTE_ENC = _bytes_to_unicode()
+_BYTE_DEC = {v: k for k, v in _BYTE_ENC.items()}
+
+_CONTRACTIONS = ("'s", "'t", "'re", "'ve", "'m", "'ll", "'d")
+
+
+def _is_letter(c: str) -> bool:
+    import unicodedata
+
+    return unicodedata.category(c).startswith("L")
+
+
+def _is_number(c: str) -> bool:
+    import unicodedata
+
+    return unicodedata.category(c).startswith("N")
+
+
+def _run(text: str, i: int, pred) -> int:
+    n = len(text)
+    while i < n and pred(text[i]):
+        i += 1
+    return i
+
+
+def gpt2_pretokenize(text: str) -> list[str]:
+    """Scanner equivalent of the GPT-2 split regex
+    ``'s|'t|'re|'ve|'m|'ll|'d| ?\\p{L}+| ?\\p{N}+| ?[^\\s\\p{L}\\p{N}]+|
+    \\s+(?!\\S)|\\s+`` (python re lacks \\p classes; the alternation
+    order is reproduced exactly)."""
+    out: list[str] = []
+    i, n = 0, len(text)
+    while i < n:
+        c = text[i]
+        if c == "'":
+            for s in _CONTRACTIONS:
+                if text.startswith(s, i):
+                    out.append(s)
+                    i += len(s)
+                    break
+            else:
+                j = _run(text, i, lambda ch: not (
+                    ch.isspace() or _is_letter(ch) or _is_number(ch)))
+                out.append(text[i:j])
+                i = j
+            continue
+        start = i
+        if c == " " and i + 1 < n and not text[i + 1].isspace():
+            c = text[i + 1]
+            i += 1
+        if _is_letter(c):
+            j = _run(text, i, _is_letter)
+        elif _is_number(c):
+            j = _run(text, i, _is_number)
+        elif not c.isspace():
+            j = _run(text, i, lambda ch: not (
+                ch.isspace() or _is_letter(ch) or _is_number(ch)))
+        else:
+            # whitespace run: \s+(?!\S) leaves the last space to prefix
+            # the following word; a run at EOF is consumed whole
+            j = _run(text, start, str.isspace)
+            if j < n and j - start > 1:
+                j -= 1
+            elif j < n and j - start == 1:
+                j = start + 1  # single space before non-space: own token
+            out.append(text[start:j])
+            i = j
+            continue
+        out.append(text[start:j])
+        i = j
+    return out
+
+
+def llama3_pretokenize(text: str) -> list[str]:
+    """Scanner for the llama-3 ("llama-bpe") pretokenizer regex
+    ``(?i:'s|'t|'re|'ve|'m|'ll|'d)|[^\\r\\n\\p{L}\\p{N}]?\\p{L}+|
+    \\p{N}{1,3}| ?[^\\s\\p{L}\\p{N}]+[\\r\\n]*|\\s*[\\r\\n]+|
+    \\s+(?!\\S)|\\s+`` — differences from GPT-2: case-insensitive
+    contractions, digits grouped at most 3, punctuation absorbs trailing
+    newlines, newline runs grouped."""
+    out: list[str] = []
+    i, n = 0, len(text)
+    while i < n:
+        c = text[i]
+        # (?i:'s|'t|'re|'ve|'m|'ll|'d)
+        if c == "'":
+            low = text[i:i + 3].lower()
+            matched = None
+            for s in _CONTRACTIONS:
+                if low.startswith(s):
+                    matched = s
+                    break
+            if matched is not None:
+                out.append(text[i:i + len(matched)])
+                i += len(matched)
+                continue
+        # [^\r\n\p{L}\p{N}]?\p{L}+
+        if _is_letter(c):
+            j = _run(text, i, _is_letter)
+            out.append(text[i:j])
+            i = j
+            continue
+        if (c not in "\r\n" and not _is_number(c)
+                and i + 1 < n and _is_letter(text[i + 1])):
+            j = _run(text, i + 1, _is_letter)
+            out.append(text[i:j])
+            i = j
+            continue
+        # \p{N}{1,3}
+        if _is_number(c):
+            j = min(_run(text, i, _is_number), i + 3)
+            out.append(text[i:j])
+            i = j
+            continue
+        #  ?[^\s\p{L}\p{N}]+[\r\n]*
+        is_punct_start = not c.isspace() or (
+            c == " " and i + 1 < n and not text[i + 1].isspace()
+            and not _is_letter(text[i + 1]) and not _is_number(text[i + 1])
+        )
+        if is_punct_start:
+            start = i
+            if c == " ":
+                i += 1
+            j = _run(text, i, lambda ch: not (
+                ch.isspace() or _is_letter(ch) or _is_number(ch)))
+            j = _run(text, j, lambda ch: ch in "\r\n")
+            out.append(text[start:j])
+            i = j
+            continue
+        # \s*[\r\n]+ | \s+(?!\S) | \s+
+        j = _run(text, i, str.isspace)
+        seg = text[i:j]
+        last_nl = max(seg.rfind("\r"), seg.rfind("\n"))
+        if last_nl >= 0:
+            out.append(seg[: last_nl + 1])
+            i += last_nl + 1
+            continue
+        if j < n and j - i > 1:
+            j -= 1
+        out.append(text[i:j])
+        i = j
+    return out
+
+
+class GgufBpeTokenizer:
+    """Byte-level BPE tokenizer from GGUF "gpt2"-model vocab tables
+    (``tokenizer.ggml.tokens`` + ``tokenizer.ggml.merges``) — the llama-3
+    GGUF family. Control tokens (token_type 3) are matched verbatim
+    before pretokenization so chat-template markup round-trips."""
+
+    def __init__(self, tokens: list[str], merges: list[str],
+                 token_types: Optional[list[int]] = None,
+                 bos_id: Optional[int] = None, eos_id: Optional[int] = None,
+                 add_bos: bool = True, pre: str = "gpt2"):
+        self.tokens = tokens
+        self.piece_to_id = {t: i for i, t in enumerate(tokens)}
+        self.ranks: dict[tuple[str, str], int] = {}
+        for r, m in enumerate(merges):
+            a, _, b = m.partition(" ")
+            self.ranks[(a, b)] = r
+        self.bos_id = bos_id
+        self.eos_id = eos_id
+        self.add_bos = add_bos and bos_id is not None
+        self.pre = pre
+        self.specials: dict[str, int] = {}
+        if token_types:
+            for i, t in enumerate(token_types):
+                if t == 3:  # control
+                    self.specials[tokens[i]] = i
+        # one compiled alternation, longest-first so overlapping control
+        # names resolve to the longest match in a single pass (llama-3
+        # carries ~256 control tokens; per-special rescans of the text
+        # would be quadratic on the serving hot path)
+        self._special_re = None
+        if self.specials:
+            import re
+
+            self._special_re = re.compile("|".join(
+                re.escape(s)
+                for s in sorted(self.specials, key=len, reverse=True)
+            ))
+        self._pretok = (llama3_pretokenize
+                        if pre in ("llama-bpe", "llama3")
+                        else gpt2_pretokenize)
+
+    @classmethod
+    def from_metadata(cls, md: dict[str, Any]) -> "GgufBpeTokenizer":
+        return cls(
+            list(md["tokenizer.ggml.tokens"]),
+            list(md.get("tokenizer.ggml.merges") or []),
+            md.get("tokenizer.ggml.token_type"),
+            bos_id=md.get("tokenizer.ggml.bos_token_id"),
+            eos_id=md.get("tokenizer.ggml.eos_token_id"),
+            add_bos=bool(md.get("tokenizer.ggml.add_bos_token", True)),
+            pre=md.get("tokenizer.ggml.pre", "gpt2"),
+        )
+
+    def _bpe(self, word: str) -> list[str]:
+        parts = list(word)
+        while len(parts) > 1:
+            best = None
+            best_rank = None
+            for i in range(len(parts) - 1):
+                r = self.ranks.get((parts[i], parts[i + 1]))
+                if r is not None and (best_rank is None or r < best_rank):
+                    best, best_rank = i, r
+            if best is None:
+                break
+            parts[best : best + 2] = [parts[best] + parts[best + 1]]
+        return parts
+
+    def encode(self, text: str, add_special_tokens: bool = True) -> list[int]:
+        ids: list[int] = []
+        if add_special_tokens and self.add_bos:
+            ids.append(self.bos_id)
+        # split on control tokens first (longest match wins)
+        segments: list[tuple[bool, str]] = []
+        if self._special_re is not None:
+            pos = 0
+            for m in self._special_re.finditer(text):
+                if m.start() > pos:
+                    segments.append((False, text[pos:m.start()]))
+                segments.append((True, m.group()))
+                pos = m.end()
+            if pos < len(text):
+                segments.append((False, text[pos:]))
+        else:
+            segments = [(False, text)]
+        for is_special, seg in segments:
+            if is_special:
+                ids.append(self.specials[seg])
+                continue
+            for piece in self._pretok(seg):
+                mapped = "".join(_BYTE_ENC[b] for b in piece.encode("utf-8"))
+                for sub in self._bpe(mapped):
+                    pid = self.piece_to_id.get(sub)
+                    if pid is None:  # fall back to single mapped bytes
+                        ids.extend(
+                            self.piece_to_id.get(ch, 0) for ch in sub
+                        )
+                    else:
+                        ids.append(pid)
+        return ids
+
+    def decode(self, ids: list[int], skip_special_tokens: bool = True) -> str:
+        buf = bytearray()
+        for i in ids:
+            if not 0 <= i < len(self.tokens):
+                continue
+            t = self.tokens[i]
+            if t in self.specials or i in (self.bos_id, self.eos_id):
+                if not skip_special_tokens:
+                    buf.extend(t.encode("utf-8"))
+                continue
+            for ch in t:
+                b = _BYTE_DEC.get(ch)
+                if b is None:
+                    buf.extend(ch.encode("utf-8"))
+                else:
+                    buf.append(b)
+        return buf.decode("utf-8", errors="replace")
+
+    @property
+    def stop_token_ids(self) -> list[int]:
+        ids = [self.eos_id] if self.eos_id is not None else []
+        for name in ("<|eot_id|>", "<|end_of_text|>", "<|im_end|>"):
+            i = self.specials.get(name)
+            if i is not None and i not in ids:
+                ids.append(i)
+        return ids
+
+    @property
+    def eos_token_ids(self) -> list[int]:
+        return self.stop_token_ids
+
+    @property
+    def vocab_size(self) -> int:
+        return len(self.tokens)
+
+
+def gguf_tokenizer(md: dict[str, Any]):
+    """Tokenizer from GGUF metadata: unigram (llama/spm) or byte-level
+    BPE (gpt2 — the llama-3 family)."""
+    model = md.get("tokenizer.ggml.model", "llama")
+    if model == "gpt2":
+        return GgufBpeTokenizer.from_metadata(md)
+    return GgufTokenizer.from_metadata(md)
+
+
+# ---------------------------------------------------------------------------
+# Tensor data: dequantization + HF-layout param loading (closes the
+# round-4 "weights dequant not wired" gap; reference reads GGUF tensors
+# via ggml in lib/engines/llamacpp).
+
+# ggml tensor dtypes (public spec ids)
+GGML_F32, GGML_F16 = 0, 1
+GGML_Q4_0, GGML_Q4_1 = 2, 3
+GGML_Q5_0, GGML_Q5_1 = 6, 7
+GGML_Q8_0 = 8
+
+_GGML_BLOCK = {
+    # dtype -> (elems per block, bytes per block)
+    GGML_F32: (1, 4), GGML_F16: (1, 2),
+    GGML_Q4_0: (32, 18), GGML_Q4_1: (32, 20),
+    GGML_Q5_0: (32, 22), GGML_Q5_1: (32, 24),
+    GGML_Q8_0: (32, 34),
+}
+
+_GGML_NAMES = {2: "Q4_0", 3: "Q4_1", 6: "Q5_0", 7: "Q5_1", 8: "Q8_0",
+               10: "Q2_K", 11: "Q3_K", 12: "Q4_K", 13: "Q5_K",
+               14: "Q6_K", 15: "Q8_K"}
+
+
+def dequantize_tensor(dtype: int, data: bytes, n_elems: int):
+    """Dequantize one tensor's raw bytes to f32 (vectorized numpy).
+    Supports the classic formats (F32/F16/Q4_0/Q4_1/Q5_0/Q5_1/Q8_0);
+    K-quants raise with the format name."""
+    import numpy as np
+
+    if dtype == GGML_F32:
+        return np.frombuffer(data, "<f4", n_elems).copy()
+    if dtype == GGML_F16:
+        return np.frombuffer(data, "<f2", n_elems).astype(np.float32)
+    if dtype not in _GGML_BLOCK:
+        raise ValueError(
+            f"GGUF tensor format {_GGML_NAMES.get(dtype, dtype)} is not "
+            "supported (classic formats F16/F32/Q4_0/Q4_1/Q5_0/Q5_1/Q8_0 "
+            "are; re-export K-quant files as Q8_0)"
+        )
+    elems, bsz = _GGML_BLOCK[dtype]
+    nblk = n_elems // elems
+    raw = np.frombuffer(data, np.uint8, nblk * bsz).reshape(nblk, bsz)
+
+    def f16(col):  # [nblk] f32 from two little-endian bytes
+        return raw[:, col:col + 2].copy().view("<f2")[:, 0].astype(np.float32)
+
+    if dtype == GGML_Q8_0:
+        d = f16(0)
+        q = raw[:, 2:34].copy().view(np.int8).astype(np.float32)
+        return (q * d[:, None]).reshape(-1)[:n_elems]
+    if dtype in (GGML_Q4_0, GGML_Q4_1):
+        off = 2 if dtype == GGML_Q4_0 else 4
+        d = f16(0)
+        qs = raw[:, off:off + 16]
+        lo = (qs & 0x0F).astype(np.float32)       # elems 0..15
+        hi = (qs >> 4).astype(np.float32)         # elems 16..31
+        x = np.concatenate([lo, hi], axis=1)
+        if dtype == GGML_Q4_0:
+            x = (x - 8.0) * d[:, None]
+        else:
+            m = f16(2)
+            x = x * d[:, None] + m[:, None]
+        return x.reshape(-1)[:n_elems]
+    if dtype in (GGML_Q5_0, GGML_Q5_1):
+        off = 2 if dtype == GGML_Q5_0 else 4
+        d = f16(0)
+        qh = raw[:, off:off + 4].copy().view("<u4")[:, 0]   # [nblk]
+        qs = raw[:, off + 4:off + 20]
+        j = np.arange(16)
+        lo = (qs & 0x0F) | (((qh[:, None] >> j) & 1) << 4).astype(np.uint8)
+        hi = (qs >> 4) | (((qh[:, None] >> (j + 16)) & 1) << 4).astype(
+            np.uint8)
+        x = np.concatenate([lo, hi], axis=1).astype(np.float32)
+        if dtype == GGML_Q5_0:
+            x = (x - 16.0) * d[:, None]
+        else:
+            m = f16(2)
+            x = x * d[:, None] + m[:, None]
+        return x.reshape(-1)[:n_elems]
+    raise AssertionError
+
+
+def _unpermute_rope(w, n_head: int):
+    """Invert the HF->GGUF attn q/k row permutation (the GGUF layout
+    serves llama.cpp's interleaved-rope kernels; ops/rope.py uses the HF
+    rotate-half convention, so rows go back). w is [out, in]."""
+    import numpy as np
+
+    out_dim = w.shape[0]
+    half = out_dim // n_head // 2
+    return (w.reshape(n_head, half, 2, *w.shape[1:])
+             .swapaxes(1, 2)
+             .reshape(w.shape))
+
+
+def load_gguf_params(config, path: str, dtype=None):
+    """Read + dequantize GGUF tensor data into the llama Params tree
+    (via the same HF-state-dict assembly the safetensors loader uses, so
+    stacking/transposes stay in one place). Host-side numpy throughout."""
+    import numpy as np
+
+    from dynamo_tpu.models import llama as _llama
+
+    md, tensors = read_gguf(path)
+    data_start = md["gguf.data_offset"]
+
+    name_map = {
+        "token_embd.weight": "model.embed_tokens.weight",
+        "output_norm.weight": "model.norm.weight",
+        "output.weight": "lm_head.weight",
+    }
+
+    def hf_name(gname: str):
+        if gname in name_map:
+            return name_map[gname]
+        if gname.startswith("blk."):
+            _, idx, rest = gname.split(".", 2)
+            sub = {
+                "attn_q.weight": "self_attn.q_proj.weight",
+                "attn_k.weight": "self_attn.k_proj.weight",
+                "attn_v.weight": "self_attn.v_proj.weight",
+                "attn_output.weight": "self_attn.o_proj.weight",
+                "ffn_gate.weight": "mlp.gate_proj.weight",
+                "ffn_up.weight": "mlp.up_proj.weight",
+                "ffn_down.weight": "mlp.down_proj.weight",
+                "attn_norm.weight": "input_layernorm.weight",
+                "ffn_norm.weight": "post_attention_layernorm.weight",
+            }.get(rest)
+            if sub is None:
+                return None
+            return f"model.layers.{idx}.{sub}"
+        return None
+
+    raw: dict[str, Any] = {}
+    with open(path, "rb") as f:
+        for t in tensors:
+            name = hf_name(t["name"])
+            if name is None:
+                continue
+            n_elems = 1
+            for d in t["dims"]:
+                n_elems *= d
+            elems, bsz = _GGML_BLOCK.get(t["dtype"], (1, 4))
+            nbytes = (
+                n_elems * (4 if t["dtype"] == GGML_F32 else 2)
+                if t["dtype"] in (GGML_F32, GGML_F16)
+                else n_elems // elems * bsz
+            )
+            f.seek(data_start + t["offset"])
+            x = dequantize_tensor(t["dtype"], f.read(nbytes), n_elems)
+            # GGUF dims are [ne0 (contiguous), ne1, ...] -> numpy shape
+            # reversed; a 2-d weight lands [out, in] like HF
+            x = x.reshape(tuple(reversed(t["dims"])))
+            if t["name"].endswith("attn_q.weight"):
+                x = _unpermute_rope(x, config.num_heads)
+            elif t["name"].endswith("attn_k.weight"):
+                x = _unpermute_rope(x, config.num_kv_heads)
+            raw[name] = x
+    if "lm_head.weight" not in raw and not config.tie_word_embeddings:
+        raw["lm_head.weight"] = raw["model.embed_tokens.weight"]
+    import jax
+
+    cpu = jax.devices("cpu")[0]
+    with jax.default_device(cpu):
+        raw_j = {k: np.asarray(v) for k, v in raw.items()}
+        params = _llama.params_from_state_dict(config, raw_j, dtype)
+        if config.quant == "int8":
+            params = _llama.quantize_params(params)
+    return params

@@ -18,6 +18,7 @@ defaults (service-account token + CA) or explicit parameters.
 """
 from __future__ import annotations
 
+import asyncio
 import json
 import logging
 import os
@@ -308,3 +309,199 @@ def render_manifests(manifests: list[dict[str, Any]]) -> str:
         )
     except ImportError:
         return "\n".join(json.dumps(m, indent=1) for m in manifests)
+
+
+# ---------------------------------------------------------------------------
+# Operator-lite reconcile loop
+
+
+def graph_key(namespace: str) -> str:
+    """Store key holding the deployed graph spec — the CRD analogue."""
+    return f"dynamo://{namespace}/_operator/graph"
+
+
+class DynamoOperator:
+    """Operator-lite: continuously reconciles a serve-graph spec into
+    Deployments/Services (reference deploy/cloud/operator
+    dynamocomponentdeployment_controller.go — CRD -> child objects, with
+    create/update/delete and drift correction; no CRDs here: the spec is
+    a store key watched like everything else on the control plane).
+
+    Reconcile = render the desired objects (emit_k8s_manifests), diff
+    against the live owned set by a spec-hash annotation, then create
+    missing, replace drifted, and delete orphans. Level-triggered: every
+    spec-change event and a periodic resync both run the same pass."""
+
+    HASH_ANN = "dynamo-tpu/spec-hash"
+    OWNED_SELECTOR = "app.kubernetes.io/part-of=dynamo-tpu"
+
+    def __init__(
+        self,
+        *,
+        api_base: str,
+        token: Optional[str] = None,
+        verify_ssl: bool = True,
+        k8s_namespace: str = "default",
+        image: str = "dynamo-tpu:latest",
+        resync_s: float = 30.0,
+    ):
+        self.api_base = api_base.rstrip("/")
+        self.token = token
+        self.verify_ssl = verify_ssl
+        self.k8s_namespace = k8s_namespace
+        self.image = image
+        self.resync_s = resync_s
+        self._session = None
+        self.reconciles = 0
+
+    _ensure_session = KubernetesConnector._ensure_session
+    _headers = KubernetesConnector._headers
+    close = KubernetesConnector.close
+
+    def _url(self, kind: str, name: Optional[str] = None) -> str:
+        base = {
+            "Deployment": (
+                f"{self.api_base}/apis/apps/v1/namespaces/"
+                f"{self.k8s_namespace}/deployments"
+            ),
+            "Service": (
+                f"{self.api_base}/api/v1/namespaces/"
+                f"{self.k8s_namespace}/services"
+            ),
+        }[kind]
+        return f"{base}/{name}" if name else base
+
+    @staticmethod
+    def _hash(obj: dict[str, Any]) -> str:
+        import hashlib
+
+        return hashlib.sha1(
+            json.dumps(obj, sort_keys=True).encode()
+        ).hexdigest()[:16]
+
+    async def _list_owned(self, kind: str) -> dict[str, dict[str, Any]]:
+        session = await self._ensure_session()
+        async with session.get(
+            self._url(kind), headers=self._headers(),
+            params={"labelSelector": self.OWNED_SELECTOR},
+        ) as resp:
+            body = await resp.json()
+            if resp.status != 200:
+                raise RuntimeError(
+                    f"{kind} LIST {resp.status}: "
+                    f"{body.get('message', body)}"
+                )
+        return {
+            item["metadata"]["name"]: item
+            for item in body.get("items", [])
+        }
+
+    async def _create(self, kind: str, obj: dict[str, Any]) -> None:
+        session = await self._ensure_session()
+        async with session.post(
+            self._url(kind), data=json.dumps(obj),
+            headers=self._headers("application/json"),
+        ) as resp:
+            if resp.status not in (200, 201):
+                body = await resp.json()
+                raise RuntimeError(
+                    f"{kind} CREATE {resp.status}: "
+                    f"{body.get('message', body)}"
+                )
+
+    async def _replace(self, kind: str, obj: dict[str, Any],
+                       live: dict[str, Any]) -> None:
+        rv = live.get("metadata", {}).get("resourceVersion")
+        if rv is not None:
+            obj = dict(obj)
+            obj["metadata"] = dict(obj["metadata"], resourceVersion=rv)
+        session = await self._ensure_session()
+        async with session.put(
+            self._url(kind, obj["metadata"]["name"]), data=json.dumps(obj),
+            headers=self._headers("application/json"),
+        ) as resp:
+            if resp.status != 200:
+                body = await resp.json()
+                raise RuntimeError(
+                    f"{kind} REPLACE {resp.status}: "
+                    f"{body.get('message', body)}"
+                )
+
+    async def _delete(self, kind: str, name: str) -> None:
+        session = await self._ensure_session()
+        async with session.delete(
+            self._url(kind, name), headers=self._headers()
+        ) as resp:
+            if resp.status not in (200, 202, 404):
+                body = await resp.json()
+                raise RuntimeError(
+                    f"{kind} DELETE {resp.status}: "
+                    f"{body.get('message', body)}"
+                )
+
+    async def reconcile(self, graph: dict[str, Any]) -> dict[str, int]:
+        """One level-triggered pass; returns counts for observability."""
+        desired = emit_k8s_manifests(
+            graph, image=self.image, k8s_namespace=self.k8s_namespace
+        )
+        for obj in desired:
+            ann = obj["metadata"].setdefault("annotations", {})
+            ann[self.HASH_ANN] = self._hash(
+                {k: v for k, v in obj.items() if k != "metadata"}
+            )
+        counts = {"created": 0, "updated": 0, "deleted": 0, "unchanged": 0}
+        for kind in ("Deployment", "Service"):
+            live = await self._list_owned(kind)
+            want = {
+                o["metadata"]["name"]: o for o in desired
+                if o["kind"] == kind
+            }
+            for name, obj in want.items():
+                cur = live.get(name)
+                if cur is None:
+                    await self._create(kind, obj)
+                    counts["created"] += 1
+                elif (
+                    cur.get("metadata", {}).get("annotations", {})
+                    .get(self.HASH_ANN)
+                    != obj["metadata"]["annotations"][self.HASH_ANN]
+                ):
+                    await self._replace(kind, obj, cur)
+                    counts["updated"] += 1
+                else:
+                    counts["unchanged"] += 1
+            for name in live:
+                if name not in want:
+                    await self._delete(kind, name)
+                    counts["deleted"] += 1
+        self.reconciles += 1
+        log.info("operator reconcile: %s", counts)
+        return counts
+
+    async def run(self, kv, namespace: str) -> None:
+        """Watch the graph spec key and reconcile on every change, plus a
+        periodic resync (drift repair — the operator owns its children)."""
+        key = graph_key(namespace)
+        watch = await kv.watch_prefix(key)
+        graph: Optional[dict[str, Any]] = None
+        for _k, v, _ver in watch.initial:
+            graph = json.loads(v)
+        if graph is not None:
+            await self.reconcile(graph)
+        try:
+            while True:
+                try:
+                    ev = await asyncio.wait_for(
+                        watch.__anext__(), timeout=self.resync_s
+                    )
+                except asyncio.TimeoutError:
+                    if graph is not None:
+                        await self.reconcile(graph)  # resync
+                    continue
+                except StopAsyncIteration:
+                    return
+                if ev.get("event") == "put":
+                    graph = json.loads(ev["value"])
+                    await self.reconcile(graph)
+        finally:
+            await watch.cancel()

@@ -138,11 +138,15 @@ class BlockTransferServer:
         write_fn: Optional[WriteFn] = None,
         host: str = "127.0.0.1",
         port: int = 0,
+        read_hashes_fn: Optional[
+            Callable[[list[int]], tuple[int, Optional[np.ndarray]]]
+        ] = None,
     ):
         self.read_fn = read_fn
         self.write_fn = write_fn
         self.host = host
         self.port = port
+        self.read_hashes_fn = read_hashes_fn
         self._server: Optional[asyncio.AbstractServer] = None
 
     async def start(self) -> tuple[str, int]:
@@ -194,6 +198,29 @@ class BlockTransferServer:
                              "dtype": data.dtype.name},
                             data,
                         )
+                    elif op == "read_hashes":
+                        # G4 remote tier: resolve a chained-hash run
+                        # against this worker's sealed pool and export the
+                        # longest present prefix (reference
+                        # block_manager.rs:69-82 remote CacheLevel)
+                        if self.read_hashes_fn is None:
+                            raise RuntimeError("hash reads not accepted")
+                        hs = [int(h) for h in header["hashes"]]
+                        found, data = await loop.run_in_executor(
+                            None, self.read_hashes_fn, hs
+                        )
+                        if not found or data is None:
+                            writer.write(encode_frame2(
+                                {"ok": True, "found": 0}, b""
+                            ))
+                        else:
+                            _write_array_frame(
+                                writer,
+                                {"ok": True, "found": int(found),
+                                 "shape": list(data.shape),
+                                 "dtype": data.dtype.name},
+                                data,
+                            )
                     else:
                         raise RuntimeError(f"unknown op {op!r}")
                 except Exception as e:  # noqa: BLE001 — answer in-band
@@ -255,6 +282,191 @@ async def read_remote_pages(
         header, payload = await read_frame2(reader)
         if not header.get("ok"):
             raise BlockTransferError(header.get("error", "read failed"))
+        return np.frombuffer(
+            payload, dtype=np.dtype(header["dtype"])
+        ).reshape(header["shape"]).copy()
+    finally:
+        writer.close()
+
+
+async def read_remote_hashes(
+    host: str, port: int, hashes: list[int]
+) -> tuple[int, Optional[np.ndarray]]:
+    """One-sided hash-addressed read: ask a peer for the longest prefix of
+    the chained-hash run its pool holds (G4 path). Returns (found, pages
+    [2, L, kvh, found, ps, hd]) — (0, None) on full miss."""
+    reader, writer = await asyncio.open_connection(host, port)
+    try:
+        writer.write(encode_frame2(
+            {"op": "read_hashes", "hashes": [int(h) for h in hashes]}, b""
+        ))
+        await writer.drain()
+        header, payload = await read_frame2(reader)
+        if not header.get("ok"):
+            raise BlockTransferError(header.get("error", "read failed"))
+        found = int(header.get("found", 0))
+        if not found:
+            return 0, None
+        return found, np.frombuffer(
+            payload, dtype=np.dtype(header["dtype"])
+        ).reshape(header["shape"]).copy()
+    finally:
+        writer.close()
+
+
+class RemoteKvFetcher:
+    """KVBM G4: the remote cache tier (reference block_manager.rs:69-82
+    CacheLevel::G4, storage/nixl.rs:403 NIXL-backed remote storage).
+
+    TPU redesign: instead of a dedicated remote store, the "remote tier"
+    is every PEER worker's sealed pool, addressed by chained block hash
+    over the existing transfer plane. A prefix that misses G1/G2/G3
+    locally is fetched from whichever peer holds it (scaled-up workers
+    warm themselves from the fleet instead of recomputing), landing in
+    the G2 host tier so the normal onboard path takes over."""
+
+    def __init__(self, kv: KvClient, namespace: str, self_worker_id: str,
+                 timeout_s: float = 3.0):
+        self.kv = kv
+        self.namespace = namespace
+        self.self_id = self_worker_id
+        self.timeout_s = timeout_s
+        self.fetches = 0
+        self.hits = 0
+
+    async def fetch(
+        self, hashes: list[int]
+    ) -> tuple[int, Optional[np.ndarray]]:
+        """Probe every peer CONCURRENTLY; the longest returned prefix
+        wins. (0, None) if no peer holds anything. timeout_s bounds the
+        WHOLE probe round, not each peer — this runs on the
+        request-submit path, so dead peers must cost one timeout total,
+        never one timeout each."""
+        self.fetches += 1
+        rows = await self.kv.get_prefix(
+            f"dynamo://{self.namespace}/{KV_META_PREFIX}"
+        )
+        peers = []
+        for _key, val, _ver in rows:
+            try:
+                desc = BlocksetDescriptor.from_json(val)
+            except (ValueError, KeyError, TypeError):
+                continue
+            if desc.worker_id != self.self_id:
+                peers.append(desc)
+        if not peers:
+            return 0, None
+
+        async def probe(desc):
+            try:
+                return await read_remote_hashes(desc.host, desc.port, hashes)
+            except (OSError, BlockTransferError):
+                return 0, None
+
+        results = await asyncio.gather(
+            *[asyncio.wait_for(probe(d), timeout=self.timeout_s)
+              for d in peers],
+            return_exceptions=True,
+        )
+        best: tuple[int, Optional[np.ndarray]] = (0, None)
+        for res in results:
+            if isinstance(res, BaseException):
+                continue
+            if res[0] > best[0]:
+                best = res
+        if best[0]:
+            self.hits += 1
+        return best
+
+
+class ArrayFrameServer:
+    """One-shot array handoff over the frame2 codec (zero-copy send):
+    producers park an array under a ticket; exactly one peer collects it.
+
+    Carries multimodal embedding tensors from the encode worker to the
+    LLM worker (reference encode_worker.py:148 moves them via NIXL) —
+    a LLaVA-scale image is ~9 MB of f32 rows, which must not transit the
+    control-plane RPC as JSON float lists. Unclaimed arrays expire."""
+
+    def __init__(self, host: str = "0.0.0.0", port: int = 0,
+                 ttl_s: float = 120.0,
+                 advertise_host: Optional[str] = None):
+        self.bind_host = host
+        # what tickets carry: peers on OTHER machines must be able to
+        # reach it (the bind address 0.0.0.0 is not routable; loopback
+        # only works intra-host)
+        self.host = advertise_host or (
+            host if host not in ("0.0.0.0", "") else "127.0.0.1"
+        )
+        self.port = port
+        self.ttl_s = ttl_s
+        self._parked: dict[str, tuple[float, np.ndarray]] = {}
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._seq = 0
+
+    def park(self, array: np.ndarray) -> str:
+        import time
+
+        self._seq += 1
+        ticket = f"t{self._seq}"
+        now = time.monotonic()
+        self._parked[ticket] = (now, np.ascontiguousarray(array))
+        # opportunistic expiry sweep (no background task to manage)
+        dead = [t for t, (ts, _) in self._parked.items()
+                if now - ts > self.ttl_s]
+        for t in dead:
+            del self._parked[t]
+        return ticket
+
+    async def start(self) -> tuple[str, int]:
+        self._server = await asyncio.start_server(
+            self._on_conn, self.bind_host, self.port
+        )
+        self.port = self._server.sockets[0].getsockname()[1]
+        return self.host, self.port
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+        self._parked.clear()
+
+    async def _on_conn(self, reader, writer) -> None:
+        try:
+            while True:
+                header, _ = await read_frame2(reader)
+                ent = self._parked.pop(header.get("ticket", ""), None)
+                if ent is None:
+                    writer.write(encode_frame2(
+                        {"ok": False, "error": "unknown or expired ticket"},
+                        b"",
+                    ))
+                else:
+                    data = ent[1]
+                    _write_array_frame(
+                        writer,
+                        {"ok": True, "shape": list(data.shape),
+                         "dtype": data.dtype.name},
+                        data,
+                    )
+                await writer.drain()
+        except (asyncio.IncompleteReadError, ConnectionResetError,
+                BrokenPipeError, ValueError):
+            pass
+        finally:
+            writer.close()
+
+
+async def take_remote_array(host: str, port: int, ticket: str) -> np.ndarray:
+    """Collect (and consume) a parked array."""
+    reader, writer = await asyncio.open_connection(host, port)
+    try:
+        writer.write(encode_frame2({"op": "take", "ticket": ticket}, b""))
+        await writer.drain()
+        header, payload = await read_frame2(reader)
+        if not header.get("ok"):
+            raise BlockTransferError(header.get("error", "take failed"))
         return np.frombuffer(
             payload, dtype=np.dtype(header["dtype"])
         ).reshape(header["shape"]).copy()

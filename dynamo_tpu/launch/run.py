@@ -40,7 +40,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--model-path", help="local HF model dir (config/tokenizer/safetensors)")
     p.add_argument("--model-name", default=None, help="served model name")
     p.add_argument("--model-config", default=None,
-                   help="canned config (tiny|llama3_1b|llama3_8b|llama3_70b) for random-weight serving")
+                   help="canned config (tiny|llama3_1b|llama3_8b|"
+                        "llama3_8b_int8|llama3_70b) for random-weight "
+                        "serving")
+    p.add_argument("--quantize", default=None, choices=["int8"],
+                   help="weight quantization (w8a16 int8): quantizes "
+                        "loaded checkpoints per-output-channel; an 8B "
+                        "checkpoint on a 16 GB v5e requires it")
     p.add_argument("--http-host", default=cfg.http_host)
     p.add_argument("--http-port", type=int, default=cfg.http_port)
     p.add_argument("--prompt", default=None, help="prompt for in=text")
@@ -104,6 +110,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="prompts with more uncached tokens go to the "
                         "prefill queue (writes the store-watched conf)")
     p.add_argument("--max-prefill-queue-size", type=int, default=None)
+    p.add_argument("--remote-kv", action="store_true",
+                   help="KVBM G4: serve this worker's sealed KV pool to "
+                        "peers and fall through the local tiers to peer "
+                        "pools on prefix misses (requires --control-plane "
+                        "and a G2 tier via --host-offload-pages)")
     p.add_argument("--prefill-timeout", type=float, default=60.0,
                    help="decode-side wait for remote prefill before local "
                         "fallback")
@@ -244,6 +255,11 @@ def _crosshost_prologue(args, cfg, ecfg, params):
             from dynamo_tpu.engine.multihost import stop_followers
 
             try:
+                # pending batches must hit the wire before the stop
+                # command, or followers see a seq gap
+                asyncio.run_coroutine_threadsafe(
+                    stream.drain(), stream_loop
+                ).result(timeout=30)
                 asyncio.run_coroutine_threadsafe(
                     stop_followers(
                         kv, args.namespace, engine_id, run_id,
@@ -287,6 +303,7 @@ def build_chain(args) -> "Any":
     from dynamo_tpu.tokenizer import HfTokenizer, make_test_tokenizer
 
     inp, out = _parse_io(args.io)
+    gguf_meta = None
 
     if args.model_path:
         # path | cached hub id | .gguf (reference local_model.rs:39; no
@@ -295,15 +312,22 @@ def build_chain(args) -> "Any":
 
         resolved = resolve_model(args.model_path)
         if resolved.kind == "gguf":
-            raise SystemExit(
-                "GGUF serving: weights dequantization is not wired yet — "
-                "use `dynamo_tpu.gguf` for metadata/tokenizer and a "
-                "safetensors model dir for serving"
-            )
+            # single-file serving: config + tokenizer + dequantized
+            # weights all come out of the .gguf (reference gguf/ module +
+            # llamacpp engine path)
+            from dynamo_tpu.gguf import gguf_tokenizer, read_gguf
+
+            gguf_meta, _ = read_gguf(resolved.path)
+            tok = gguf_tokenizer(gguf_meta)
+            fmt = PromptFormatter()
+            name = args.model_name or os.path.basename(
+                resolved.path).removesuffix(".gguf")
+        else:
+            tok = HfTokenizer.from_dir(resolved.path)
+            fmt = PromptFormatter.from_dir(resolved.path)
+            name = args.model_name or os.path.basename(
+                resolved.path.rstrip("/"))
         args.model_path = resolved.path
-        tok = HfTokenizer.from_dir(args.model_path)
-        fmt = PromptFormatter.from_dir(args.model_path)
-        name = args.model_name or os.path.basename(args.model_path.rstrip("/"))
     else:
         tok = make_test_tokenizer()
         fmt = PromptFormatter()
@@ -352,12 +376,20 @@ def build_chain(args) -> "Any":
                     )
                 local_devices = None  # global mesh
 
-        if args.model_path:
+        if args.model_path and gguf_meta is not None:
+            from dynamo_tpu.gguf import config_from_gguf
+
+            cfg = config_from_gguf(gguf_meta)
+        elif args.model_path:
             cfg = ModelConfig.from_pretrained(args.model_path)
         elif args.model_config:
             cfg = getattr(ModelConfig, args.model_config)()
         else:
             raise SystemExit("out=tpu needs --model-path or --model-config")
+        if args.quantize:
+            from dataclasses import replace as _replace
+
+            cfg = _replace(cfg, quant=args.quantize)
         ecfg = EngineConfig(
             num_pages=args.num_pages,
             page_size=args.page_size,
@@ -368,7 +400,11 @@ def build_chain(args) -> "Any":
             disk_offload_path=args.disk_offload_path,
         )
         params = None
-        if args.model_path:
+        if args.model_path and gguf_meta is not None:
+            from dynamo_tpu.gguf import load_gguf_params
+
+            params = load_gguf_params(cfg, args.model_path)
+        elif args.model_path:
             from dynamo_tpu.models import llama
 
             params = llama.load_hf_params(cfg, args.model_path)
@@ -611,6 +647,30 @@ async def _serve_worker(args, chain) -> None:
         )
         disagg_parts.append(served_xfer)
 
+    if getattr(args, "remote_kv", False) and args.role != "decode":
+        # G4: aggregated workers also join the transfer plane (decode
+        # workers already do) and fetch through it on prefix misses
+        import uuid as _uuid
+
+        inner = getattr(engine, "engine", engine)
+        if getattr(inner, "offload", None) is None:
+            raise SystemExit(
+                "--remote-kv needs a G2 host tier "
+                "(--host-offload-pages > 0)"
+            )
+        served_xfer = await _attach_data_plane(
+            args, rt, engine, _uuid.uuid4().hex
+        )
+        disagg_parts.append(served_xfer)
+    if getattr(args, "remote_kv", False):
+        from dynamo_tpu.kv_transfer import RemoteKvFetcher
+
+        inner = getattr(engine, "engine", engine)
+        if getattr(inner, "offload", None) is not None:
+            inner.remote_kv = RemoteKvFetcher(
+                rt.kv, args.namespace, getattr(engine, "worker_id", ""),
+            )
+
     entry = ModelEntry(
         name=chain.name,
         namespace=args.namespace,
@@ -659,7 +719,8 @@ async def _attach_data_plane(args, rt, engine, worker_id: str):
     engine.worker_id = worker_id
     write_fn = getattr(engine, "guarded_import", None) or inner.import_pages
     srv = BlockTransferServer(
-        read_fn=inner.export_pages, write_fn=write_fn
+        read_fn=inner.export_pages, write_fn=write_fn,
+        read_hashes_fn=getattr(inner, "export_pages_by_hash", None),
     )
     host, port = await srv.start()
     cfg, ecfg = inner.config, inner.ecfg

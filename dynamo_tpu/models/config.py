@@ -39,6 +39,12 @@ class ModelConfig:
     # None = dense MLP. Experts shard over the `ep` mesh axis, expert
     # hidden dim over `tp` (the sglang wide-EP shape, SURVEY §2.5).
     moe: Optional[tuple[tuple[str, Any], ...]] = None
+    # Weight quantization: None (dense, `dtype`) or "int8" (w8a16:
+    # per-output-channel symmetric int8 weights dequantized inside the
+    # matmul — llama.py _mm). Halves weight bytes, which both halves the
+    # decode weight-pass floor and is what fits an 8B on a 16 GB v5e
+    # (the reference's FP8 recipes, examples/llm/benchmarks/README.md:28).
+    quant: Optional[str] = None
 
     @property
     def rope_scaling_dict(self) -> Optional[dict[str, Any]]:
@@ -126,9 +132,9 @@ class ModelConfig:
         return cls.tiny(**base)
 
     @classmethod
-    def llama3_1b(cls) -> "ModelConfig":
+    def llama3_1b(cls, **kw) -> "ModelConfig":
         """Llama-3.2-1B shapes (fits one v5e chip in bf16 with room for KV)."""
-        return cls(
+        base = dict(
             vocab_size=128256,
             hidden_size=2048,
             intermediate_size=8192,
@@ -140,12 +146,14 @@ class ModelConfig:
             max_position_embeddings=131072,
             tie_word_embeddings=True,
         )
+        base.update(kw)
+        return cls(**base)
 
     @classmethod
-    def llama3_8b(cls) -> "ModelConfig":
+    def llama3_8b(cls, **kw) -> "ModelConfig":
         """Llama-3.1-8B / DeepSeek-R1-Distill-Llama-8B shapes (the reference
         benchmark model, BASELINE.json)."""
-        return cls(
+        base = dict(
             vocab_size=128256,
             hidden_size=4096,
             intermediate_size=14336,
@@ -156,6 +164,18 @@ class ModelConfig:
             rope_theta=500000.0,
             max_position_embeddings=131072,
         )
+        base.update(kw)
+        return cls(**base)
+
+    @classmethod
+    def llama3_8b_int8(cls) -> "ModelConfig":
+        """BASELINE config 1's model on one 16 GB v5e: w8a16 int8 weights
+        (~8 GB) — bf16 cannot fit."""
+        return cls.llama3_8b(quant="int8")
+
+    @classmethod
+    def llama3_1b_int8(cls) -> "ModelConfig":
+        return cls.llama3_1b(quant="int8")
 
     @classmethod
     def llama3_70b(cls) -> "ModelConfig":

@@ -44,6 +44,7 @@ from dynamo_tpu.models.config import ModelConfig
 from dynamo_tpu.ops.attention import (
     ctx_decode_attention,
     ctx_prefill_attention,
+    flash_prefill_attention,
 )
 from dynamo_tpu.ops.rope import apply_rope, rope_cos_sin, rope_inv_freq
 
@@ -55,20 +56,33 @@ Cache = dict[str, jnp.ndarray]
 # Parameters
 
 def init_params(config: ModelConfig, rng: jax.Array | int = 0) -> Params:
-    """Random-init parameters (bf16). Weight values only matter for quality,
-    not performance, so benchmarks use this; serving uses load_hf_params."""
+    """Random-init parameters (bf16, or w8a16 when config.quant="int8").
+    Weight values only matter for quality, not performance, so benchmarks
+    use this; serving uses load_hf_params.
+
+    With quant, int8 leaves are generated DIRECTLY (uniform int8 + a
+    constant per-channel scale matched to the dense init's std) — an 8B's
+    dense weights can never be materialized on a 16 GB chip, so there is
+    no dense-then-quantize step here."""
     if isinstance(rng, int):
         rng = jax.random.PRNGKey(rng)
     c = config
     dtype = jnp.dtype(c.dtype)
     keys = jax.random.split(rng, 12)
+    quant8 = c.quant == "int8"
 
-    def rnd(key, *shape, scale=None):
+    def rnd(key, *shape, scale=None, qaxis=-2):
         scale = scale or (1.0 / np.sqrt(shape[-2] if len(shape) > 1 else shape[-1]))
+        if quant8 and qaxis is not None:
+            q = jax.random.randint(key, shape, -127, 128, jnp.int8)
+            s_shape = tuple(np.delete(shape, len(shape) + qaxis))
+            # uniform[-127,127] has std ~73.3; s recovers the dense std
+            s = jnp.full(s_shape, scale / 73.3, jnp.float32)
+            return {"q": q, "s": s}
         return (jax.random.normal(key, shape, jnp.float32) * scale).astype(dtype)
 
     L, H, I, V = c.num_layers, c.hidden_size, c.intermediate_size, c.vocab_size
-    layers: dict[str, jnp.ndarray] = {
+    layers: dict[str, Any] = {
         "ln1": jnp.ones((L, H), dtype),
         "ln2": jnp.ones((L, H), dtype),
         "wq": rnd(keys[1], L, H, c.q_dim),
@@ -79,7 +93,7 @@ def init_params(config: ModelConfig, rng: jax.Array | int = 0) -> Params:
     if c.moe is not None:
         E = c.moe_dict["num_experts"]
         layers.update(
-            wr=rnd(keys[5], L, H, E),
+            wr=rnd(keys[5], L, H, E, qaxis=None),  # router stays dense
             we_g=rnd(keys[6], L, E, H, I),
             we_u=rnd(keys[7], L, E, H, I),
             we_d=rnd(keys[9], L, E, I, H),
@@ -91,7 +105,7 @@ def init_params(config: ModelConfig, rng: jax.Array | int = 0) -> Params:
             wd=rnd(keys[7], L, I, H),
         )
     params: Params = {
-        "embed": rnd(keys[0], V, H, scale=0.02),
+        "embed": rnd(keys[0], V, H, scale=0.02, qaxis=-1),
         "layers": layers,
         "norm_f": jnp.ones((H,), dtype),
     }
@@ -103,39 +117,49 @@ def init_params(config: ModelConfig, rng: jax.Array | int = 0) -> Params:
 def param_shardings(config: ModelConfig, mesh: Mesh) -> Params:
     """NamedSharding pytree: Megatron-style TP over the `tp` mesh axis.
     qkv/gate/up shard the output (head/hidden) dim; o/down shard the input
-    dim; embedding + lm_head shard the vocab dim."""
+    dim; embedding + lm_head shard the vocab dim. Quantized leaves get the
+    weight's spec on "q" and the spec minus the reduced axis on "s"."""
+    quant8 = config.quant == "int8"
+
     def ns(*spec):
         return NamedSharding(mesh, P(*spec))
+
+    def w(name, *spec):
+        if quant8 and name in _QUANT_AXIS:
+            axis = len(spec) + _QUANT_AXIS[name]
+            s_spec = tuple(p for i, p in enumerate(spec) if i != axis)
+            return {"q": ns(*spec), "s": ns(*s_spec)}
+        return ns(*spec)
 
     layers: Params = {
         "ln1": ns(None, None),
         "ln2": ns(None, None),
-        "wq": ns(None, None, "tp"),
-        "wk": ns(None, None, "tp"),
-        "wv": ns(None, None, "tp"),
-        "wo": ns(None, "tp", None),
+        "wq": w("wq", None, None, "tp"),
+        "wk": w("wk", None, None, "tp"),
+        "wv": w("wv", None, None, "tp"),
+        "wo": w("wo", None, "tp", None),
     }
     if config.moe is not None:
         # experts over ep, expert hidden over tp (wide-EP shape §2.5)
         layers.update(
             wr=ns(None, None, None),
-            we_g=ns(None, "ep", None, "tp"),
-            we_u=ns(None, "ep", None, "tp"),
-            we_d=ns(None, "ep", "tp", None),
+            we_g=w("we_g", None, "ep", None, "tp"),
+            we_u=w("we_u", None, "ep", None, "tp"),
+            we_d=w("we_d", None, "ep", "tp", None),
         )
     else:
         layers.update(
-            wg=ns(None, None, "tp"),
-            wu=ns(None, None, "tp"),
-            wd=ns(None, "tp", None),
+            wg=w("wg", None, None, "tp"),
+            wu=w("wu", None, None, "tp"),
+            wd=w("wd", None, "tp", None),
         )
     out: Params = {
-        "embed": ns("tp", None),
+        "embed": w("embed", "tp", None),
         "layers": layers,
         "norm_f": ns(None),
     }
     if not config.tie_word_embeddings:
-        out["lm_head"] = ns(None, "tp")
+        out["lm_head"] = w("lm_head", None, "tp")
     return out
 
 
@@ -200,6 +224,76 @@ def ring_shardings(config: ModelConfig, mesh: Mesh) -> Cache:
 
 
 # ---------------------------------------------------------------------------
+# Quantization (w8a16: per-output-channel symmetric int8 weights)
+#
+# A quantized weight is the leaf pair {"q": int8 [..., in, out],
+# "s": f32 [..., out]}; every matmul site routes through _mm/_embed_rows
+# so dense and quantized params are interchangeable. The int8 tensor is
+# what streams from HBM (half the weight-pass bytes of bf16 — the decode
+# roofline — and what fits an 8B on a 16 GB v5e, BASELINE config 1); the
+# dequantize (convert + per-channel scale) fuses into the matmul epilogue.
+# Reference analogue: the FP8 serving recipes
+# (examples/llm/benchmarks/README.md:28).
+
+_QUANT_AXIS = {
+    # reduction axis for the per-output-channel scale, per weight name
+    # (all weights are stored [in, out]-style; embed is row-gathered)
+    "wq": -2, "wk": -2, "wv": -2, "wo": -2,
+    "wg": -2, "wu": -2, "wd": -2,
+    "we_g": -2, "we_u": -2, "we_d": -2,
+    "embed": -1, "lm_head": -2,
+}
+
+
+def _is_quant(w) -> bool:
+    return isinstance(w, dict) and "q" in w
+
+
+def _mm(x: jnp.ndarray, w) -> jnp.ndarray:
+    """x @ w for a dense or quantized weight."""
+    if _is_quant(w):
+        return jnp.matmul(x, w["q"].astype(x.dtype)) * w["s"].astype(x.dtype)
+    return x @ w
+
+
+def _embed_rows(params: Params, tokens: jnp.ndarray, dtype) -> jnp.ndarray:
+    """Embedding gather for dense or quantized embed tables."""
+    e = params["embed"]
+    if _is_quant(e):
+        return (e["q"][tokens].astype(dtype)
+                * e["s"][tokens][..., None].astype(dtype))
+    return e[tokens].astype(dtype)
+
+
+def quantize_tensor(w, axis: int):
+    """Symmetric per-channel int8: scale = amax/127 over `axis`."""
+    wf = jnp.asarray(w, jnp.float32)
+    s = jnp.max(jnp.abs(wf), axis=axis) / 127.0
+    s = jnp.maximum(s, 1e-10)
+    q = jnp.clip(
+        jnp.round(wf / jnp.expand_dims(s, axis)), -127, 127
+    ).astype(jnp.int8)
+    return {"q": q, "s": s.astype(jnp.float32)}
+
+
+def quantize_params(params: Params) -> Params:
+    """Post-load transform: dense params -> w8a16. Norms and the MoE
+    router stay dense (tiny, accuracy-sensitive)."""
+    out = dict(params)
+    layers = dict(params["layers"])
+    for name, axis in _QUANT_AXIS.items():
+        if name in layers:
+            layers[name] = quantize_tensor(layers[name], axis)
+    out["layers"] = layers
+    out["embed"] = quantize_tensor(params["embed"], _QUANT_AXIS["embed"])
+    if "lm_head" in params:
+        out["lm_head"] = quantize_tensor(
+            params["lm_head"], _QUANT_AXIS["lm_head"]
+        )
+    return out
+
+
+# ---------------------------------------------------------------------------
 # Forward pieces
 
 def rms_norm(x: jnp.ndarray, w: jnp.ndarray, eps: float) -> jnp.ndarray:
@@ -209,7 +303,7 @@ def rms_norm(x: jnp.ndarray, w: jnp.ndarray, eps: float) -> jnp.ndarray:
 
 
 def _mlp(h, wg, wu, wd):
-    return (jax.nn.silu(h @ wg) * (h @ wu)) @ wd
+    return _mm(jax.nn.silu(_mm(h, wg)) * _mm(h, wu), wd)
 
 
 def _moe_ffn(c: ModelConfig, lp, x: jnp.ndarray,
@@ -257,9 +351,16 @@ def _moe_ffn(c: ModelConfig, lp, x: jnp.ndarray,
     x_rep = x_rep.reshape(T * K, c.hidden_size)
     buf = jnp.einsum("sec,sh->ech", slot, x_rep.astype(jnp.float32))
     buf = buf.astype(x.dtype)
-    y = (jax.nn.silu(jnp.einsum("ech,ehi->eci", buf, lp["we_g"]))
-         * jnp.einsum("ech,ehi->eci", buf, lp["we_u"]))
-    y = jnp.einsum("eci,eih->ech", y, lp["we_d"])      # [E, C, H]
+    def emm(spec, a, w):
+        # expert einsum, dense or quantized (scale is per [E, out])
+        if _is_quant(w):
+            return (jnp.einsum(spec, a, w["q"].astype(a.dtype))
+                    * w["s"][:, None, :].astype(a.dtype))
+        return jnp.einsum(spec, a, w)
+
+    y = (jax.nn.silu(emm("ech,ehi->eci", buf, lp["we_g"]))
+         * emm("ech,ehi->eci", buf, lp["we_u"]))
+    y = emm("eci,eih->ech", y, lp["we_d"])             # [E, C, H]
     out = jnp.einsum("sec,ech->sh", slot, y.astype(jnp.float32))
     out = out.reshape(T, K, c.hidden_size) * gate_w[..., None]
     return out.sum(axis=1).astype(x.dtype)
@@ -281,14 +382,14 @@ def _layer_body(c: ModelConfig, lp, h, cos, sin, write_kv, attend,
     """
     N = h.shape[0]
     x = rms_norm(h, lp["ln1"], c.rms_norm_eps)
-    q = (x @ lp["wq"]).reshape(N, c.num_heads, c.head_dim)
-    k = (x @ lp["wk"]).reshape(N, c.num_kv_heads, c.head_dim)
-    v = (x @ lp["wv"]).reshape(N, c.num_kv_heads, c.head_dim)
+    q = _mm(x, lp["wq"]).reshape(N, c.num_heads, c.head_dim)
+    k = _mm(x, lp["wk"]).reshape(N, c.num_kv_heads, c.head_dim)
+    v = _mm(x, lp["wv"]).reshape(N, c.num_kv_heads, c.head_dim)
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
     new_cache = write_kv(k, v)
     attn = attend(q, new_cache)
-    h = h + attn.reshape(N, c.q_dim) @ lp["wo"]
+    h = h + _mm(attn.reshape(N, c.q_dim), lp["wo"])
     x2 = rms_norm(h, lp["ln2"], c.rms_norm_eps)
     h = h + _ffn(c, lp, x2, ffn_valid)
     return h, new_cache
@@ -296,7 +397,15 @@ def _layer_body(c: ModelConfig, lp, h, cos, sin, write_kv, attend,
 
 def _logits(config: ModelConfig, params: Params, h: jnp.ndarray) -> jnp.ndarray:
     h = rms_norm(h, params["norm_f"], config.rms_norm_eps)
-    w = params["embed"].T if config.tie_word_embeddings else params["lm_head"]
+    w = params["embed"] if config.tie_word_embeddings else params["lm_head"]
+    if _is_quant(w):
+        q = w["q"].T if config.tie_word_embeddings else w["q"]  # [H, V]
+        y = jnp.matmul(
+            h, q.astype(h.dtype), preferred_element_type=jnp.float32
+        )
+        return y * w["s"]  # s is [V] for both orientations
+    if config.tie_word_embeddings:
+        w = w.T
     # f32 accumulation without materializing an f32 copy of the [H, V] matrix
     return jnp.matmul(h, w, preferred_element_type=jnp.float32)
 
@@ -338,7 +447,7 @@ def prefill_impl(
     positions = q_start + jnp.arange(T, dtype=jnp.int32)
     cos, sin = rope_cos_sin(positions, inv_freq)
 
-    h = params["embed"][tokens].astype(ctx_kv["k"].dtype)
+    h = _embed_rows(params, tokens, ctx_kv["k"].dtype)
     if embeds is not None:
         h = jnp.where(embeds_mask[:, None], embeds.astype(h.dtype), h)
 
@@ -393,6 +502,102 @@ def prefill_impl(
 prefill = jax.jit(prefill_impl, static_argnums=(0,), donate_argnums=(2,))
 
 
+def batch_prefill_impl(
+    config: ModelConfig,
+    params: Params,
+    ctx_kv: Cache,
+    tokens: jnp.ndarray,    # [K, T] int32, bucket-padded per request
+    slots: jnp.ndarray,     # [K] i32 — destination slot lanes (distinct)
+    q_starts: jnp.ndarray,  # [K] i32 — tokens already in each region
+    seq_lens: jnp.ndarray,  # [K] i32 — total valid context per request
+    ctx_span: int = 0,      # STATIC: prior-context window to attend
+                            # (pow2 >= max(q_starts); 0 = fresh prefill,
+                            # no context read compiled at all)
+) -> tuple[Cache, jnp.ndarray]:
+    """Batched multi-request prefill: K chunks through the model in ONE
+    program — the TTFT lever for concurrent arrivals (reference analogue:
+    vLLM's max_num_batched_tokens prefill batching; the per-request
+    `prefill` above keeps the multimodal-embeds and odd-shape paths).
+
+    Matmuls see [K*T, H] rows (the MXU-utilization win over K separate
+    [T, H] dispatches); attention is the blocked flash scan
+    (ops/attention.py flash_prefill_attention), so no [T, S+T] score
+    tensor materializes. Per-request KV lands in each slot's contiguous
+    region at [q_start_k, q_start_k+T); all writes happen in one tail
+    pass after the last read (the round-4 no-interleave discipline —
+    models/llama.py module doc). Returns (ctx_kv, logits[K, vocab]) with
+    each row the last valid token's logits.
+
+    Padding lanes (group smaller than the compiled K): point slot at the
+    scratch lane (batch index B) with seq_len=0 — ffn_valid masks their
+    tokens out of MoE routing and their region writes hit scratch.
+    """
+    c = config
+    K, T = tokens.shape
+    inv_freq = jnp.asarray(
+        rope_inv_freq(c.head_dim, c.rope_theta, c.rope_scaling_dict)
+    )
+
+    def compute(toks, slot, q_start, seq_len):
+        """Read-only per-request layer stack (vmapped over K): returns
+        stacked per-layer KV + last-token logits; region writes happen
+        outside the vmap (a shared-buffer update inside vmap would be a
+        scatter with lane-conflict semantics)."""
+        positions = q_start + jnp.arange(T, dtype=jnp.int32)
+        cos, sin = rope_cos_sin(positions, inv_freq)
+        h = _embed_rows(params, toks, ctx_kv["k"].dtype)
+        new_ks: list[jnp.ndarray] = []
+        new_vs: list[jnp.ndarray] = []
+        for l in range(c.num_layers):
+            lp = jax.tree.map(lambda x: x[l], params["layers"])
+
+            def write_kv(k, v):
+                new_ks.append(k)
+                new_vs.append(v)
+                return (k, v)
+
+            def attend(q, kv, l=l):
+                k_new, v_new = kv
+                if ctx_span > 0:
+                    k_ctx = jax.lax.dynamic_index_in_dim(
+                        ctx_kv["k"][l], slot, axis=1, keepdims=False
+                    )[:, :ctx_span]
+                    v_ctx = jax.lax.dynamic_index_in_dim(
+                        ctx_kv["v"][l], slot, axis=1, keepdims=False
+                    )[:, :ctx_span]
+                else:
+                    k_ctx = v_ctx = None
+                return flash_prefill_attention(
+                    q, k_ctx, v_ctx, k_new, v_new, q_start, seq_len
+                )
+
+            h, _ = _layer_body(c, lp, h, cos, sin, write_kv, attend,
+                               ffn_valid=positions < seq_len)
+        last = seq_len - q_start - 1
+        logits = _logits(c, params, h[last])
+        return (
+            jnp.stack(new_ks).astype(ctx_kv["k"].dtype),
+            jnp.stack(new_vs).astype(ctx_kv["v"].dtype),
+            logits,
+        )
+
+    ks, vs, logits = jax.vmap(compute)(tokens, slots, q_starts, seq_lens)
+    # tail: K span writes per buffer, K static (unrolled), all reads done
+    ck, cv = ctx_kv["k"], ctx_kv["v"]
+    for i in range(K):
+        upd_k = ks[i].transpose(0, 2, 1, 3)[:, :, None]  # [L,kvh,1,T,hd]
+        upd_v = vs[i].transpose(0, 2, 1, 3)[:, :, None]
+        at = (0, 0, slots[i], q_starts[i], 0)
+        ck = jax.lax.dynamic_update_slice(ck, upd_k, at)
+        cv = jax.lax.dynamic_update_slice(cv, upd_v, at)
+    return {"k": ck, "v": cv}, logits
+
+
+batch_prefill = jax.jit(
+    batch_prefill_impl, static_argnums=(0, 7), donate_argnums=(2,)
+)
+
+
 # ---------------------------------------------------------------------------
 # Decode
 
@@ -424,7 +629,7 @@ def decode_step_impl(
     positions = jnp.maximum(ctx_lens - 1, 0)
     cos, sin = rope_cos_sin(positions, inv_freq)  # [B, hd]
 
-    h = params["embed"][tokens].astype(ctx_kv["k"].dtype)  # [B, H]
+    h = _embed_rows(params, tokens, ctx_kv["k"].dtype)  # [B, H]
 
     # unrolled layers — see prefill_impl for why not lax.scan
     for l in range(c.num_layers):
@@ -606,7 +811,7 @@ def sp_prefill(
     )
     positions = jnp.arange(T, dtype=jnp.int32)
     cos, sin = rope_cos_sin(positions, inv_freq)
-    h = params["embed"][tokens].astype(jnp.dtype(c.dtype))
+    h = _embed_rows(params, tokens, jnp.dtype(c.dtype))
 
     ks, vs = [], []
     rep = c.num_heads // c.num_kv_heads
@@ -656,7 +861,7 @@ def encode_impl(
     )
     positions = jnp.arange(T, dtype=jnp.int32)
     cos, sin = rope_cos_sin(positions, inv_freq)
-    h = params["embed"][tokens].astype(jnp.dtype(c.dtype))
+    h = _embed_rows(params, tokens, jnp.dtype(c.dtype))
     valid = positions < seq_len                                   # [T]
     causal = (positions[None, :] <= positions[:, None]) & valid[None, :]
 
@@ -788,6 +993,9 @@ def load_hf_params(
                     raw[name] = f.get_tensor(name)
         params = params_from_state_dict(config, raw, dtype)
         del raw
+        if config.quant == "int8":
+            # quantize on the host: the dense 8B never touches the chip
+            params = quantize_params(params)
     if shardings is not None:
         params = jax.tree.map(
             lambda x, s: jax.device_put(x, s), params, shardings

@@ -9,11 +9,16 @@ discipline as models/llama.py), bidirectional attention, and a projector
 to the language model's hidden size. The output is a sequence of image
 tokens the llama prefill consumes in place of ``<image>`` placeholder
 embeddings (llama.prefill token_embeds).
+
+The parameter tree is CLIP-vision-tower shaped (biases, class token,
+pre-embedding layernorm, post layernorm, LLaVA-style 2-layer projector)
+so real checkpoints load via ``load_vision_params`` — random init keeps
+the same tree with zero biases and identity norms.
 """
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Any
+from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp
@@ -32,10 +37,17 @@ class VisionConfig:
     num_heads: int = 16
     out_hidden_size: int = 4096   # language model hidden size
     layer_norm_eps: float = 1e-5
+    # CLIP prepends a learned class token; LLaVA drops it from the
+    # projector input (patch tokens only)
+    use_class_token: bool = False
 
     @property
     def num_patches(self) -> int:
         return (self.image_size // self.patch_size) ** 2
+
+    @property
+    def num_positions(self) -> int:
+        return self.num_patches + (1 if self.use_class_token else 0)
 
     @property
     def patch_dim(self) -> int:
@@ -46,81 +58,236 @@ class VisionConfig:
         return self.hidden_size // self.num_heads
 
     @classmethod
-    def tiny(cls, out_hidden_size: int = 64) -> "VisionConfig":
+    def tiny(cls, out_hidden_size: int = 64, **kw) -> "VisionConfig":
         """CPU-test shapes."""
-        return cls(image_size=16, patch_size=4, hidden_size=32,
-                   intermediate_size=64, num_layers=2, num_heads=4,
-                   out_hidden_size=out_hidden_size)
+        base = dict(image_size=16, patch_size=4, hidden_size=32,
+                    intermediate_size=64, num_layers=2, num_heads=4,
+                    out_hidden_size=out_hidden_size)
+        base.update(kw)
+        return cls(**base)
+
+    @classmethod
+    def from_hf(cls, d: dict[str, Any],
+                out_hidden_size: int = 4096) -> "VisionConfig":
+        """From a HF ``vision_config`` section (CLIPVisionConfig keys)."""
+        return cls(
+            image_size=d.get("image_size", 224),
+            patch_size=d.get("patch_size", 14),
+            hidden_size=d.get("hidden_size", 1024),
+            intermediate_size=d.get("intermediate_size", 4096),
+            num_layers=d.get("num_hidden_layers", 24),
+            num_heads=d.get("num_attention_heads", 16),
+            out_hidden_size=out_hidden_size,
+            layer_norm_eps=d.get("layer_norm_eps", 1e-5),
+            use_class_token=True,
+        )
 
 
 def init_vision_params(cfg: VisionConfig, rng: jax.Array | int = 0,
                        dtype=jnp.float32) -> Params:
     if isinstance(rng, int):
         rng = jax.random.PRNGKey(rng)
-    keys = jax.random.split(rng, 10)
+    keys = jax.random.split(rng, 12)
     L, H, I = cfg.num_layers, cfg.hidden_size, cfg.intermediate_size
 
     def rnd(k, *s):
         return (jax.random.normal(k, s, jnp.float32)
                 / np.sqrt(s[-2] if len(s) > 1 else s[-1])).astype(dtype)
 
-    return {
+    params: Params = {
         "patch_embed": rnd(keys[0], cfg.patch_dim, H),
-        "pos_embed": (jax.random.normal(keys[1], (cfg.num_patches, H),
+        "patch_bias": jnp.zeros((H,), dtype),
+        "pos_embed": (jax.random.normal(keys[1], (cfg.num_positions, H),
                                         jnp.float32) * 0.02).astype(dtype),
+        "ln_pre": jnp.ones((H,), dtype),
+        "ln_pre_b": jnp.zeros((H,), dtype),
         "layers": {
             "ln1": jnp.ones((L, H), dtype),
+            "ln1_b": jnp.zeros((L, H), dtype),
             "ln2": jnp.ones((L, H), dtype),
-            "wq": rnd(keys[2], L, H, H),
-            "wk": rnd(keys[3], L, H, H),
-            "wv": rnd(keys[4], L, H, H),
-            "wo": rnd(keys[5], L, H, H),
-            "w1": rnd(keys[6], L, H, I),
-            "w2": rnd(keys[7], L, I, H),
+            "ln2_b": jnp.zeros((L, H), dtype),
+            "wq": rnd(keys[2], L, H, H), "bq": jnp.zeros((L, H), dtype),
+            "wk": rnd(keys[3], L, H, H), "bk": jnp.zeros((L, H), dtype),
+            "wv": rnd(keys[4], L, H, H), "bv": jnp.zeros((L, H), dtype),
+            "wo": rnd(keys[5], L, H, H), "bo": jnp.zeros((L, H), dtype),
+            "w1": rnd(keys[6], L, H, I), "b1": jnp.zeros((L, I), dtype),
+            "w2": rnd(keys[7], L, I, H), "b2": jnp.zeros((L, H), dtype),
         },
         "ln_f": jnp.ones((H,), dtype),
+        "ln_f_b": jnp.zeros((H,), dtype),
         "proj": rnd(keys[8], H, cfg.out_hidden_size),
+        "proj_b": jnp.zeros((cfg.out_hidden_size,), dtype),
     }
+    if cfg.use_class_token:
+        params["cls"] = (jax.random.normal(keys[9], (H,), jnp.float32)
+                         * 0.02).astype(dtype)
+    return params
 
 
-def _ln(x, w, eps):
+def _ln(x, w, b, eps):
     xf = x.astype(jnp.float32)
     mu = xf.mean(-1, keepdims=True)
     var = ((xf - mu) ** 2).mean(-1, keepdims=True)
-    return ((xf - mu) * jax.lax.rsqrt(var + eps)).astype(x.dtype) * w
+    return ((xf - mu) * jax.lax.rsqrt(var + eps)).astype(x.dtype) * w + b
 
 
 def encode_image_impl(
     cfg: VisionConfig, params: Params, image: jnp.ndarray
 ) -> jnp.ndarray:
-    """[H, W, 3] float image (0..1) -> [num_patches, out_hidden] tokens."""
+    """[H, W, 3] float image (0..1) -> [num_patches, out_hidden] tokens.
+    With a class token it joins the transformer but is dropped before the
+    projector (the LLaVA select_feature="patch" convention)."""
     c = cfg
     p = c.patch_size
     n = c.image_size // p
     # patchify: [n, p, n, p, 3] -> [n*n, p*p*3] (stride==kernel conv)
     patches = image.reshape(n, p, n, p, 3).transpose(0, 2, 1, 3, 4)
     patches = patches.reshape(n * n, c.patch_dim)
-    h = patches.astype(params["patch_embed"].dtype) @ params["patch_embed"]
+    h = (patches.astype(params["patch_embed"].dtype)
+         @ params["patch_embed"] + params["patch_bias"])
+    if c.use_class_token:
+        h = jnp.concatenate([params["cls"][None], h], axis=0)
     h = h + params["pos_embed"]
+    h = _ln(h, params["ln_pre"], params["ln_pre_b"], c.layer_norm_eps)
 
     nh, hd = c.num_heads, c.head_dim
     for l in range(c.num_layers):
         lp = jax.tree.map(lambda x: x[l], params["layers"])
-        x = _ln(h, lp["ln1"], c.layer_norm_eps)
-        q = (x @ lp["wq"]).reshape(-1, nh, hd)
-        k = (x @ lp["wk"]).reshape(-1, nh, hd)
-        v = (x @ lp["wv"]).reshape(-1, nh, hd)
+        x = _ln(h, lp["ln1"], lp["ln1_b"], c.layer_norm_eps)
+        q = (x @ lp["wq"] + lp["bq"]).reshape(-1, nh, hd)
+        k = (x @ lp["wk"] + lp["bk"]).reshape(-1, nh, hd)
+        v = (x @ lp["wv"] + lp["bv"]).reshape(-1, nh, hd)
         s = jnp.einsum("qhd,khd->hqk", q, k,
                        preferred_element_type=jnp.float32) / np.sqrt(hd)
         w = jax.nn.softmax(s, axis=-1)
         attn = jnp.einsum("hqk,khd->qhd", w.astype(v.dtype), v,
                           preferred_element_type=jnp.float32)
-        h = h + attn.astype(h.dtype).reshape(-1, c.hidden_size) @ lp["wo"]
-        x2 = _ln(h, lp["ln2"], c.layer_norm_eps)
-        h = h + jax.nn.gelu(x2 @ lp["w1"]) @ lp["w2"]
+        h = h + (attn.astype(h.dtype).reshape(-1, c.hidden_size)
+                 @ lp["wo"] + lp["bo"])
+        x2 = _ln(h, lp["ln2"], lp["ln2_b"], c.layer_norm_eps)
+        h = h + (jax.nn.gelu(x2 @ lp["w1"] + lp["b1"]) @ lp["w2"]
+                 + lp["b2"])
 
-    h = _ln(h, params["ln_f"], c.layer_norm_eps)
-    return h @ params["proj"]   # [num_patches, out_hidden]
+    h = _ln(h, params["ln_f"], params["ln_f_b"], c.layer_norm_eps)
+    if c.use_class_token:
+        h = h[1:]                 # patch tokens only
+    h = h @ params["proj"] + params["proj_b"]
+    if "proj2" in params:         # LLaVA 2-layer projector
+        h = jax.nn.gelu(h) @ params["proj2"] + params["proj2_b"]
+    return h                      # [num_patches, out_hidden]
 
 
 encode_image = jax.jit(encode_image_impl, static_argnums=(0,))
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint loading (CLIP vision tower + LLaVA projector names)
+
+_TOWER_PREFIXES = (
+    "vision_tower.vision_model.",     # LLaVA checkpoints
+    "vision_model.",                  # bare CLIPVisionModel
+    "model.vision_tower.vision_model.",
+)
+
+
+def load_vision_params(
+    cfg: VisionConfig, model_dir: str, dtype=jnp.float32
+) -> Params:
+    """Load a CLIP-shape vision tower (+ optional LLaVA
+    ``multi_modal_projector``) from a HF model directory's safetensors.
+
+    The conv patch embedding [H, 3, p, p] becomes our patch matmul
+    [p*p*3, H] (stride==kernel conv == matmul over flattened patches —
+    flatten order (p_h, p_w, chan) matches encode_image_impl's
+    patchify). Torch linears are [out, in] and transpose, exactly like
+    models/llama.py params_from_state_dict."""
+    import glob
+    import os
+
+    from safetensors import safe_open
+
+    raw: dict[str, np.ndarray] = {}
+    for fp in sorted(glob.glob(os.path.join(model_dir, "*.safetensors"))):
+        with safe_open(fp, framework="numpy") as f:
+            for name in f.keys():
+                raw[name] = f.get_tensor(name)
+
+    prefix = None
+    for cand in _TOWER_PREFIXES:
+        if any(k.startswith(cand) for k in raw):
+            prefix = cand
+            break
+    if prefix is None:
+        raise FileNotFoundError(
+            f"no CLIP vision tower found in {model_dir} "
+            f"(looked for prefixes {_TOWER_PREFIXES})"
+        )
+
+    def t(name: str) -> np.ndarray:
+        return np.asarray(raw[prefix + name], np.float32)
+
+    L, H = cfg.num_layers, cfg.hidden_size
+    conv = t("embeddings.patch_embedding.weight")      # [H, 3, p, p]
+    patch_embed = conv.transpose(2, 3, 1, 0).reshape(cfg.patch_dim, H)
+    layers: dict[str, list] = {k: [] for k in (
+        "ln1", "ln1_b", "ln2", "ln2_b", "wq", "bq", "wk", "bk",
+        "wv", "bv", "wo", "bo", "w1", "b1", "w2", "b2",
+    )}
+    for l in range(L):
+        p = f"encoder.layers.{l}."
+        layers["ln1"].append(t(p + "layer_norm1.weight"))
+        layers["ln1_b"].append(t(p + "layer_norm1.bias"))
+        layers["ln2"].append(t(p + "layer_norm2.weight"))
+        layers["ln2_b"].append(t(p + "layer_norm2.bias"))
+        for ours, theirs in (("q", "q_proj"), ("k", "k_proj"),
+                             ("v", "v_proj"), ("o", "out_proj")):
+            layers[f"w{ours}"].append(t(p + f"self_attn.{theirs}.weight").T)
+            layers[f"b{ours}"].append(t(p + f"self_attn.{theirs}.bias"))
+        layers["w1"].append(t(p + "mlp.fc1.weight").T)
+        layers["b1"].append(t(p + "mlp.fc1.bias"))
+        layers["w2"].append(t(p + "mlp.fc2.weight").T)
+        layers["b2"].append(t(p + "mlp.fc2.bias"))
+
+    params: Params = {
+        "patch_embed": jnp.asarray(patch_embed, dtype),
+        "patch_bias": jnp.asarray(
+            raw.get(prefix + "embeddings.patch_embedding.bias",
+                    np.zeros(H, np.float32)), dtype),
+        "pos_embed": jnp.asarray(
+            t("embeddings.position_embedding.weight"), dtype),
+        "ln_pre": jnp.asarray(
+            raw.get(prefix + "pre_layrnorm.weight",
+                    np.ones(H, np.float32)), dtype),
+        "ln_pre_b": jnp.asarray(
+            raw.get(prefix + "pre_layrnorm.bias",
+                    np.zeros(H, np.float32)), dtype),
+        "layers": {
+            k: jnp.asarray(np.stack(v), dtype) for k, v in layers.items()
+        },
+        "ln_f": jnp.asarray(t("post_layernorm.weight"), dtype),
+        "ln_f_b": jnp.asarray(t("post_layernorm.bias"), dtype),
+    }
+    if cfg.use_class_token:
+        params["cls"] = jnp.asarray(t("embeddings.class_embedding"), dtype)
+
+    proj_w = raw.get("multi_modal_projector.linear_1.weight")
+    if proj_w is not None:
+        params["proj"] = jnp.asarray(np.asarray(proj_w, np.float32).T, dtype)
+        params["proj_b"] = jnp.asarray(
+            raw.get("multi_modal_projector.linear_1.bias",
+                    np.zeros(proj_w.shape[0], np.float32)), dtype)
+        w2 = raw.get("multi_modal_projector.linear_2.weight")
+        if w2 is not None:
+            params["proj2"] = jnp.asarray(np.asarray(w2, np.float32).T, dtype)
+            params["proj2_b"] = jnp.asarray(
+                raw.get("multi_modal_projector.linear_2.bias",
+                        np.zeros(w2.shape[0], np.float32)), dtype)
+    elif cfg.out_hidden_size == H:
+        params["proj"] = jnp.eye(H, dtype=dtype)
+        params["proj_b"] = jnp.zeros((H,), dtype)
+    else:
+        raise ValueError(
+            "no multi_modal_projector in checkpoint and out_hidden_size "
+            f"{cfg.out_hidden_size} != tower hidden {H}"
+        )
+    return params

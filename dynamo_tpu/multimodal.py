@@ -87,14 +87,17 @@ class EncodeWorker:
         self.worker_id = worker_id
         self.images_encoded = 0
         self._served = None
+        self._frames = None   # ArrayFrameServer (RPC transport only)
 
-    async def _handle(self, payload: dict) -> AsyncIterator[dict]:
+    async def encode_arrays(self, images: list[dict]) -> list[np.ndarray]:
+        """Encode to raw [num_patches, out_hidden] f32 arrays (the
+        in-process path — no transport)."""
         import asyncio
 
         from dynamo_tpu.models.vision import encode_image
 
         out = []
-        for im in payload.get("images", []):
+        for im in images:
             arr = decode_image_payload(im)
             emb = await asyncio.to_thread(
                 lambda a=arr: np.asarray(
@@ -102,10 +105,29 @@ class EncodeWorker:
                 )
             )
             self.images_encoded += 1
-            out.append(emb.tolist())
+            out.append(emb)
+        return out
+
+    async def _handle(self, payload: dict) -> AsyncIterator[dict]:
+        """RPC path: embeddings go as array-frame TICKETS, not JSON float
+        lists — the peer collects the raw tensors over the frame2 side
+        channel (reference moves them via NIXL, encode_worker.py:148).
+        A LLaVA-scale image is ~9 MB of f32; JSON would 10x that."""
+        embs = await self.encode_arrays(payload.get("images", []))
+        out = []
+        for emb in embs:
+            out.append({
+                "ticket": self._frames.park(emb),
+                "host": self._frames.host, "port": self._frames.port,
+                "shape": list(emb.shape),
+            })
         yield {"embeddings": out}
 
     async def start(self) -> "EncodeWorker":
+        from dynamo_tpu.kv_transfer import ArrayFrameServer
+
+        self._frames = ArrayFrameServer()
+        await self._frames.start()
         ep = self.rt.namespace(self.namespace).component(
             self.component
         ).endpoint("encode")
@@ -116,6 +138,9 @@ class EncodeWorker:
         if self._served is not None:
             await self._served.shutdown()
             self._served = None
+        if self._frames is not None:
+            await self._frames.stop()
+            self._frames = None
 
 
 class MultimodalEngine:
@@ -138,18 +163,26 @@ class MultimodalEngine:
         self.images_resolved = 0
         self._client = None
 
-    async def _encode(self, images: list[dict]) -> list[list]:
+    async def _encode(self, images: list[dict]) -> list[np.ndarray]:
         if self.local_encoder is not None:
-            out = None
-            async for item in self.local_encoder._handle({"images": images}):
-                out = item
-            return out["embeddings"]
+            return await self.local_encoder.encode_arrays(images)
         if self._client is None:
             self._client = await self.rt.namespace(self.namespace).component(
                 self.component
             ).endpoint("encode").client()
         async for item in self._client.generate({"images": images}):
-            return item["embeddings"]
+            from dynamo_tpu.kv_transfer import take_remote_array
+
+            out: list[np.ndarray] = []
+            for ent in item["embeddings"]:
+                if isinstance(ent, dict) and "ticket" in ent:
+                    # array-frame transport: collect the raw tensor
+                    out.append(await take_remote_array(
+                        ent["host"], ent["port"], ent["ticket"]
+                    ))
+                else:  # legacy float-list responses stay readable
+                    out.append(np.asarray(ent, np.float32))
+            return out
         raise RuntimeError("encode endpoint returned no response")
 
     async def generate(

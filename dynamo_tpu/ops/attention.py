@@ -112,3 +112,106 @@ def ctx_prefill_attention(
         preferred_element_type=jnp.float32,
     )
     return out.astype(q.dtype)
+
+def flash_prefill_attention(
+    q: jnp.ndarray,        # [T, n_heads, hd] — new tokens (padded)
+    k_ctx: Optional[jnp.ndarray],  # [kvh, Sc, hd] prior context, or None
+    v_ctx: Optional[jnp.ndarray],
+    k_new: jnp.ndarray,    # [T, kvh, hd] — this chunk's keys
+    v_new: jnp.ndarray,
+    q_start: jnp.ndarray,  # scalar i32 — #tokens already in the region
+    seq_len: jnp.ndarray,  # scalar i32 — total valid context length
+    block: int = 256,
+) -> jnp.ndarray:
+    """Blocked running-softmax ("flash") prefill attention in pure XLA.
+
+    Same semantics as ctx_prefill_attention — T new tokens at positions
+    q_start..q_start+T attend prior context [0, q_start) plus the chunk
+    causally — but scores never materialize beyond [nh, T, block], so
+    large chunks (T in the thousands) don't allocate the [T, S+T] f32
+    score tensor the dense path does (32 heads x 3072^2 x 4B = 1.2 GB per
+    layer). lax.scan over key blocks with the standard (m, l, acc)
+    running-max rescale; attention FLOPs are a rounding error next to the
+    parameter matmuls at serving sizes, so the causal 2x block waste is
+    taken in exchange for compiler-friendly static control flow.
+
+    Pass k_ctx=None for fresh prefill (q_start==0 everywhere): the
+    context scan is omitted entirely from the compiled program instead of
+    masked out. The reference's analogue of this split is vLLM's
+    prefill-vs-extend kernel dispatch.
+    """
+    T, n_heads, hd = q.shape
+    kvh = k_new.shape[1]
+    n_rep = n_heads // kvh
+    scale = 1.0 / jnp.sqrt(jnp.asarray(hd, jnp.float32))
+    qt = q.transpose(1, 0, 2)            # [nh, T, hd]
+    q_pos = q_start + jnp.arange(T)      # [T]
+
+    m0 = jnp.full((n_heads, T), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((n_heads, T), jnp.float32)
+    acc0 = jnp.zeros((n_heads, T, hd), jnp.float32)
+
+    def blocked(k_src, v_src, mask_fn, carry):
+        """Scan key blocks of k_src [kvh, S, hd]; mask_fn(key_pos[blk],
+        q_pos[T]) -> [T, blk] validity."""
+        S = k_src.shape[1]
+        blk = min(block, S)
+        nblk = -(-S // blk)
+        if nblk * blk != S:  # pad the tail block; masks exclude it
+            pad = ((0, 0), (0, nblk * blk - S), (0, 0))
+            k_src = jnp.pad(k_src, pad)
+            v_src = jnp.pad(v_src, pad)
+        kb = k_src.reshape(kvh, nblk, blk, hd).transpose(1, 0, 2, 3)
+        vb = v_src.reshape(kvh, nblk, blk, hd).transpose(1, 0, 2, 3)
+        starts = jnp.arange(nblk, dtype=jnp.int32) * blk
+
+        def step(c, x):
+            m, l, acc = c
+            k_blk, v_blk, start = x           # [kvh, blk, hd]
+            k_rep = jnp.repeat(k_blk, n_rep, axis=0)
+            v_rep = jnp.repeat(v_blk, n_rep, axis=0)
+            s = jnp.einsum(
+                "nth,nbh->ntb", qt, k_rep,
+                preferred_element_type=jnp.float32,
+            ) * scale                          # [nh, T, blk]
+            key_pos = start + jnp.arange(blk)
+            s = jnp.where(mask_fn(key_pos)[None], s, NEG_INF)
+            m_new = jnp.maximum(m, s.max(axis=-1))
+            alpha = jnp.exp(m - m_new)
+            p = jnp.exp(s - m_new[..., None])
+            l_new = l * alpha + p.sum(axis=-1)
+            acc_new = acc * alpha[..., None] + jnp.einsum(
+                "ntb,nbh->nth", p.astype(v_rep.dtype), v_rep,
+                preferred_element_type=jnp.float32,
+            )
+            return (m_new, l_new, acc_new), None
+
+        carry, _ = jax.lax.scan(step, carry, (kb, vb, starts))
+        return carry
+
+    carry = (m0, l0, acc0)
+    if k_ctx is not None:
+        # prior context: valid below q_start (q_start <= seq_len always)
+        carry = blocked(
+            k_ctx, v_ctx,
+            lambda kp: jnp.broadcast_to(
+                (kp < q_start) & (kp < seq_len), (T, kp.shape[0])
+            ),
+            carry,
+        )
+    # the chunk itself: causal, bounded by seq_len
+    carry = blocked(
+        k_new.transpose(1, 0, 2).astype(qt.dtype),
+        v_new.transpose(1, 0, 2).astype(qt.dtype),
+        lambda kp: ((q_start + kp)[None, :] <= q_pos[:, None])
+        & ((q_start + kp) < seq_len)[None, :],
+        carry,
+    )
+    m, l, acc = carry
+    # fully-masked rows (padding queries): their blocks contribute
+    # p = exp(NEG_INF - NEG_INF) = 1 per key (NEG_INF is finite), so l
+    # ends at the key count, not 0 — gate on the running max never having
+    # seen a real (unmasked) score and emit zeros explicitly
+    out = acc / jnp.maximum(l, 1e-30)[..., None]     # [nh, T, hd]
+    out = jnp.where((m > NEG_INF / 2)[..., None], out, 0.0)
+    return out.transpose(1, 0, 2).astype(q.dtype)

@@ -96,6 +96,75 @@ class LocalConnector:
                 p.kill()  # backstop for workers ignoring SIGTERM
 
 
+class MultihostLocalConnector:
+    """DP replicas OF a cross-host engine (BASELINE config 4 x planner):
+    each replica is a GROUP of ``num_nodes`` processes — rank 0 the
+    in=endpoint leader, the rest replay followers — spawned and retired
+    together. Command args are templated with ``{rank}``, ``{coord}``
+    (a fresh coordinator address per group) and ``{replica}`` (unique
+    component suffix, so concurrent groups' bring-up barriers and command
+    queues never collide)."""
+
+    def __init__(self, cmd_template: list[str], num_nodes: int = 2,
+                 host: str = "127.0.0.1",
+                 env: Optional[dict[str, str]] = None):
+        self.cmd_template = list(cmd_template)
+        self.num_nodes = num_nodes
+        self.host = host
+        self.env = env
+        self.groups: list[list[subprocess.Popen]] = []
+        self._next_replica = 0
+
+    @staticmethod
+    def _free_port() -> int:
+        import socket
+
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return port
+
+    def current_replicas(self) -> int:
+        # a group is alive while its LEADER is (followers die with it via
+        # the liveness key)
+        self.groups = [g for g in self.groups if g[0].poll() is None]
+        return len(self.groups)
+
+    async def set_replicas(self, n: int) -> None:
+        self.current_replicas()
+        while len(self.groups) < n:
+            replica = self._next_replica
+            self._next_replica += 1
+            coord = f"{self.host}:{self._free_port()}"
+            group = []
+            for rank in range(self.num_nodes):
+                cmd = [
+                    a.format(rank=rank, coord=coord, replica=replica)
+                    for a in self.cmd_template
+                ]
+                group.append(subprocess.Popen(
+                    cmd, stdout=subprocess.DEVNULL,
+                    stderr=subprocess.DEVNULL, start_new_session=True,
+                    env=self.env,
+                ))
+            self.groups.append(group)
+            log.info("planner: spawned multihost group %d (%d procs)",
+                     replica, self.num_nodes)
+        while len(self.groups) > n:
+            group = self.groups.pop()
+            log.info("planner: retiring multihost group")
+            group[0].terminate()  # leader exit tears the group down
+
+    async def shutdown(self) -> None:
+        groups = list(self.groups)
+        await self.set_replicas(0)
+        for g in groups:
+            for p in g:
+                if p.poll() is None:
+                    p.kill()
+
+
 class Planner:
     """The observe -> decide -> scale loop (planner_core.py:131-168)."""
 

@@ -121,11 +121,349 @@ def test_spm_tokenizer_encode_decode(tmp_path):
     assert tok.stop_token_ids == [2]
 
 
-def test_bpe_gguf_rejected(tmp_path):
+def test_bpe_gguf_dispatch(tmp_path):
+    """gpt2-model GGUFs now dispatch to the byte-level BPE tokenizer
+    (reference gguf_tokenizer.rs:111,222 handles them; round-4 rejected
+    them)."""
+    from dynamo_tpu.gguf import GgufBpeTokenizer, gguf_tokenizer
+
     path = tmp_path / "m.gguf"
     blobs = _tok_metadata()
     blobs[9] = _kv("tokenizer.ggml.model", _T_STRING, _s("gpt2"))
+    blobs.append(_kv("tokenizer.ggml.merges", _T_ARRAY, _arr(_T_STRING, [])))
     write_gguf(path, blobs)
     md, _ = read_gguf(str(path))
+    assert isinstance(gguf_tokenizer(md), GgufBpeTokenizer)
     with pytest.raises(ValueError, match="not supported"):
         GgufTokenizer.from_metadata(md)
+
+
+GOLDEN_TEXTS = [
+    "Hello world",
+    "hello, world!  How's it going?",
+    "The quick brown fox jumps over the lazy dog.",
+    "  leading spaces and   runs",
+    "trailing space ",
+    "numbers 123 and 456789 mixed2with words",
+    "punct!!! ... --- (mixed) [brackets] {braces}",
+    "CamelCase and UPPER and lower",
+    "unicode: caf\u00e9 na\u00efve \u00fcber stra\u00dfe",
+    "emoji \U0001f600 ok",
+    "don't we'll they've I'm you're he'd it's",
+    "tabs\tand\nnewlines\r\nmixed \n\n double",
+    "a",
+    " ",
+    "",
+    "'quoted' \"double\" `tick`",
+]
+
+
+def test_bpe_tokenizer_matches_hf_bytelevel_golden(tmp_path):
+    """Golden parity: the same vocab+merges loaded into HF `tokenizers`
+    ByteLevelBPE (the library the reference converts GGUF vocabs INTO,
+    gguf_tokenizer.rs:222) and into GgufBpeTokenizer must encode
+    identically — pretokenizer scanner, byte mapping, and merge order all
+    checked at once."""
+    tokenizers = pytest.importorskip("tokenizers")
+    from tokenizers import ByteLevelBPETokenizer
+
+    from dynamo_tpu.gguf import GgufBpeTokenizer
+
+    ref = ByteLevelBPETokenizer()
+    corpus = [
+        "hello world how are you doing today",
+        "the quick brown fox jumps over the lazy dog",
+        "numbers 123 456 789 and punctuation !!! ... ??",
+        "don't stop believing, hold on to that feeling",
+        "some CamelCase and UPPERCASE and lowercase words",
+        "caf\u00e9 na\u00efve \u00fcber stra\u00dfe unicode text",
+    ] * 50
+    ref.train_from_iterator(corpus, vocab_size=600, min_frequency=1)
+    vocab = ref.get_vocab()
+    tokens = [t for t, _ in sorted(vocab.items(), key=lambda kv: kv[1])]
+    # extract merge list in rank order from the trained model
+    import json
+
+    model_json = json.loads(ref.to_str())
+    merges = [
+        m if isinstance(m, str) else " ".join(m)
+        for m in model_json["model"]["merges"]
+    ]
+    mine = GgufBpeTokenizer(tokens, merges, add_bos=False)
+    for text in GOLDEN_TEXTS:
+        exp = ref.encode(text).ids
+        got = mine.encode(text)
+        assert got == exp, (text, [tokens[i] for i in got],
+                            [tokens[i] for i in exp])
+        assert mine.decode(got) == text
+
+
+def test_bpe_llama3_pretokenizer_rules():
+    """The llama-bpe scanner's divergences from GPT-2: digit triples,
+    case-insensitive contractions, punctuation absorbing newlines."""
+    from dynamo_tpu.gguf import llama3_pretokenize
+
+    assert llama3_pretokenize("1234567") == ["123", "456", "7"]
+    assert llama3_pretokenize("WE'LL go") == ["WE", "'LL", " go"]
+    assert llama3_pretokenize("end.\n\nNew") == ["end", ".\n\n", "New"]
+    assert llama3_pretokenize("hello world") == ["hello", " world"]
+    assert llama3_pretokenize("  indent") == [" ", " indent"]
+
+
+def test_bpe_special_token_splitting():
+    """Control tokens (token_type 3) are matched verbatim and round-trip
+    — chat-template markup must not be split by the pretokenizer."""
+    from dynamo_tpu.gguf import GgufBpeTokenizer
+
+    base = [chr(c) for c in range(33, 127)]
+    tokens = base + ["<|eot_id|>", "<|start_header_id|>"]
+    types = [1] * len(base) + [3, 3]
+    tok = GgufBpeTokenizer(tokens, [], token_types=types, add_bos=False)
+    ids = tok.encode("<|start_header_id|>hi<|eot_id|>")
+    assert ids[0] == tokens.index("<|start_header_id|>")
+    assert ids[-1] == tokens.index("<|eot_id|>")
+    assert tok.decode(ids, skip_special_tokens=False) == "<|start_header_id|>hi<|eot_id|>"
+    assert tokens.index("<|eot_id|>") in tok.stop_token_ids
+
+
+# ---------------------------------------------------------------------------
+# Tensor dequantization + weight loading
+
+
+def _pack_f16(x):
+    import numpy as np
+
+    return np.asarray(x, "<f2").tobytes()
+
+
+def test_dequantize_q8_0():
+    """Q8_0 block layout straight from the spec: f16 scale + 32 int8."""
+    import numpy as np
+
+    from dynamo_tpu.gguf import GGML_Q8_0, dequantize_tensor
+
+    q = np.arange(-16, 16, dtype=np.int8)
+    data = _pack_f16([0.5]) + q.tobytes() + _pack_f16([2.0]) + q.tobytes()
+    x = dequantize_tensor(GGML_Q8_0, data, 64)
+    np.testing.assert_allclose(x[:32], q * 0.5)
+    np.testing.assert_allclose(x[32:], q * 2.0)
+
+
+def test_dequantize_q4_0_and_q4_1():
+    """Q4 nibble order: byte j carries elems j (low) and j+16 (high)."""
+    import numpy as np
+
+    from dynamo_tpu.gguf import GGML_Q4_0, GGML_Q4_1, dequantize_tensor
+
+    nibbles = np.arange(16, dtype=np.uint8)          # elem j = j
+    qs = (nibbles | (15 - nibbles) << 4).tobytes()   # elem j+16 = 15-j
+    x = dequantize_tensor(GGML_Q4_0, _pack_f16([1.5]) + qs, 32)
+    np.testing.assert_allclose(x[:16], (nibbles - 8.0) * 1.5)
+    np.testing.assert_allclose(x[16:], ((15 - nibbles) - 8.0) * 1.5)
+    x1 = dequantize_tensor(
+        GGML_Q4_1, _pack_f16([2.0]) + _pack_f16([-3.0]) + qs, 32)
+    np.testing.assert_allclose(x1[:16], nibbles * 2.0 - 3.0)
+
+
+def test_dequantize_q5_0():
+    """Q5: the 5th bit of elem j comes from bit j of the u32 qh."""
+    import numpy as np
+    import struct as _st
+
+    from dynamo_tpu.gguf import GGML_Q5_0, dequantize_tensor
+
+    vals = np.arange(32, dtype=np.uint8)  # 5-bit values 0..31
+    qs = bytes((vals[j] & 0xF) | ((vals[j + 16] & 0xF) << 4)
+               for j in range(16))
+    qh = 0
+    for j in range(32):
+        qh |= ((int(vals[j]) >> 4) & 1) << j
+    data = _pack_f16([1.0]) + _st.pack("<I", qh) + qs
+    x = dequantize_tensor(GGML_Q5_0, data, 32)
+    np.testing.assert_allclose(x, vals.astype(np.float32) - 16.0)
+
+
+def test_dequantize_kquant_rejected():
+    import pytest as _pytest
+
+    from dynamo_tpu.gguf import dequantize_tensor
+
+    with _pytest.raises(ValueError, match="Q4_K"):
+        dequantize_tensor(12, b"", 256)
+
+
+def _write_gguf_with_data(path, metadata_blobs, named_arrays):
+    """GGUF v3 writer incl. F32 tensor data (aligned data section)."""
+    import numpy as np
+
+    descs, payload = [], bytearray()
+    for name, arr in named_arrays:
+        a = np.asarray(arr, "<f4")
+        descs.append((name, list(reversed(a.shape)), 0, len(payload)))
+        payload.extend(a.tobytes())
+        while len(payload) % 32:
+            payload.append(0)
+    with open(path, "wb") as f:
+        f.write(b"GGUF")
+        f.write(struct.pack("<IQQ", 3, len(descs), len(metadata_blobs)))
+        for blob in metadata_blobs:
+            f.write(blob)
+        for name, dims, dtype, off in descs:
+            f.write(_s(name))
+            f.write(struct.pack("<I", len(dims)))
+            for d in dims:
+                f.write(struct.pack("<Q", d))
+            f.write(struct.pack("<IQ", dtype, off))
+        while f.tell() % 32:
+            f.write(b"\x00")
+        f.write(payload)
+
+
+def _gguf_permute(w, n_head):
+    """The HF->GGUF q/k row permutation (llama.cpp convert script) the
+    loader must invert."""
+    import numpy as np
+
+    out_dim = w.shape[0]
+    return (w.reshape(n_head, 2, out_dim // n_head // 2, *w.shape[1:])
+             .swapaxes(1, 2)
+             .reshape(w.shape))
+
+
+def test_load_gguf_params_roundtrip(tmp_path):
+    """A tiny model's HF-layout weights written into a GGUF (with the
+    llama.cpp q/k permutation applied, as real conversions do) load back
+    EQUAL to the originals — name mapping, dim reversal, transposes, and
+    the rope unpermute all verified at once. The loaded params then run a
+    prefill to prove they're serving-shaped."""
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    from dynamo_tpu.gguf import load_gguf_params
+    from dynamo_tpu.models import llama
+    from dynamo_tpu.models.config import ModelConfig
+
+    cfg = ModelConfig.tiny(dtype="float32", tie_word_embeddings=False)
+    rng = np.random.RandomState(0)
+    H, I, V, L = (cfg.hidden_size, cfg.intermediate_size, cfg.vocab_size,
+                  cfg.num_layers)
+    hf = {
+        "model.embed_tokens.weight": rng.randn(V, H).astype(np.float32),
+        "model.norm.weight": rng.randn(H).astype(np.float32),
+        "lm_head.weight": rng.randn(V, H).astype(np.float32),
+    }
+    for l in range(L):
+        p = f"model.layers.{l}."
+        hf[p + "self_attn.q_proj.weight"] = rng.randn(cfg.q_dim, H).astype(np.float32)
+        hf[p + "self_attn.k_proj.weight"] = rng.randn(cfg.kv_dim, H).astype(np.float32)
+        hf[p + "self_attn.v_proj.weight"] = rng.randn(cfg.kv_dim, H).astype(np.float32)
+        hf[p + "self_attn.o_proj.weight"] = rng.randn(H, cfg.q_dim).astype(np.float32)
+        hf[p + "mlp.gate_proj.weight"] = rng.randn(I, H).astype(np.float32)
+        hf[p + "mlp.up_proj.weight"] = rng.randn(I, H).astype(np.float32)
+        hf[p + "mlp.down_proj.weight"] = rng.randn(H, I).astype(np.float32)
+        hf[p + "input_layernorm.weight"] = rng.randn(H).astype(np.float32)
+        hf[p + "post_attention_layernorm.weight"] = rng.randn(H).astype(np.float32)
+
+    arrays = [
+        ("token_embd.weight", hf["model.embed_tokens.weight"]),
+        ("output_norm.weight", hf["model.norm.weight"]),
+        ("output.weight", hf["lm_head.weight"]),
+    ]
+    for l in range(L):
+        p = f"model.layers.{l}."
+        arrays += [
+            (f"blk.{l}.attn_q.weight",
+             _gguf_permute(hf[p + "self_attn.q_proj.weight"], cfg.num_heads)),
+            (f"blk.{l}.attn_k.weight",
+             _gguf_permute(hf[p + "self_attn.k_proj.weight"],
+                           cfg.num_kv_heads)),
+            (f"blk.{l}.attn_v.weight", hf[p + "self_attn.v_proj.weight"]),
+            (f"blk.{l}.attn_output.weight", hf[p + "self_attn.o_proj.weight"]),
+            (f"blk.{l}.ffn_gate.weight", hf[p + "mlp.gate_proj.weight"]),
+            (f"blk.{l}.ffn_up.weight", hf[p + "mlp.up_proj.weight"]),
+            (f"blk.{l}.ffn_down.weight", hf[p + "mlp.down_proj.weight"]),
+            (f"blk.{l}.attn_norm.weight", hf[p + "input_layernorm.weight"]),
+            (f"blk.{l}.ffn_norm.weight",
+             hf[p + "post_attention_layernorm.weight"]),
+        ]
+    path = tmp_path / "tiny.gguf"
+    blobs = [b for b in _tok_metadata()]
+    blobs[1] = _kv("llama.embedding_length", _T_U32, struct.pack("<I", H))
+    _write_gguf_with_data(path, blobs, arrays)
+
+    params = load_gguf_params(cfg, str(path), dtype="float32")
+    ref = llama.params_from_state_dict(
+        cfg, {k: jnp.asarray(v) for k, v in hf.items()}, "float32")
+    import jax
+
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-6),
+        params, ref,
+    )
+    # serving-shaped: a prefill runs
+    ctx = llama.init_ctx(cfg, 1, 64)
+    toks = jnp.asarray(np.arange(1, 17, dtype=np.int32))
+    _, lg = llama.prefill(cfg, params, ctx, toks, jnp.int32(0),
+                          jnp.int32(0), jnp.int32(16))
+    assert np.isfinite(np.asarray(lg)).all()
+
+
+def test_cli_serves_gguf_end_to_end(tmp_path):
+    """`dynamo-tpu run in=text --model-path x.gguf out=tpu` serves a
+    completion from a single GGUF file: config + tokenizer + dequantized
+    weights all come from the container (round-4 rejected this path)."""
+    import os
+    import subprocess
+    import sys
+
+    import numpy as np
+
+    cfg_vocab = len(VOCAB)
+    rng = np.random.RandomState(3)
+    from dynamo_tpu.models.config import ModelConfig
+
+    cfg = ModelConfig.tiny(dtype="float32")
+    arrays = [
+        ("token_embd.weight",
+         rng.randn(cfg_vocab, cfg.hidden_size).astype(np.float32) * 0.02),
+        ("output_norm.weight", np.ones(cfg.hidden_size, np.float32)),
+        ("output.weight",
+         rng.randn(cfg_vocab, cfg.hidden_size).astype(np.float32) * 0.02),
+    ]
+    for l in range(cfg.num_layers):
+        s = 1.0 / np.sqrt(cfg.hidden_size)
+        arrays += [
+            (f"blk.{l}.attn_q.weight",
+             rng.randn(cfg.q_dim, cfg.hidden_size).astype(np.float32) * s),
+            (f"blk.{l}.attn_k.weight",
+             rng.randn(cfg.kv_dim, cfg.hidden_size).astype(np.float32) * s),
+            (f"blk.{l}.attn_v.weight",
+             rng.randn(cfg.kv_dim, cfg.hidden_size).astype(np.float32) * s),
+            (f"blk.{l}.attn_output.weight",
+             rng.randn(cfg.hidden_size, cfg.q_dim).astype(np.float32) * s),
+            (f"blk.{l}.ffn_gate.weight",
+             rng.randn(cfg.intermediate_size, cfg.hidden_size).astype(np.float32) * s),
+            (f"blk.{l}.ffn_up.weight",
+             rng.randn(cfg.intermediate_size, cfg.hidden_size).astype(np.float32) * s),
+            (f"blk.{l}.ffn_down.weight",
+             rng.randn(cfg.hidden_size, cfg.intermediate_size).astype(np.float32) * s),
+            (f"blk.{l}.attn_norm.weight", np.ones(cfg.hidden_size, np.float32)),
+            (f"blk.{l}.ffn_norm.weight", np.ones(cfg.hidden_size, np.float32)),
+        ]
+    path = tmp_path / "served.gguf"
+    _write_gguf_with_data(path, _tok_metadata(), arrays)
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=repo)
+    r = subprocess.run(
+        [sys.executable, "-m", "dynamo_tpu.cli", "run", "in=text",
+         "out=tpu", "--model-path", str(path),
+         "--prompt", "hello world", "--max-tokens", "4",
+         "--page-size", "16", "--num-pages", "32",
+         "--max-decode-slots", "2", "--cache-dtype", "float32"],
+        capture_output=True, text=True, timeout=240, env=env,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert any(line.strip() for line in r.stdout.splitlines())

@@ -203,3 +203,169 @@ def test_render_manifests_yaml_roundtrip():
         assert docs[0]["apiVersion"] in ("apps/v1", "v1")
     except ImportError:
         assert '"kind": "Deployment"' in text
+
+
+class FakeObjectApi:
+    """Minimal typed-object CRUD (apps/v1 deployments + v1 services) with
+    labelSelector list — what the operator reconciles against."""
+
+    def __init__(self):
+        self.objects = {"deployments": {}, "services": {}}
+        self.rv = 0
+        app = web.Application()
+        for coll, path in (
+            ("deployments", "/apis/apps/v1/namespaces/{ns}/deployments"),
+            ("services", "/api/v1/namespaces/{ns}/services"),
+        ):
+            app.router.add_get(path, self._mk_list(coll))
+            app.router.add_post(path, self._mk_create(coll))
+            app.router.add_put(path + "/{name}", self._mk_replace(coll))
+            app.router.add_delete(path + "/{name}", self._mk_delete(coll))
+        self.app = app
+
+    def _mk_list(self, coll):
+        async def handler(request):
+            sel = request.query.get("labelSelector", "")
+            items = []
+            for obj in self.objects[coll].values():
+                labels = obj.get("metadata", {}).get("labels", {})
+                ok = all(
+                    labels.get(k) == v
+                    for k, _, v in (s.partition("=") for s in sel.split(",") if s)
+                )
+                if ok:
+                    items.append(obj)
+            return web.json_response({"items": items})
+        return handler
+
+    def _mk_create(self, coll):
+        async def handler(request):
+            obj = json.loads(await request.text())
+            name = obj["metadata"]["name"]
+            if name in self.objects[coll]:
+                return web.json_response(
+                    {"message": "already exists"}, status=409)
+            self.rv += 1
+            obj["metadata"]["resourceVersion"] = str(self.rv)
+            self.objects[coll][name] = obj
+            return web.json_response(obj, status=201)
+        return handler
+
+    def _mk_replace(self, coll):
+        async def handler(request):
+            obj = json.loads(await request.text())
+            name = request.match_info["name"]
+            if name not in self.objects[coll]:
+                return web.json_response({"message": "not found"}, status=404)
+            self.rv += 1
+            obj["metadata"]["resourceVersion"] = str(self.rv)
+            self.objects[coll][name] = obj
+            return web.json_response(obj)
+        return handler
+
+    def _mk_delete(self, coll):
+        async def handler(request):
+            self.objects[coll].pop(request.match_info["name"], None)
+            return web.json_response({})
+        return handler
+
+
+async def start_fake_object_api():
+    from aiohttp.test_utils import TestServer
+
+    api = FakeObjectApi()
+    server = TestServer(api.app)
+    await server.start_server()
+    return api, f"http://{server.host}:{server.port}", server
+
+
+OP_GRAPH = {
+    "namespace": "dyn",
+    "frontend": {"http_port": 8080},
+    "workers": [
+        {"name": "decode", "replicas": 2, "tpu_chips": 4,
+         "args": ["out=tpu", "--model-config", "llama3_1b"]},
+    ],
+}
+
+
+async def test_operator_reconcile_create_update_delete():
+    """Spec change -> rollout; worker removal -> orphan deletion; no-op
+    pass -> all unchanged (reference operator controller semantics)."""
+    from dynamo_tpu.k8s import DynamoOperator
+
+    api, base, server = await start_fake_object_api()
+    op = DynamoOperator(api_base=base, verify_ssl=False,
+                        k8s_namespace="default")
+    try:
+        c = await op.reconcile(OP_GRAPH)
+        assert c["created"] >= 4 and c["deleted"] == 0  # store+fe+svc+worker
+        assert "dyn-decode" in api.objects["deployments"]
+        assert api.objects["deployments"]["dyn-decode"]["spec"]["replicas"] == 2
+
+        # idempotent second pass
+        c = await op.reconcile(OP_GRAPH)
+        assert c["created"] == 0 and c["updated"] == 0 and c["deleted"] == 0
+
+        # spec change rolls the deployment
+        g2 = json.loads(json.dumps(OP_GRAPH))
+        g2["workers"][0]["args"].append("--max-decode-slots")
+        g2["workers"][0]["args"].append("16")
+        c = await op.reconcile(g2)
+        assert c["updated"] == 1
+        args = api.objects["deployments"]["dyn-decode"]["spec"]["template"][
+            "spec"]["containers"][0]["args"]
+        assert "--max-decode-slots" in args
+
+        # removing the worker deletes its deployment, keeps the rest
+        g3 = json.loads(json.dumps(OP_GRAPH))
+        g3["workers"] = []
+        c = await op.reconcile(g3)
+        assert c["deleted"] == 1
+        assert "dyn-decode" not in api.objects["deployments"]
+        assert "dyn-frontend" in api.objects["deployments"]
+    finally:
+        await op.close()
+        await server.close()
+
+
+async def test_operator_watches_store_spec():
+    """The graph spec is a store key (the CRD analogue): writing it
+    triggers a reconcile; updating it triggers a rollout."""
+    import asyncio
+
+    from dynamo_tpu.k8s import DynamoOperator, graph_key
+    from dynamo_tpu.runtime.client import KvClient
+    from dynamo_tpu.runtime.store import serve_store
+
+    api, base, server = await start_fake_object_api()
+    st_server, _ = await serve_store(port=0, sweep_interval_s=0.1)
+    port = st_server.sockets[0].getsockname()[1]
+    kv = await KvClient(port=port).connect()
+    kv2 = await KvClient(port=port).connect()
+    op = DynamoOperator(api_base=base, verify_ssl=False, resync_s=5.0)
+    task = asyncio.ensure_future(op.run(kv, "dyn"))
+    try:
+        await kv2.put(graph_key("dyn"), json.dumps(OP_GRAPH))
+        for _ in range(100):
+            if "dyn-decode" in api.objects["deployments"]:
+                break
+            await asyncio.sleep(0.05)
+        assert "dyn-decode" in api.objects["deployments"]
+
+        g2 = json.loads(json.dumps(OP_GRAPH))
+        g2["workers"][0]["replicas"] = 5
+        await kv2.put(graph_key("dyn"), json.dumps(g2))
+        for _ in range(100):
+            d = api.objects["deployments"].get("dyn-decode", {})
+            if d.get("spec", {}).get("replicas") == 5:
+                break
+            await asyncio.sleep(0.05)
+        assert api.objects["deployments"]["dyn-decode"]["spec"]["replicas"] == 5
+    finally:
+        task.cancel()
+        await op.close()
+        await kv.close()
+        await kv2.close()
+        st_server.close()
+        await server.close()

@@ -74,6 +74,7 @@ async def main():
             toks.extend(out.token_ids)
         outs.append(toks)
     await eng.stop()
+    await stream.drain()  # batched frames must precede the stop command
     await stop_followers(kv, "tt", "e1", "run1", 1, stream.seq)
     print("RESULT " + json.dumps(outs), flush=True)
     await kv.close()

@@ -258,3 +258,125 @@ async def test_multimodal_http_image_lowering(setup):
     finally:
         await client.close()
         await eng.stop()
+
+
+def test_vision_clip_checkpoint_roundtrip(tmp_path):
+    """A CLIP-shape vision tower + LLaVA projector written as safetensors
+    loads into the param tree with the right transposes (conv->patch
+    matmul, torch [out,in] -> [in,out]) and runs a forward pass
+    (reference: the encode worker serves a real LLaVA/Qwen-VL tower)."""
+    import numpy as np
+    from safetensors.numpy import save_file
+
+    from dynamo_tpu.models.vision import (
+        VisionConfig,
+        encode_image,
+        load_vision_params,
+    )
+
+    cfg = VisionConfig.tiny(use_class_token=True)
+    rng = np.random.RandomState(0)
+    H, I, P = cfg.hidden_size, cfg.intermediate_size, cfg.patch_size
+    OUT = cfg.out_hidden_size
+    sd = {
+        "vision_tower.vision_model.embeddings.patch_embedding.weight":
+            rng.randn(H, 3, P, P).astype(np.float32) * 0.05,
+        "vision_tower.vision_model.embeddings.class_embedding":
+            rng.randn(H).astype(np.float32) * 0.02,
+        "vision_tower.vision_model.embeddings.position_embedding.weight":
+            rng.randn(cfg.num_positions, H).astype(np.float32) * 0.02,
+        "vision_tower.vision_model.pre_layrnorm.weight":
+            np.ones(H, np.float32),
+        "vision_tower.vision_model.pre_layrnorm.bias":
+            np.zeros(H, np.float32),
+        "vision_tower.vision_model.post_layernorm.weight":
+            np.ones(H, np.float32),
+        "vision_tower.vision_model.post_layernorm.bias":
+            np.zeros(H, np.float32),
+        "multi_modal_projector.linear_1.weight":
+            rng.randn(OUT, H).astype(np.float32) * 0.05,
+        "multi_modal_projector.linear_1.bias":
+            np.zeros(OUT, np.float32),
+        "multi_modal_projector.linear_2.weight":
+            rng.randn(OUT, OUT).astype(np.float32) * 0.05,
+        "multi_modal_projector.linear_2.bias":
+            np.zeros(OUT, np.float32),
+    }
+    for l in range(cfg.num_layers):
+        p = f"vision_tower.vision_model.encoder.layers.{l}."
+        for nm, shp in (("layer_norm1", H), ("layer_norm2", H)):
+            sd[p + nm + ".weight"] = np.ones(shp, np.float32)
+            sd[p + nm + ".bias"] = np.zeros(shp, np.float32)
+        for nm in ("q_proj", "k_proj", "v_proj", "out_proj"):
+            sd[p + f"self_attn.{nm}.weight"] = (
+                rng.randn(H, H).astype(np.float32) * 0.05)
+            sd[p + f"self_attn.{nm}.bias"] = np.zeros(H, np.float32)
+        sd[p + "mlp.fc1.weight"] = rng.randn(I, H).astype(np.float32) * 0.05
+        sd[p + "mlp.fc1.bias"] = np.zeros(I, np.float32)
+        sd[p + "mlp.fc2.weight"] = rng.randn(H, I).astype(np.float32) * 0.05
+        sd[p + "mlp.fc2.bias"] = np.zeros(H, np.float32)
+    save_file(sd, str(tmp_path / "model.safetensors"))
+
+    params = load_vision_params(cfg, str(tmp_path))
+    # transposes verified leaf-wise
+    np.testing.assert_allclose(
+        np.asarray(params["layers"]["wq"][0]),
+        sd["vision_tower.vision_model.encoder.layers.0.self_attn.q_proj.weight"].T,
+        rtol=1e-6,
+    )
+    np.testing.assert_allclose(
+        np.asarray(params["proj"]),
+        sd["multi_modal_projector.linear_1.weight"].T, rtol=1e-6,
+    )
+    conv = sd["vision_tower.vision_model.embeddings.patch_embedding.weight"]
+    np.testing.assert_allclose(
+        np.asarray(params["patch_embed"]),
+        conv.transpose(2, 3, 1, 0).reshape(cfg.patch_dim, H), rtol=1e-6,
+    )
+    img = np.random.RandomState(1).rand(
+        cfg.image_size, cfg.image_size, 3).astype(np.float32)
+    out = np.asarray(encode_image(cfg, params, img))
+    assert out.shape == (cfg.num_patches, OUT)
+    assert np.isfinite(out).all()
+
+
+async def test_rpc_embeddings_travel_as_array_frames(setup):
+    """Over the distributed runtime, embeddings must ride the frame2
+    array channel (tickets), not JSON float lists."""
+    import numpy as np
+
+    from dynamo_tpu.kv_transfer import take_remote_array
+    from dynamo_tpu.multimodal import EncodeWorker, encode_image_payload
+    from dynamo_tpu.runtime.component import DistributedRuntime
+    from dynamo_tpu.runtime.store import serve_store
+
+    cfg, vcfg, _params, vparams, _ecfg = setup
+    server, _ = await serve_store(port=0, sweep_interval_s=0.1)
+    port = server.sockets[0].getsockname()[1]
+    rt = await DistributedRuntime.connect(port=port)
+    enc = await EncodeWorker(rt, vcfg, vparams).start()
+    try:
+        img = encode_image_payload(
+            np.random.RandomState(0).rand(16, 16, 3).astype(np.float32))
+        client = await rt.namespace("dynamo").component(
+            "encoder").endpoint("encode").client()
+        resp = None
+        async for item in client.generate({"images": [img, img]}):
+            resp = item
+        ents = resp["embeddings"]
+        assert all("ticket" in e and "data" not in e for e in ents)
+        arr = await take_remote_array(
+            ents[0]["host"], ents[0]["port"], ents[0]["ticket"])
+        assert arr.shape == tuple(ents[0]["shape"])
+        assert arr.dtype == np.float32
+        # tickets are one-shot
+        import pytest as _pytest
+
+        from dynamo_tpu.kv_transfer import BlockTransferError
+        with _pytest.raises(BlockTransferError):
+            await take_remote_array(
+                ents[0]["host"], ents[0]["port"], ents[0]["ticket"])
+    finally:
+        await enc.stop()
+        await rt.close()
+        server.close()

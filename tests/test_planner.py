@@ -218,3 +218,100 @@ async def test_system_server_per_worker():
     assert 'dynamo_worker_total_slots{worker="w9"} 8' in text
     await srv.stop()
     await eng.stop()
+
+
+@pytest.mark.asyncio_timeout(600)
+async def test_planner_scales_multihost_engine_groups():
+    """BASELINE config 4 x planner: DP replicas OF a cross-host engine.
+    Each replica the planner adds is a 2-process lockstep group (leader
+    in=endpoint + replay follower over one jax.distributed mesh); scale
+    1 -> 2 under held load, then back to 1, with registrations following
+    (VERDICT r4 #7: planner and multihost had never met)."""
+    import os
+
+    from dynamo_tpu.planner import MultihostLocalConnector
+
+    server, store = await serve_store(port=0, sweep_interval_s=0.05)
+    port = server.sockets[0].getsockname()[1]
+    cp = f"127.0.0.1:{port}"
+    cmd = [
+        sys.executable, "-m", "dynamo_tpu.cli", "run",
+        "in=endpoint", "out=tpu", "--model-config", "tiny_wide",
+        "--tensor-parallel-size", "4",
+        "--num-nodes", "2", "--node-rank", "{rank}",
+        "--leader-addr", "{coord}",
+        "--control-plane", cp, "--namespace", "mhplan",
+        "--component", "backend-r{replica}", "--model-name", "mh",
+        "--page-size", "16", "--num-pages", "32",
+        "--max-decode-slots", "2", "--cache-dtype", "float32",
+    ]
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=2")
+    conn = MultihostLocalConnector(cmd, num_nodes=2, env=env)
+    kv = await KvClient(port=port).connect()
+    planner = Planner(kv, conn, PlannerConfig(
+        adjustment_interval_s=1.0,
+        kv_usage_scale_up=0.01,
+        kv_usage_scale_down=0.005,
+        waiting_scale_up=10_000,
+        min_replicas=1, max_replicas=2, stable_intervals=2,
+        metrics_stale_after_s=60.0,
+    ))
+    rt = await DistributedRuntime.connect(port=port)
+    client = None
+    sip_task = None
+    try:
+        await conn.set_replicas(1)
+        await planner.start()
+        client = await rt.namespace("mhplan").component(
+            "backend-r0").endpoint("generate").client()
+        await client.wait_for_instances(1, timeout_s=180)
+
+        stream = client.generate({
+            "token_ids": list(range(1, 50)),
+            "stop_conditions": {"max_tokens": 100000, "ignore_eos": True},
+        })
+
+        async def sip():
+            async for _ in stream:
+                await asyncio.sleep(0.05)
+
+        sip_task = asyncio.create_task(sip())
+
+        for _ in range(360):
+            if conn.current_replicas() == 2:
+                break
+            await asyncio.sleep(0.5)
+        assert conn.current_replicas() == 2
+        # the new group registers as its own model instance
+        for _ in range(240):
+            regs = await kv.get_prefix("dynamo://mhplan/_models/mh/")
+            if len(regs) >= 2:
+                break
+            await asyncio.sleep(0.5)
+        assert len(await kv.get_prefix("dynamo://mhplan/_models/mh/")) == 2
+
+        sip_task.cancel()
+        try:
+            await sip_task  # let the generator unwind before aclose
+        except asyncio.CancelledError:
+            pass
+        sip_task = None
+        aclose = getattr(stream, "aclose", None)
+        if aclose:
+            await aclose()
+        for _ in range(360):
+            if conn.current_replicas() == 1:
+                break
+            await asyncio.sleep(0.5)
+        assert conn.current_replicas() == 1
+    finally:
+        await planner.stop()
+        if sip_task is not None:
+            sip_task.cancel()
+        if client is not None:
+            await client.stop()
+        await conn.shutdown()
+        await rt.close()
+        await kv.close()
+        server.close()

@@ -1,0 +1,141 @@
+"""KVBM G4 remote tier (reference block_manager.rs:69-82 CacheLevel::G4,
+storage/nixl.rs:403): a COLD worker whose G1/G2/G3 tiers miss a prefix
+fetches the sealed pages from a PEER worker's pool over the transfer
+plane (hash-addressed one-sided read), lands them in its G2 host tier,
+and onboards them through the normal path — serving the same tokens as
+the warm worker without recomputing the prefix."""
+import asyncio
+
+import numpy as np
+import pytest
+
+from dynamo_tpu.engine.config import EngineConfig
+from dynamo_tpu.engine.engine import TpuEngine
+from dynamo_tpu.kv_transfer import (
+    BlocksetDescriptor,
+    BlockTransferServer,
+    KvCacheLayout,
+    RemoteKvFetcher,
+    publish_descriptor,
+    read_remote_hashes,
+)
+from dynamo_tpu.models import llama
+from dynamo_tpu.models.config import ModelConfig
+from dynamo_tpu.parallel.mesh import MeshConfig
+from dynamo_tpu.protocols.common import PreprocessedRequest, StopConditions
+from dynamo_tpu.runtime.client import KvClient
+from dynamo_tpu.runtime.store import serve_store
+
+PS = 16
+
+
+def _ecfg(**kw):
+    base = dict(
+        num_pages=64, page_size=PS, max_pages_per_seq=8,
+        max_decode_slots=2, prefill_buckets=(32, 64),
+        cache_dtype="float32", flush_every=2, max_inflight_rounds=1,
+    )
+    base.update(kw)
+    return EngineConfig(**base)
+
+
+async def _collect(eng, prompt, n=6):
+    req = PreprocessedRequest(
+        token_ids=list(prompt),
+        stop_conditions=StopConditions(max_tokens=n, ignore_eos=True),
+    )
+    toks = []
+    async for out in eng.generate(req):
+        toks.extend(out.token_ids)
+    return toks
+
+
+@pytest.mark.asyncio_timeout(180)
+async def test_cold_worker_onboards_prefix_from_peer_pool():
+    cfg = ModelConfig.tiny(dtype="float32")
+    params = llama.init_params(cfg, 0)
+    server, _store = await serve_store(port=0, sweep_interval_s=0.1)
+    port = server.sockets[0].getsockname()[1]
+    kv_a = await KvClient(port=port).connect()
+    kv_b = await KvClient(port=port).connect()
+
+    warm = TpuEngine(cfg, _ecfg(), params=params,
+                     mesh_config=MeshConfig(tp=1))
+    cold = TpuEngine(cfg, _ecfg(host_offload_pages=16), params=params,
+                     mesh_config=MeshConfig(tp=1))
+    try:
+        # warm worker seals 3 full blocks of prefix
+        prompt = list(range(1, PS * 3 + 4))
+        warm_toks = await _collect(warm, prompt)
+
+        # warm worker's pool on the transfer plane, hash-addressed
+        srv = BlockTransferServer(
+            read_fn=warm.export_pages,
+            read_hashes_fn=warm.export_pages_by_hash,
+        )
+        host, sport = await srv.start()
+        await publish_descriptor(kv_a, "g4", BlocksetDescriptor(
+            worker_id="warm", host=host, port=sport,
+            layout=KvCacheLayout(
+                num_layers=cfg.num_layers, num_kv_heads=cfg.num_kv_heads,
+                page_size=PS, head_dim=cfg.head_dim, dtype="float32",
+            ),
+        ))
+
+        # direct hash read: peer resolves the committed run
+        hashes = [b.block_hash for b in
+                  __import__("dynamo_tpu.tokens", fromlist=["x"])
+                  .TokenBlockSequence.from_tokens(prompt, PS).blocks[:3]]
+        found, data = await read_remote_hashes(host, sport, hashes)
+        assert found == 3
+        assert data.shape[3] == 3
+
+        # cold worker: G4 fetch -> G2 -> onboard; same tokens, no
+        # recompute of the cached prefix
+        cold.remote_kv = RemoteKvFetcher(kv_b, "g4", "cold")
+        cold_toks = await _collect(cold, prompt)
+        assert cold_toks == warm_toks
+        assert cold.remote_kv.hits == 1
+        assert cold.remote_onboard_blocks == 3
+        assert cold.offload.onboard_hits >= 3  # onboarded, not recomputed
+
+        # second request on the cold worker: now a pure LOCAL hit
+        fetches = cold.remote_kv.fetches
+        again = await _collect(cold, prompt)
+        assert again == warm_toks
+        assert cold.remote_kv.fetches == fetches  # no remote round-trip
+
+        await srv.stop()
+    finally:
+        await warm.stop()
+        await cold.stop()
+        await kv_a.close()
+        await kv_b.close()
+        server.close()
+
+
+@pytest.mark.asyncio_timeout(120)
+async def test_remote_fetch_misses_and_dead_peers_are_harmless():
+    cfg = ModelConfig.tiny(dtype="float32")
+    params = llama.init_params(cfg, 0)
+    server, _store = await serve_store(port=0, sweep_interval_s=0.1)
+    port = server.sockets[0].getsockname()[1]
+    kv = await KvClient(port=port).connect()
+    # a descriptor pointing at a dead port
+    await publish_descriptor(kv, "g4m", BlocksetDescriptor(
+        worker_id="gone", host="127.0.0.1", port=1,
+        layout=KvCacheLayout(num_layers=1, num_kv_heads=1, page_size=PS,
+                             head_dim=4, dtype="float32"),
+    ))
+    eng = TpuEngine(cfg, _ecfg(host_offload_pages=8), params=params,
+                    mesh_config=MeshConfig(tp=1))
+    eng.remote_kv = RemoteKvFetcher(kv, "g4m", "me", timeout_s=0.5)
+    try:
+        toks = await _collect(eng, list(range(1, PS * 2 + 3)))
+        assert len(toks) == 6  # served fine despite the dead peer
+        assert eng.remote_kv.fetches >= 1
+        assert eng.remote_kv.hits == 0
+    finally:
+        await eng.stop()
+        await kv.close()
+        server.close()
