@@ -1154,10 +1154,11 @@ class TpuEngine:
             q_starts[i] = start
             seq_lens[i] = start + len(chunk)
             chunk_lens.append(len(chunk))
-        max_qs = int(q_starts.max())
-        ctx_span = 0
-        if max_qs > 0:
-            ctx_span = min(e.max_context, pow2_cover(max_qs))
+        # ctx_span is binary — 0 (fresh) or the FULL region: each distinct
+        # value is its own ~30 s XLA compile on the dev chip, and the
+        # masked flash scan over dead context is a rounding error next to
+        # the parameter matmuls
+        ctx_span = e.max_context if int(q_starts.max()) > 0 else 0
         self.batch_prefills += 1
         if self.on_dispatch is not None:
             self.on_dispatch("prefill_batch", {

@@ -764,16 +764,17 @@ def seal_blocks_impl(
     ps = page_size
 
     def one(name):
-        def grab(slot, start):
-            lane = jax.lax.dynamic_index_in_dim(
-                ctx_kv[name], slot, axis=2, keepdims=False
-            )  # [L, kvh, S, hd]
-            return jax.lax.dynamic_slice_in_dim(lane, start, ps, axis=2)
-
-        blocks = jax.vmap(grab)(slots, starts)   # [n, L, kvh, ps, hd]
-        return cache[name].at[:, :, pages].set(
-            blocks.transpose(1, 2, 0, 3, 4)
-        )
+        # ONE gather over the (lane, position)-flattened axis. The
+        # previous vmap(dynamic_index + dynamic_slice) materialized the
+        # full [L, kvh, S, hd] LANE per entry before slicing — at long
+        # context (S 3328, n 512) that is ~28 GB of temps and the seal
+        # program OOMs at compile
+        src = ctx_kv[name]
+        L, kvh, lanes, S, hd = src.shape
+        flat = src.reshape(L, kvh, lanes * S, hd)
+        idx = (slots * S + starts)[:, None] + jnp.arange(ps)[None, :]
+        blocks = flat[:, :, idx]                 # [L, kvh, n, ps, hd]
+        return cache[name].at[:, :, pages].set(blocks)
 
     return {"k": one("k"), "v": one("v")}
 

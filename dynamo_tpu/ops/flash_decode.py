@@ -17,12 +17,20 @@ Round-4 redesign of the decode hot path. Two lessons drive the design
      ``[L, kvh, B, R, hd]`` instead; the engine flushes ring->ctx once
      per round, AFTER all reads, where the update aliases in place.
 
+Round-5 knob: ``slot_block`` processes SB slots per grid invocation
+(grid (B/SB, chunks)) — measured per-invocation cost is dominated by
+fixed overhead (grid sequencing + DMA setup + Mosaic's serialization of
+small batched dots), so fewer, fatter invocations close the gap to the
+bandwidth roofline. The DMA-skip index then clamps to the LONGEST live
+context in the slot group (short slots ride along). Env overrides for
+experiments: ``DYNAMO_FLASH_SB`` / ``DYNAMO_FLASH_CHUNK``.
+
 Position semantics: ctx_kv[l, :, b, p] holds position p of slot b, valid
 while p < ring_base[b]; ring[l, :, b, r] holds position ring_base[b]+r,
 valid while < ctx_lens[b] (the current token INCLUDED — the decode step
-writes its KV to the ring before attending). Chunks beyond a slot's
-ring_base repeat the previous block index, so their DMA is elided — cost
-tracks the LIVE context, not the padded capacity.
+writes its KV to the ring before attending). Chunks beyond a slot
+group's live context repeat the previous block index, so their DMA is
+elided — cost tracks the LIVE context, not the padded capacity.
 
 This replaces what vLLM's paged-attention CUDA kernel does for the
 reference (SURVEY.md §7 "Paged attention on TPU" hard part); paging moved
@@ -31,6 +39,7 @@ out of the per-step critical path entirely.
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -48,25 +57,24 @@ def _kernel(
     ctx_sm,      # [B] i32
     base_sm,     # [B] i32 — ring base positions
     # blocks
-    q_ref,       # [1, nkv, G, HD]      (slot squeezed via index map)
-    k_ref,       # [1, nkv, 1, CHUNK, HD]
+    q_ref,       # [SB, nkv, G, HD]
+    k_ref,       # [1, nkv, SB, CHUNK, HD]
     v_ref,
-    rk_ref,      # [1, nkv, 1, R, HD]   ring lane
+    rk_ref,      # [1, nkv, SB, R, HD]   ring lanes
     rv_ref,
-    o_ref,       # [1, nkv, G, HD]
+    o_ref,       # [SB, nkv, G, HD]
     # scratch
-    m_ref,       # [nkv, G, 128] f32 running max
-    l_ref,       # [nkv, G, 128] f32 running denom
-    acc_ref,     # [nkv, G, HD] f32 running numerator
+    m_ref,       # [SB, nkv, G, 128] f32 running max
+    l_ref,       # [SB, nkv, G, 128] f32 running denom
+    acc_ref,     # [SB, nkv, G, HD] f32 running numerator
     *,
     scale: float,
     chunk: int,
+    sb: int,
 ):
-    b = pl.program_id(0)
+    s_idx = pl.program_id(0)
     i = pl.program_id(1)
     n_chunks = pl.num_programs(1)  # ctx chunks + 1 ring chunk
-    ctx = ctx_sm[b]
-    base = base_sm[b]
     is_ring = i == n_chunks - 1
 
     @pl.when(i == 0)
@@ -75,52 +83,59 @@ def _kernel(
         l_ref[:] = jnp.zeros_like(l_ref)
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    def accumulate(k, v, start, limit, length):
+    def accumulate(j, k, v, start, limit, length):
         # k/v [nkv, length, HD]; positions start + iota valid below limit
         pos = start + jax.lax.broadcasted_iota(
             jnp.int32, (1, 1, length), 2)
         valid = pos < limit
-        q = q_ref[0]                                       # [nkv, G, HD]
+        q = q_ref[j]                                       # [nkv, G, HD]
         s = jax.lax.dot_general(
             q, k, (((2,), (2,)), ((0,), (0,))),
             preferred_element_type=jnp.float32,
         ) * scale                                          # [nkv, G, length]
         s = jnp.where(valid, s, NEG_INF)
-        m_prev = m_ref[:, :, :1]
+        m_prev = m_ref[j, :, :, :1]
         row_max = jnp.max(s, axis=2, keepdims=True)
         m_new = jnp.maximum(m_prev, row_max)
         p = jnp.exp(s - m_new)
         alpha = jnp.exp(m_prev - m_new)
-        l_new = l_ref[:, :, :1] * alpha + jnp.sum(p, axis=2, keepdims=True)
-        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+        l_new = l_ref[j, :, :, :1] * alpha + jnp.sum(
+            p, axis=2, keepdims=True)
+        acc_ref[j] = acc_ref[j] * alpha + jax.lax.dot_general(
             p.astype(v.dtype), v, (((2,), (1,)), ((0,), (0,))),
             preferred_element_type=jnp.float32,
         )
-        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
-        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+        m_ref[j] = jnp.broadcast_to(m_new, m_ref.shape[1:])
+        l_ref[j] = jnp.broadcast_to(l_new, l_ref.shape[1:])
 
-    # ctx chunk: positions [i*chunk, +chunk), valid below ring_base
-    @pl.when(jnp.logical_and(jnp.logical_not(is_ring), i * chunk < base))
-    def _():
-        accumulate(
-            k_ref[0, :, 0], v_ref[0, :, 0],
-            i * chunk, jnp.minimum(base, ctx), chunk,
-        )
+    for j in range(sb):
+        b = s_idx * sb + j
+        ctx = ctx_sm[b]
+        base = base_sm[b]
 
-    # ring chunk: slot r holds position base + r, valid below ctx
-    @pl.when(is_ring)
-    def _():
-        accumulate(rk_ref[0, :, 0], rv_ref[0, :, 0], base, ctx,
-                   rk_ref.shape[3])
+        # ctx chunk: positions [i*chunk, +chunk), valid below ring_base
+        @pl.when(jnp.logical_and(
+            jnp.logical_not(is_ring), i * chunk < base))
+        def _(j=j, ctx=ctx, base=base):
+            accumulate(
+                j, k_ref[0, :, j], v_ref[0, :, j],
+                i * chunk, jnp.minimum(base, ctx), chunk,
+            )
+
+        # ring chunk: slot r holds position base + r, valid below ctx
+        @pl.when(is_ring)
+        def _(j=j, ctx=ctx, base=base):
+            accumulate(j, rk_ref[0, :, j], rv_ref[0, :, j], base, ctx,
+                       rk_ref.shape[3])
 
     @pl.when(i == n_chunks - 1)
     def _():
-        denom = jnp.maximum(l_ref[:, :, :1], 1e-30)
-        o_ref[0] = (acc_ref[:] / denom).astype(o_ref.dtype)
+        denom = jnp.maximum(l_ref[:, :, :, :1], 1e-30)
+        o_ref[:] = (acc_ref[:] / denom).astype(o_ref.dtype)
 
 
 @functools.partial(
-    jax.jit, static_argnames=("chunk", "interpret")
+    jax.jit, static_argnames=("chunk", "interpret", "slot_block")
 )
 def flash_decode_attention(
     q: jnp.ndarray,          # [B, n_heads, HD]
@@ -131,57 +146,67 @@ def flash_decode_attention(
     layer: jnp.ndarray,      # scalar i32
     ctx_lens: jnp.ndarray,   # [B] i32 — context length INCL. current token
     ring_base: jnp.ndarray,  # [B] i32 — position held by ring slot 0
-    chunk: int = DEFAULT_CHUNK,
+    chunk: int = 0,
     interpret: bool = False,
+    slot_block: int = 0,
 ) -> jnp.ndarray:
     """Flash decode attention over contiguous KV + ring. Returns
     [B, n_heads, HD]. The current token's KV must already be in the ring
-    (position ctx-1 == ring_base + r for the step's ring slot r)."""
+    (position ctx-1 == ring_base + r for the step's ring slot r).
+    chunk/slot_block of 0 pick the defaults (env-overridable)."""
     B, n_heads, hd = q.shape
     L, nkv, _, S, _ = ctx_k.shape
     R = ring_k.shape[3]
     g = n_heads // nkv
+    if chunk <= 0:
+        chunk = int(os.environ.get("DYNAMO_FLASH_CHUNK", DEFAULT_CHUNK))
+    if slot_block <= 0:
+        slot_block = int(os.environ.get("DYNAMO_FLASH_SB", 1))
     # chunk must tile S exactly; gcd rounds it down to a divisor (legal
     # configs can make S a non-multiple of the default chunk)
     import math
 
     chunk = math.gcd(min(chunk, S), S)
+    sb = math.gcd(slot_block, B)
     scale = float(1.0 / (hd ** 0.5))
     qg = q.reshape(B, nkv, g, hd)
     n_chunks = S // chunk
     ctx_i32 = ctx_lens.astype(jnp.int32)
     base_i32 = ring_base.astype(jnp.int32)
-    last = n_chunks  # ring chunk index
 
-    def q_map(b, i, layer, ctx, base):
-        return (b, 0, 0, 0)
+    def q_map(s, i, layer, ctx, base):
+        return (s, 0, 0, 0)
 
-    def kv_map(b, i, layer, ctx, base):
-        # chunks beyond this slot's ctx repeat the previous index so the
-        # pipeline skips the (unused) DMA; the ring grid step clamps too
-        live = jnp.maximum((base[b] + chunk - 1) // chunk - 1, 0)
-        return (layer[0], 0, b, jnp.minimum(i, live), 0)
+    def kv_map(s, i, layer, ctx, base):
+        # chunks beyond the slot GROUP's longest live context repeat the
+        # previous index so the pipeline skips the (unused) DMA
+        # scalar loads only in index maps (SMEM): unrolled group max
+        grp_max = base[s * sb]
+        for j in range(1, sb):
+            grp_max = jnp.maximum(grp_max, base[s * sb + j])
+        live = jnp.maximum((grp_max + chunk - 1) // chunk - 1, 0)
+        return (layer[0], 0, s, jnp.minimum(i, live), 0)
 
-    def ring_map(b, i, layer, ctx, base):
-        return (layer[0], 0, b, 0, 0)
+    def ring_map(s, i, layer, ctx, base):
+        return (layer[0], 0, s, 0, 0)
 
     out = pl.pallas_call(
-        functools.partial(_kernel, scale=scale, chunk=chunk),
+        functools.partial(_kernel, scale=scale, chunk=chunk, sb=sb),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=3,
-            grid=(B, n_chunks + 1),
+            grid=(B // sb, n_chunks + 1),
             in_specs=[
-                pl.BlockSpec((1, nkv, g, hd), q_map),
-                pl.BlockSpec((1, nkv, 1, chunk, hd), kv_map),
-                pl.BlockSpec((1, nkv, 1, chunk, hd), kv_map),
-                pl.BlockSpec((1, nkv, 1, R, hd), ring_map),
-                pl.BlockSpec((1, nkv, 1, R, hd), ring_map),
+                pl.BlockSpec((sb, nkv, g, hd), q_map),
+                pl.BlockSpec((1, nkv, sb, chunk, hd), kv_map),
+                pl.BlockSpec((1, nkv, sb, chunk, hd), kv_map),
+                pl.BlockSpec((1, nkv, sb, R, hd), ring_map),
+                pl.BlockSpec((1, nkv, sb, R, hd), ring_map),
             ],
-            out_specs=pl.BlockSpec((1, nkv, g, hd), q_map),
+            out_specs=pl.BlockSpec((sb, nkv, g, hd), q_map),
             scratch_shapes=[
-                pltpu.VMEM((nkv, g, 128), jnp.float32),
-                pltpu.VMEM((nkv, g, 128), jnp.float32),
-                pltpu.VMEM((nkv, g, hd), jnp.float32),
+                pltpu.VMEM((sb, nkv, g, 128), jnp.float32),
+                pltpu.VMEM((sb, nkv, g, 128), jnp.float32),
+                pltpu.VMEM((sb, nkv, g, hd), jnp.float32),
             ],
         ),
         out_shape=jax.ShapeDtypeStruct((B, nkv, g, hd), q.dtype),

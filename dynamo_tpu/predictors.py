@@ -104,6 +104,64 @@ class ARPredictor(BasePredictor):
         return float(np.clip(pred, max(0.0, lo - span), hi + span))
 
 
+class SeasonalPredictor(BasePredictor):
+    """Seasonal one-step forecast — the Prophet slot
+    (load_predictor.py:159). Prophet's job for the planner is "daily /
+    weekly traffic has a repeating shape; scale for the next bucket's
+    USUAL level plus the current trend". The honest numpy equivalent is
+    Holt-Winters-style additive decomposition: level (EWMA) + trend
+    (EWMA of first differences) + a per-phase seasonal offset averaged
+    across observed cycles.
+
+    ``period`` is in observations (planner adjustment intervals); e.g.
+    a 60 s interval and period=1440 tracks a daily cycle. Until one full
+    cycle is seen, behaves like trend-following; never predicts
+    negative load.
+    """
+
+    def __init__(self, window_size: int = 4320, period: int = 1440,
+                 alpha: float = 0.4, beta: float = 0.1):
+        if period < 2:
+            raise ValueError("period must be >= 2")
+        super().__init__(max(window_size, 2 * period))
+        self.period = period
+        self.alpha = alpha
+        self.beta = beta
+
+    def predict_next(self) -> float:
+        n = len(self.data)
+        if n == 0:
+            return 0.0
+        series = np.asarray(self.data, np.float64)
+        if n < self.period + 2:
+            # no full cycle yet: level + trend only
+            level, trend = series[0], 0.0
+            for x in series[1:]:
+                prev = level
+                level = self.alpha * x + (1 - self.alpha) * (level + trend)
+                trend = self.beta * (level - prev) + (1 - self.beta) * trend
+            return float(max(0.0, level + trend))
+        # per-phase seasonal offsets vs a centered moving level
+        phases = np.arange(n) % self.period
+        level_series = np.convolve(
+            series, np.ones(self.period) / self.period, mode="same"
+        )
+        resid = series - level_series
+        seasonal = np.zeros(self.period)
+        for ph in range(self.period):
+            vals = resid[phases == ph]
+            if len(vals):
+                seasonal[ph] = float(vals.mean())
+        deseason = series - seasonal[phases]
+        level, trend = deseason[0], 0.0
+        for x in deseason[1:]:
+            prev = level
+            level = self.alpha * x + (1 - self.alpha) * (level + trend)
+            trend = self.beta * (level - prev) + (1 - self.beta) * trend
+        next_phase = n % self.period
+        return float(max(0.0, level + trend + seasonal[next_phase]))
+
+
 def make_predictor(name: str, **kw) -> BasePredictor:
     """Factory used by PlannerConfig.predictor."""
     table = {
@@ -111,6 +169,8 @@ def make_predictor(name: str, **kw) -> BasePredictor:
         "moving_average": MovingAveragePredictor,
         "ar": ARPredictor,
         "arima": ARPredictor,  # the reference's name for this slot
+        "seasonal": SeasonalPredictor,
+        "prophet": SeasonalPredictor,  # the reference's name for the slot
     }
     if name not in table:
         raise ValueError(f"unknown predictor {name!r} (have {sorted(table)})")

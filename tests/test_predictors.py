@@ -70,7 +70,7 @@ def test_make_predictor_names():
     assert isinstance(make_predictor("constant"), ConstantPredictor)
     assert isinstance(make_predictor("arima"), ARPredictor)
     with pytest.raises(ValueError):
-        make_predictor("prophet")
+        make_predictor("nonesuch")
 
 
 # ---------------------------------------------------------------------------
@@ -180,3 +180,40 @@ def test_predictor_reduces_flapping():
     flappy = _sim_flaps("constant", series)
     smooth = _sim_flaps("moving_average", series)
     assert smooth < flappy
+
+
+def test_seasonal_predictor_tracks_cycles():
+    """The Prophet-slot predictor (reference load_predictor.py:159):
+    after two observed cycles of a square wave, the forecast for the
+    next bucket reflects that bucket's USUAL level, not the current one
+    — the planner scales ahead of the daily peak."""
+    import numpy as np
+
+    from dynamo_tpu.predictors import SeasonalPredictor, make_predictor
+
+    p = make_predictor("prophet", period=8)
+    assert isinstance(p, SeasonalPredictor)
+    wave = [10.0] * 4 + [100.0] * 4
+    for _ in range(4):
+        for v in wave:
+            p.add_data_point(v)
+    # next phase is the start of the low half
+    low = p.predict_next()
+    assert low < 50.0
+    # advance into the high half: forecast jumps ahead of the data
+    for v in [10.0] * 4 + [100.0] * 3:
+        p.add_data_point(v)
+    high = p.predict_next()
+    assert high > 50.0
+    assert p.predict_next() >= 0.0
+
+
+def test_seasonal_predictor_prefull_cycle_is_trend_following():
+    from dynamo_tpu.predictors import SeasonalPredictor
+
+    p = SeasonalPredictor(period=100)
+    for v in (1.0, 2.0, 3.0, 4.0):
+        p.add_data_point(v)
+    assert p.predict_next() > 3.0  # rising trend, no cycle seen yet
+    p2 = SeasonalPredictor(period=10)
+    assert p2.predict_next() == 0.0
