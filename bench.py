@@ -385,6 +385,100 @@ async def _run_reuse_phase() -> dict:
     }
 
 
+async def _run_spec_phase() -> dict:
+    """Speculative decoding on a repetitive/structured workload (where
+    prompt-lookup shines: code, extraction, long copies — here a cycled
+    token pattern). Runs the SAME prompts through an n-gram-speculating
+    engine and a plain one and reports accepted-tokens-per-verify-step
+    plus the tok/s ratio. Greedy speculation is output-identical by
+    construction (tests/test_spec.py), so the speedup is free quality-
+    wise whenever acceptance pays for the verify forwards."""
+    import numpy as np
+
+    from dynamo_tpu.engine.config import EngineConfig
+    from dynamo_tpu.engine.engine import TpuEngine
+    from dynamo_tpu.models.config import ModelConfig
+    from dynamo_tpu.parallel.mesh import MeshConfig
+    from dynamo_tpu.protocols.common import (
+        PreprocessedRequest,
+        StopConditions,
+    )
+
+    tiny = os.environ.get("DYNAMO_BENCH_TINY") == "1"
+    if tiny:
+        cfg = ModelConfig.tiny()
+        ecfg_kw = dict(
+            num_pages=128, page_size=16, max_pages_per_seq=16,
+            max_decode_slots=8, prefill_buckets=(128,),
+            cache_dtype="float32",
+        )
+        n_req, isl, osl = 8, 96, 48
+    else:
+        cfg = ModelConfig.llama3_1b()
+        ecfg_kw = dict(
+            num_pages=256, page_size=64, max_pages_per_seq=16,
+            max_decode_slots=8, prefill_buckets=(256,),
+            flush_every=16, max_inflight_rounds=2,
+            prefill_chunks_per_round=8,
+        )
+        n_req, isl, osl = 8, 192, 128
+    k = int(os.environ.get("DYNAMO_BENCH_SPEC_K", 4))
+    rng = np.random.RandomState(0)
+    # repetitive prompts: a short random cycle repeated to ISL — the
+    # generated continuation re-enters the cycle and n-gram lookup
+    # predicts it
+    prompts = []
+    for _ in range(n_req):
+        pat = rng.randint(1, cfg.vocab_size, 16).tolist()
+        prompts.append((pat * (isl // 16 + 1))[:isl])
+
+    async def measure(speculative: str):
+        eng = TpuEngine(
+            cfg,
+            EngineConfig(**ecfg_kw, speculative=speculative,
+                         num_speculative_tokens=k),
+            mesh_config=MeshConfig(tp=1),
+        )
+        eng.start()
+
+        async def one(p, mt):
+            n = 0
+            async for out in eng.generate(PreprocessedRequest(
+                token_ids=list(p),
+                stop_conditions=StopConditions(
+                    max_tokens=mt, ignore_eos=True
+                ),
+            )):
+                n += len(out.token_ids)
+            return n
+
+        # warmup compiles (prefill buckets, decode round / verify)
+        await asyncio.gather(*[one(p, 8) for p in prompts[:2]])
+        t0 = time.monotonic()
+        tokens = sum(await asyncio.gather(
+            *[one(p, osl) for p in prompts]
+        ))
+        wall = time.monotonic() - t0
+        stats = eng.spec.stats() if eng.spec else None
+        await eng.stop()
+        return tokens / wall, stats
+
+    base_tok_s, _ = await measure("off")
+    spec_tok_s, st = await measure("ngram")
+    steps = max(st["spec_verify_steps"], 1)
+    return {
+        "spec_decode_tok_s": round(spec_tok_s, 2),
+        "spec_baseline_tok_s": round(base_tok_s, 2),
+        "spec_speedup": round(spec_tok_s / base_tok_s, 3),
+        # emitted tokens per verify step = accepted drafts + the bonus
+        "spec_tokens_per_step": round(
+            (st["spec_accepted_total"] + steps) / steps, 3
+        ),
+        "spec_acceptance_rate": round(st["spec_acceptance_rate"], 4),
+        "spec_k": k,
+    }
+
+
 def _extra_phase(fields_prefix: str, fn, out: dict,
                  budget_left_s: float) -> float:
     """Run one optional bench phase unless the wall budget is spent."""
@@ -464,8 +558,16 @@ def main():
         budget = float(os.environ.get("DYNAMO_BENCH_BUDGET_S", 900))
         budget -= _extra_phase("int8_8b", _run_8b_int8_phase, out, budget)
         budget -= _extra_phase(
+            "spec", lambda: asyncio.run(_run_spec_phase()), out, budget)
+        budget -= _extra_phase(
             "reuse", lambda: asyncio.run(_run_reuse_phase()), out, budget)
         budget -= _extra_phase("isl3000", _run_isl3000_phase, out, budget)
+    elif (os.environ.get("DYNAMO_BENCH_EXTRA", "1") != "0"
+            and os.environ.get("DYNAMO_BENCH_TINY") == "1"):
+        # the spec phase has a tiny mode: keep it observable in CI runs
+        _extra_phase(
+            "spec", lambda: asyncio.run(_run_spec_phase()), out,
+            float(os.environ.get("DYNAMO_BENCH_BUDGET_S", 900)))
     print(json.dumps(out))
 
 

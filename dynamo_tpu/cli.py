@@ -4,7 +4,9 @@ Mirrors the reference's launcher surface (launch/dynamo-run/src/main.rs).
 Subcommands:
   run   serve a graph: in=<http|text|stdin|batch:FILE|endpoint> out=<echo|mocker|tpu>
         (distributed mode: --control-plane HOST:PORT; workers use
-         in=endpoint, frontends in=http discover models dynamically)
+         in=endpoint, frontends in=http discover models dynamically;
+         out=tpu takes --speculative {off,ngram,draft} and
+         --num-speculative-tokens K for speculative decoding)
   cp    run the control-plane store (native dcp-server if built, else the
         wire-compatible Python fallback): cp --port 7111
   serve    launch a whole serving graph (store+workers+frontend) from a

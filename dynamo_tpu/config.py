@@ -45,6 +45,9 @@ class RuntimeConfig:
     host_offload_pages: int = 0
     disk_offload_pages: int = 0
     disk_offload_path: Optional[str] = None
+    # speculative decoding (dynamo_tpu/spec/): off | ngram | draft
+    speculative: str = "off"
+    num_speculative_tokens: int = 4
 
     @property
     def store_host_port(self) -> tuple[str, int]:

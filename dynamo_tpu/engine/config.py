@@ -55,6 +55,17 @@ class EngineConfig:
     # requests asking for logprobs compile the lp variant of the step
     max_logprobs: int = 20
 
+    # speculative decoding (dynamo_tpu/spec/): "off" | "ngram" | "draft".
+    # ngram needs no extra model (prompt-lookup against the request's own
+    # history); draft needs a draft_config/draft_params pair passed to
+    # TpuEngine (a small model sharing the target tokenizer). Eligible
+    # slots (no penalties/logprobs) verify K proposed tokens per target
+    # forward instead of taking the fused decode round.
+    speculative: str = "off"
+    num_speculative_tokens: int = 4   # K proposals per verify step
+    spec_ngram_max: int = 3           # longest tail n-gram to match
+    spec_ngram_min: int = 1
+
     # prefix cache
     enable_prefix_caching: bool = True
 

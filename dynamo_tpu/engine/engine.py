@@ -112,6 +112,19 @@ class _Request:
     finished: bool = False
     enqueue_time: float = field(default_factory=time.monotonic)
     first_token_time: Optional[float] = None
+    # speculative decoding (spec/): a speculating slot's device lane
+    # stays PARKED (dest=scratch) — its real state lives here on the
+    # host and in the ctx region, driven by verify dispatches instead of
+    # the fused decode round.
+    spec: bool = False
+    spec_ready: bool = False       # host knows the pending token
+    spec_inflight: bool = False    # a verify dispatch is outstanding
+    # full sequence incl. the pending token (region holds KV for all but
+    # the last element) — the proposers' lookup corpus
+    spec_tokens: list[int] = field(default_factory=list)
+    spec_keys: Optional[np.ndarray] = None  # [2] uint32 PRNG key
+    spec_proposed: int = 0
+    spec_accepted: int = 0
 
     @property
     def prompt_len(self) -> int:
@@ -144,6 +157,12 @@ class _Entry:
     # logprobs: stacked (chosen [F,B], top_ids [F,B,K], top_lps [F,B,K])
     # for rounds, or the single-step tuple for "first" entries
     lp_handle: Optional[tuple] = None
+    # spec verify: (slot, request, history-length-at-dispatch) per live
+    # row, aligned with the leading rows of the fetched arrays
+    rows: list[tuple] = field(default_factory=list)
+    # spec verify: (n_out [B], new_keys [B, 2]) device handles fetched
+    # alongside `handle` (the [B, K+1] accepted-token array)
+    aux: Any = None
 
 
 class TpuEngine:
@@ -161,6 +180,8 @@ class TpuEngine:
         on_kv_event: Optional[Callable[[KvCacheEvent], None]] = None,
         on_metrics: Optional[Callable[[ForwardPassMetrics], None]] = None,
         on_dispatch: Optional[Callable[[str, dict], None]] = None,
+        draft_config: Any = None,
+        draft_params: Any = None,
     ):
         self.config = model_config
         self.ecfg = engine_config or EngineConfig()
@@ -179,6 +200,12 @@ class TpuEngine:
                 raise ValueError(
                     "multihost engine: host/disk offload tiers are "
                     "single-host features"
+                )
+            if self.ecfg.speculative != "off":
+                raise ValueError(
+                    "multihost engine: speculative decoding is a "
+                    "single-host feature (the verify/propose dispatch "
+                    "sequence is data-dependent on fetched results)"
                 )
 
         c, e = self.config, self.ecfg
@@ -247,6 +274,19 @@ class TpuEngine:
             )
             self.allocator.on_park = (
                 lambda p, h, par: self._offload_cands.append((p, h, par))
+            )
+
+        # speculative decoding (dynamo_tpu/spec/): proposers, the fused
+        # verifier, acceptance counters. Eligible slots bypass the fused
+        # decode round entirely — see _dispatch_spec.
+        self.spec = None
+        if e.speculative != "off":
+            from dynamo_tpu.spec import SpecDecoder
+
+            self.spec = SpecDecoder(
+                c, e, mesh=self.mesh,
+                draft_config=draft_config, draft_params=draft_params,
+                rng_seed=rng_seed,
             )
 
         B = e.max_decode_slots
@@ -705,6 +745,15 @@ class TpuEngine:
                     sum(1 for r in self._waiting if r.slot < 0)
                     + self._intake.qsize()
                 ),
+                spec_proposed_total=(
+                    self.spec.proposed_total if self.spec else 0
+                ),
+                spec_accepted_total=(
+                    self.spec.accepted_total if self.spec else 0
+                ),
+                spec_acceptance_rate=(
+                    self.spec.acceptance_rate() if self.spec else 0.0
+                ),
             ),
             kv_stats=KvStats(
                 kv_active_blocks=a.active_pages,
@@ -775,18 +824,29 @@ class TpuEngine:
 
         # dispatch only for LIVE requests: a round for finished-awaiting-
         # release slots is pure garbage work that also queues ahead of the
-        # next arrival's prefill (isolated-TTFT cost on an idling engine)
+        # next arrival's prefill (isolated-TTFT cost on an idling engine).
+        # Speculating slots are excluded — their device lanes are parked
+        # and they advance through verify dispatches instead.
         active = [
             i for i, s in enumerate(self._slots)
-            if s is not None and not s.finished
+            if s is not None and not s.finished and not s.spec
         ]
         did_work = bool(self._entries)
         rounds_in_flight = sum(1 for en in self._entries if en.kind == "round")
+        dispatched = False
         if active and rounds_in_flight <= e.max_inflight_rounds:
             self._dispatch_round(active)
-            did_work = True
+            did_work = dispatched = True
+        if self.spec is not None and self._dispatch_spec():
+            did_work = dispatched = True
         if self.on_metrics is not None:
             self.on_metrics(self.metrics())
+        if (not dispatched and self._entries
+                and self._intake.empty() and not self._waiting):
+            # nothing to overlap with the in-flight fetches (e.g. every
+            # live slot is waiting on its verify result) — block on the
+            # head entry instead of spinning the loop
+            self._process_entries(block=True)
         return did_work
 
     def _drain_intake(self) -> None:
@@ -832,7 +892,11 @@ class TpuEngine:
                 want_lp, want_sample,
             )
         )
-        self._ctx_disp = np.minimum(self._ctx_disp + n, e.max_context)
+        # only dispatched lanes advance (spec slots track their own
+        # lengths through verify processing)
+        self._ctx_disp[active] = np.minimum(
+            self._ctx_disp[active] + n, e.max_context
+        )
         self.step_count += n
         stacked.copy_to_host_async()
         if lp_stacked is not None:
@@ -880,6 +944,160 @@ class TpuEngine:
             jnp.float32(a.get("pres", 0.0)),
             jnp.float32(a.get("rep", 1.0)),
         )
+
+    # ---- speculative decoding (spec/): propose -> fused verify ----
+
+    def _dispatch_spec(self) -> bool:
+        """Collect spec-ready slots, propose K tokens each, dispatch ONE
+        fused score+accept program (static width B; dummy rows target the
+        scratch lane). The verify optimistically writes K+1 KV rows per
+        slot; the host later commits only the accepted prefix — rollback
+        is pointer truncation because attention masks by sequence length
+        and the next write over the lane overwrites the dead span.
+        Returns True if anything was dispatched."""
+        e = self.ecfg
+        K = self.spec.k
+        ready = [
+            (i, r) for i, r in enumerate(self._slots)
+            if r is not None and r.spec and r.spec_ready
+            and not r.finished and not r.cancelled and not r.spec_inflight
+        ]
+        if not ready:
+            return False
+        rows: list[tuple[int, _Request, int]] = []
+        dispatched = False
+        for slot, r in ready:
+            n_hist = len(r.spec_tokens)
+            # the verify writes K+1 rows at [N, N+K+1); when that no
+            # longer fits the region, hand the slot back to the fused
+            # decode round for its final tokens
+            if (n_hist - 1) + K + 1 > e.max_context:
+                self._despeculate(slot, r)
+                dispatched = True
+                continue
+            rows.append((slot, r, n_hist))
+        if not rows:
+            return dispatched
+        B = self._B
+        toks = np.zeros((B, K + 1), np.int32)
+        slots_a = np.full(B, B, np.int32)     # dummies -> scratch lane
+        q_starts = np.zeros(B, np.int32)
+        seq_lens = np.zeros(B, np.int32)      # 0: dummy rows fully masked
+        keys = np.zeros((B, 2), np.uint32)
+        temps = np.zeros(B, np.float32)
+        top_ks = np.zeros(B, np.int32)
+        top_ps = np.ones(B, np.float32)
+        draft_rows: list[tuple[int, Any]] = []
+        for j, (slot, r, n_hist) in enumerate(rows):
+            toks[j, 0] = r.spec_tokens[-1]    # pending token
+            slots_a[j] = slot
+            q_starts[j] = n_hist - 1
+            seq_lens[j] = n_hist + K
+            keys[j] = r.spec_keys
+            so = r.req.sampling_options
+            temps[j] = so.temperature or 0.0
+            top_ks[j] = so.top_k or 0
+            top_ps[j] = so.top_p if so.top_p is not None else 1.0
+            proposal = self.spec.propose(slot, r.spec_tokens)
+            if isinstance(proposal, list):    # n-gram: host tokens
+                toks[j, 1:] = proposal
+            else:                             # draft: device [K], no sync
+                draft_rows.append((j, proposal))
+        toks_dev = jnp.asarray(toks)
+        for j, prop in draft_rows:
+            toks_dev = toks_dev.at[j, 1:].set(prop)
+        self.ctx, out_toks, n_out, new_keys = self.spec.verify(
+            self.params, self.ctx, toks_dev, slots_a, q_starts,
+            seq_lens, keys, temps, top_ks, top_ps,
+        )
+        for arr in (out_toks, n_out, new_keys):
+            arr.copy_to_host_async()
+        for slot, r, _ in rows:
+            r.spec_ready = False
+            r.spec_inflight = True
+        self._entries.append(_Entry(
+            kind="spec", handle=out_toks, rows=rows,
+            aux=(n_out, new_keys), n_steps=K,
+        ))
+        return True
+
+    def _despeculate(self, slot: int, r: _Request) -> None:
+        """Hand a speculating slot back to the fused decode round: the
+        admit patch restores the exact device state the non-spec path
+        would carry (pending token, ctx length, PRNG keys) — the
+        continuation is token-identical."""
+        so = r.req.sampling_options
+        r.spec = False
+        r.spec_ready = False
+        self.spec.on_despec(slot)
+        self._ctx_disp[slot] = len(r.spec_tokens)
+        self._dispatch_patch(admit=dict(
+            slot=slot,
+            ctx=len(r.spec_tokens),
+            tok=jnp.asarray([r.spec_tokens[-1]], jnp.int32),
+            keys=np.asarray(r.spec_keys, np.uint32),
+            temp=so.temperature or 0.0,
+            top_k=so.top_k or 0,
+            top_p=so.top_p if so.top_p is not None else 1.0,
+        ))
+
+    def _process_spec(self, entry: _Entry) -> None:
+        """Consume one verify result: emit the accepted prefix + bonus
+        token per slot, advance host history and PRNG keys, roll the
+        draft model's KV pointer back to the accepted length."""
+        out = np.asarray(entry.handle)          # [B, K+1]
+        n_out_arr = np.asarray(entry.aux[0])    # [B]
+        new_keys = np.asarray(entry.aux[1])     # [B, 2]
+        for j, (slot, r, hist_len) in enumerate(entry.rows):
+            r.spec_inflight = False
+            if r.finished or self._slots[slot] is not r:
+                continue
+            if r.cancelled:
+                self._finish(r, None)
+                continue
+            n = int(n_out_arr[j])
+            accepted = n - 1
+            self.spec.on_result(slot, hist_len, accepted)
+            r.spec_proposed += self.spec.k
+            r.spec_accepted += accepted
+            toks = [int(t) for t in out[j, :n]]
+            batch: list[int] = []
+            finish: Optional[FinishReason] = None
+            for tok in toks:
+                finish = self._advance_token(r, tok)
+                if finish is FinishReason.EOS:
+                    break  # stop token itself is not emitted
+                batch.append(tok)
+                if finish is not None:
+                    break
+            if batch or finish is not None:
+                extra = (
+                    {"annotations": self._spec_annotations(r)}
+                    if finish is not None else {}
+                )
+                r.emit(LLMEngineOutput(
+                    token_ids=batch, finish_reason=finish, **extra
+                ))
+            self.tokens_generated += len(batch)
+            if finish is not None:
+                self._finish(r, None)
+                continue
+            r.spec_tokens.extend(toks)  # accepted + bonus, all emitted
+            r.spec_keys = new_keys[j]
+            r.spec_ready = True
+            self._ctx_disp[slot] = len(r.spec_tokens)
+
+    def _spec_annotations(self, r: _Request) -> dict:
+        """Per-request speculation stats for the finishing output — the
+        SDK reads these back as request stats (sdk.request_stats), which
+        is what lets a planner gate speculation on observed acceptance."""
+        if r.spec_proposed <= 0:
+            return {}
+        return {"spec": {
+            "proposed": r.spec_proposed,
+            "accepted": r.spec_accepted,
+            "acceptance_rate": r.spec_accepted / r.spec_proposed,
+        }}
 
     # ---- block sealing (ctx -> pool prefix-cache copies) ----
 
@@ -1387,20 +1605,29 @@ class TpuEngine:
         del self._prefilling[slot]
         self._slots[slot] = r
         self._ctx_disp[slot] = len(prompt) + 1
-        self._dispatch_patch(
-            admit=dict(
-                slot=slot,
-                ctx=len(prompt) + 1,
-                tok=first_tok,
-                keys=step_keys,
-                temp=so.temperature or 0.0,
-                top_k=so.top_k or 0,
-                top_p=so.top_p if so.top_p is not None else 1.0,
-                freq=so.frequency_penalty or 0.0,
-                pres=so.presence_penalty or 0.0,
-                rep=so.repetition_penalty or 1.0,
-            ),
-        )
+        if self.spec is not None and self.spec.eligible(r.req):
+            # speculative admission: the device lane stays PARKED on the
+            # scratch lane (exactly like a freed slot) — the slot's real
+            # state lives host-side and it advances through verify
+            # dispatches once the first token's fetch lands
+            # (_process_first marks it spec-ready)
+            r.spec = True
+            r.spec_keys = np.asarray(step_keys, np.uint32)
+        else:
+            self._dispatch_patch(
+                admit=dict(
+                    slot=slot,
+                    ctx=len(prompt) + 1,
+                    tok=first_tok,
+                    keys=step_keys,
+                    temp=so.temperature or 0.0,
+                    top_k=so.top_k or 0,
+                    top_p=so.top_p if so.top_p is not None else 1.0,
+                    freq=so.frequency_penalty or 0.0,
+                    pres=so.presence_penalty or 0.0,
+                    rep=so.repetition_penalty or 1.0,
+                ),
+            )
         # first token reaches the client via the async fetch pipeline
         first_tok.copy_to_host_async()
         if first_lp is not None:
@@ -1448,6 +1675,8 @@ class TpuEngine:
                 entry.hashes, entry.parents,
                 data[:, :, :, : entry.n_steps],
             )
+        elif entry.kind == "spec":
+            self._process_spec(entry)
         else:
             self._process_round(entry, data)
 
@@ -1478,6 +1707,10 @@ class TpuEngine:
         r.emit(LLMEngineOutput(token_ids=[tok], **self._lp_payload(r, lp)))
         if r.produced >= r.max_new_tokens(self.ecfg.max_context):
             self._finish(r, FinishReason.LENGTH, emit_empty=True)
+        elif r.spec:
+            # the host now knows the pending token — speculation can start
+            r.spec_tokens = list(r.tokens) + [tok]
+            r.spec_ready = True
 
     def _process_round(self, entry: _Entry, toks: np.ndarray) -> None:
         """Consume one round's stacked tokens. Emission is BATCHED per
@@ -1521,6 +1754,10 @@ class TpuEngine:
                 extra = {}
                 if lp_chosen:
                     extra = {"log_probs": lp_chosen, "top_logprobs": lp_top}
+                if finish is not None:
+                    ann = self._spec_annotations(r)
+                    if ann:  # de-speculated requests finishing here
+                        extra["annotations"] = ann
                 r.emit(LLMEngineOutput(
                     token_ids=batch, finish_reason=finish, **extra
                 ))
@@ -1568,7 +1805,10 @@ class TpuEngine:
             return
         r.finished = True
         if reason is not None:
-            r.emit(LLMEngineOutput(token_ids=[], finish_reason=reason))
+            r.emit(LLMEngineOutput(
+                token_ids=[], finish_reason=reason,
+                annotations=self._spec_annotations(r),
+            ))
         self._to_release.append(r)
 
     def _apply_releases(self) -> None:
@@ -1585,6 +1825,8 @@ class TpuEngine:
                 clear_slots.append(r.slot)
                 self._slots[r.slot] = None
                 self._ctx_disp[r.slot] = 1
+                if self.spec is not None and r.spec:
+                    self.spec.release(r.slot)  # drop stale draft KV state
             r.slot = -1
         self._to_release = []
         if clear_slots:
@@ -1596,6 +1838,9 @@ class TpuEngine:
                 r.emit(err)
                 r.finished = True
         self._slots = [None] * self._B
+        if self.spec is not None:
+            for i in range(self._B):
+                self.spec.release(i)
         for r in self._waiting:
             r.emit(err)
             self._abort_prefill(r)

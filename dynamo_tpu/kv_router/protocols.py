@@ -73,6 +73,13 @@ class WorkerStats:
     request_active_slots: int = 0
     request_total_slots: int = 0
     num_requests_waiting: int = 0
+    # speculative decoding acceptance (dynamo_tpu/spec/): cumulative
+    # proposed/accepted drafts and the rolling acceptance rate — the
+    # signal a planner needs to gate speculation per workload. All zero
+    # when speculation is off.
+    spec_proposed_total: int = 0
+    spec_accepted_total: int = 0
+    spec_acceptance_rate: float = 0.0
 
 
 @dataclass

@@ -76,6 +76,21 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--disk-offload-path", default=cfg.disk_offload_path,
                    help="backing file for the G3 pool "
                         "(default: fresh tempfile)")
+    # speculative decoding (dynamo_tpu/spec/)
+    p.add_argument("--speculative", default=cfg.speculative,
+                   choices=["off", "ngram", "draft"],
+                   help="speculative decoding: ngram = model-free "
+                        "prompt-lookup proposer; draft = small draft "
+                        "model sharing the tokenizer (--draft-model-"
+                        "config); eligible requests verify K proposed "
+                        "tokens per target forward")
+    p.add_argument("--num-speculative-tokens", type=int,
+                   default=cfg.num_speculative_tokens,
+                   help="K: proposed tokens per verify step")
+    p.add_argument("--draft-model-config", default=None,
+                   help="canned ModelConfig name for the draft model "
+                        "(speculative=draft; must share the target "
+                        "vocab, e.g. tiny for --model-config tiny)")
     # distributed mode (reference: etcd/NATS endpoints; here the dcp store).
     # --control-plane default stays None (it's the discovery-mode switch);
     # RuntimeConfig.control_plane is None unless the config file or
@@ -398,7 +413,16 @@ def build_chain(args) -> "Any":
             host_offload_pages=args.host_offload_pages,
             disk_offload_pages=args.disk_offload_pages,
             disk_offload_path=args.disk_offload_path,
+            speculative=args.speculative,
+            num_speculative_tokens=args.num_speculative_tokens,
         )
+        draft_cfg = None
+        if args.speculative == "draft":
+            if not args.draft_model_config:
+                raise SystemExit(
+                    "--speculative draft needs --draft-model-config"
+                )
+            draft_cfg = getattr(ModelConfig, args.draft_model_config)()
         params = None
         if args.model_path and gguf_meta is not None:
             from dynamo_tpu.gguf import load_gguf_params
@@ -420,6 +444,7 @@ def build_chain(args) -> "Any":
             ) if local_devices is not None else None,
             mesh_config=MeshConfig(tp=args.tensor_parallel_size),
             on_dispatch=on_dispatch,
+            draft_config=draft_cfg,
         )
     else:
         raise SystemExit(f"unknown engine out={out!r}")

@@ -10,6 +10,7 @@ Exposed (all labelled by worker):
   dynamo_worker_active_slots / total_slots / waiting_requests
   dynamo_kv_active_blocks / total_blocks / usage_perc / hit_rate
   dynamo_kv_host_blocks / host_onboard_hits
+  dynamo_spec_proposed_total / accepted_total / acceptance_rate
 Run: ``dynamo-tpu metrics --control-plane HOST:PORT --port 9090``.
 """
 from __future__ import annotations
@@ -110,6 +111,18 @@ class MetricsExporter:
               {w: m.kv_stats.host_blocks for w, m in snap.metrics.items()})
         gauge("dynamo_kv_host_onboard_hits", "G2 onboard hits",
               {w: m.kv_stats.host_onboard_hits
+               for w, m in snap.metrics.items()})
+        gauge("dynamo_spec_proposed_total",
+              "speculative tokens proposed",
+              {w: m.worker_stats.spec_proposed_total
+               for w, m in snap.metrics.items()})
+        gauge("dynamo_spec_accepted_total",
+              "speculative tokens accepted",
+              {w: m.worker_stats.spec_accepted_total
+               for w, m in snap.metrics.items()})
+        gauge("dynamo_spec_acceptance_rate",
+              "rolling speculative acceptance rate",
+              {w: m.worker_stats.spec_acceptance_rate
                for w, m in snap.metrics.items()})
         lines.append(f"dynamo_metrics_workers {len(snap.metrics)}")
         return "\n".join(lines) + "\n"

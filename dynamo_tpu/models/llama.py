@@ -502,36 +502,22 @@ def prefill_impl(
 prefill = jax.jit(prefill_impl, static_argnums=(0,), donate_argnums=(2,))
 
 
-def batch_prefill_impl(
+def _batch_forward(
     config: ModelConfig,
     params: Params,
     ctx_kv: Cache,
     tokens: jnp.ndarray,    # [K, T] int32, bucket-padded per request
-    slots: jnp.ndarray,     # [K] i32 — destination slot lanes (distinct)
-    q_starts: jnp.ndarray,  # [K] i32 — tokens already in each region
-    seq_lens: jnp.ndarray,  # [K] i32 — total valid context per request
-    ctx_span: int = 0,      # STATIC: prior-context window to attend
-                            # (pow2 >= max(q_starts); 0 = fresh prefill,
-                            # no context read compiled at all)
-) -> tuple[Cache, jnp.ndarray]:
-    """Batched multi-request prefill: K chunks through the model in ONE
-    program — the TTFT lever for concurrent arrivals (reference analogue:
-    vLLM's max_num_batched_tokens prefill batching; the per-request
-    `prefill` above keeps the multimodal-embeds and odd-shape paths).
-
-    Matmuls see [K*T, H] rows (the MXU-utilization win over K separate
-    [T, H] dispatches); attention is the blocked flash scan
-    (ops/attention.py flash_prefill_attention), so no [T, S+T] score
-    tensor materializes. Per-request KV lands in each slot's contiguous
-    region at [q_start_k, q_start_k+T); all writes happen in one tail
-    pass after the last read (the round-4 no-interleave discipline —
-    models/llama.py module doc). Returns (ctx_kv, logits[K, vocab]) with
-    each row the last valid token's logits.
-
-    Padding lanes (group smaller than the compiled K): point slot at the
-    scratch lane (batch index B) with seq_len=0 — ffn_valid masks their
-    tokens out of MoE routing and their region writes hit scratch.
-    """
+    slots: jnp.ndarray,     # [K] i32
+    q_starts: jnp.ndarray,  # [K] i32
+    seq_lens: jnp.ndarray,  # [K] i32
+    ctx_span: int,
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Read-only vmapped layer stack shared by batch_prefill and
+    batch_score: K chunks through the model in one program. Returns
+    (ks, vs, h) — stacked per-layer KV [K, L, T, kvh, hd] and final
+    hidden states [K, T, H]; region writes happen OUTSIDE the vmap (a
+    shared-buffer update inside vmap would be a scatter with
+    lane-conflict semantics)."""
     c = config
     K, T = tokens.shape
     inv_freq = jnp.asarray(
@@ -539,10 +525,6 @@ def batch_prefill_impl(
     )
 
     def compute(toks, slot, q_start, seq_len):
-        """Read-only per-request layer stack (vmapped over K): returns
-        stacked per-layer KV + last-token logits; region writes happen
-        outside the vmap (a shared-buffer update inside vmap would be a
-        scatter with lane-conflict semantics)."""
         positions = q_start + jnp.arange(T, dtype=jnp.int32)
         cos, sin = rope_cos_sin(positions, inv_freq)
         h = _embed_rows(params, toks, ctx_kv["k"].dtype)
@@ -573,16 +555,25 @@ def batch_prefill_impl(
 
             h, _ = _layer_body(c, lp, h, cos, sin, write_kv, attend,
                                ffn_valid=positions < seq_len)
-        last = seq_len - q_start - 1
-        logits = _logits(c, params, h[last])
         return (
             jnp.stack(new_ks).astype(ctx_kv["k"].dtype),
             jnp.stack(new_vs).astype(ctx_kv["v"].dtype),
-            logits,
+            h,
         )
 
-    ks, vs, logits = jax.vmap(compute)(tokens, slots, q_starts, seq_lens)
-    # tail: K span writes per buffer, K static (unrolled), all reads done
+    return jax.vmap(compute)(tokens, slots, q_starts, seq_lens)
+
+
+def _write_chunks(
+    ctx_kv: Cache,
+    ks: jnp.ndarray,        # [K, L, T, kvh, hd]
+    vs: jnp.ndarray,
+    slots: jnp.ndarray,
+    q_starts: jnp.ndarray,
+) -> Cache:
+    """Tail pass: K span writes per buffer, K static (unrolled), after
+    every read — the donated update chain aliases in place."""
+    K = ks.shape[0]
     ck, cv = ctx_kv["k"], ctx_kv["v"]
     for i in range(K):
         upd_k = ks[i].transpose(0, 2, 1, 3)[:, :, None]  # [L,kvh,1,T,hd]
@@ -590,12 +581,79 @@ def batch_prefill_impl(
         at = (0, 0, slots[i], q_starts[i], 0)
         ck = jax.lax.dynamic_update_slice(ck, upd_k, at)
         cv = jax.lax.dynamic_update_slice(cv, upd_v, at)
-    return {"k": ck, "v": cv}, logits
+    return {"k": ck, "v": cv}
+
+
+def batch_prefill_impl(
+    config: ModelConfig,
+    params: Params,
+    ctx_kv: Cache,
+    tokens: jnp.ndarray,    # [K, T] int32, bucket-padded per request
+    slots: jnp.ndarray,     # [K] i32 — destination slot lanes (distinct)
+    q_starts: jnp.ndarray,  # [K] i32 — tokens already in each region
+    seq_lens: jnp.ndarray,  # [K] i32 — total valid context per request
+    ctx_span: int = 0,      # STATIC: prior-context window to attend
+                            # (pow2 >= max(q_starts); 0 = fresh prefill,
+                            # no context read compiled at all)
+) -> tuple[Cache, jnp.ndarray]:
+    """Batched multi-request prefill: K chunks through the model in ONE
+    program — the TTFT lever for concurrent arrivals (reference analogue:
+    vLLM's max_num_batched_tokens prefill batching; the per-request
+    `prefill` above keeps the multimodal-embeds and odd-shape paths).
+
+    Matmuls see [K*T, H] rows (the MXU-utilization win over K separate
+    [T, H] dispatches); attention is the blocked flash scan
+    (ops/attention.py flash_prefill_attention), so no [T, S+T] score
+    tensor materializes. Per-request KV lands in each slot's contiguous
+    region at [q_start_k, q_start_k+T); all writes happen in one tail
+    pass after the last read (the round-4 no-interleave discipline —
+    models/llama.py module doc). Returns (ctx_kv, logits[K, vocab]) with
+    each row the last valid token's logits.
+
+    Padding lanes (group smaller than the compiled K): point slot at the
+    scratch lane (batch index B) with seq_len=0 — ffn_valid masks their
+    tokens out of MoE routing and their region writes hit scratch.
+    """
+    ks, vs, h = _batch_forward(
+        config, params, ctx_kv, tokens, slots, q_starts, seq_lens, ctx_span
+    )
+    ctx_kv = _write_chunks(ctx_kv, ks, vs, slots, q_starts)
+    last = jnp.maximum(seq_lens - q_starts - 1, 0)
+    h_last = jnp.take_along_axis(h, last[:, None, None], axis=1)[:, 0]
+    logits = _logits(config, params, h_last)
+    return ctx_kv, logits
 
 
 batch_prefill = jax.jit(
     batch_prefill_impl, static_argnums=(0, 7), donate_argnums=(2,)
 )
+
+
+def batch_score_impl(
+    config: ModelConfig,
+    params: Params,
+    ctx_kv: Cache,
+    tokens: jnp.ndarray,    # [K, T] int32 — T = pending + proposed tokens
+    slots: jnp.ndarray,     # [K] i32 (dummies -> scratch lane)
+    q_starts: jnp.ndarray,  # [K] i32 — tokens already in each region
+    seq_lens: jnp.ndarray,  # [K] i32 — q_start + T for live rows, 0 dummy
+    ctx_span: int,          # STATIC prior-context window (always > 0 here)
+) -> tuple[Cache, jnp.ndarray]:
+    """Speculative-verification scorer: identical to batch_prefill — same
+    chunked q_start>0 forward, same optimistic KV tail write — but
+    returns logits for EVERY chunk position [K, T, V], not just the last.
+    Row t of a chunk scores the target's distribution for the token
+    FOLLOWING tokens[:, t] — the verifier (spec/verifier.py) compares
+    those rows against the proposed tokens. The KV rows written for
+    later-rejected tokens are dead weight past the committed length:
+    attention masks by seq_len and the next write over the lane
+    overwrites them, so rollback is pointer truncation, not a device op.
+    """
+    ks, vs, h = _batch_forward(
+        config, params, ctx_kv, tokens, slots, q_starts, seq_lens, ctx_span
+    )
+    ctx_kv = _write_chunks(ctx_kv, ks, vs, slots, q_starts)
+    return ctx_kv, _logits(config, params, h)
 
 
 # ---------------------------------------------------------------------------

@@ -95,6 +95,13 @@ class SystemServer:
                   ks.gpu_prefix_cache_hit_rate)
                 g("dynamo_kv_host_blocks", "host-tier (G2) cached pages",
                   ks.host_blocks)
+                g("dynamo_spec_proposed_total",
+                  "speculative tokens proposed", ws.spec_proposed_total)
+                g("dynamo_spec_accepted_total",
+                  "speculative tokens accepted", ws.spec_accepted_total)
+                g("dynamo_spec_acceptance_rate",
+                  "rolling speculative acceptance rate",
+                  ws.spec_acceptance_rate)
         return "\n".join(lines) + "\n"
 
     async def handle_metrics(self, request: web.Request) -> web.Response:

@@ -37,9 +37,57 @@ from __future__ import annotations
 import inspect
 import logging
 from dataclasses import dataclass, field
-from typing import Any, Callable, Optional
+from typing import Any, Callable, Iterable, Optional
 
 log = logging.getLogger(__name__)
+
+
+# ---------------------------------------------------------------------------
+# Request stats: the SDK-side read of the engine's in-band annotation
+# plane. The engine attaches per-request speculation counters to the
+# finishing LLMEngineOutput (annotations["spec"]); request_stats folds a
+# request's output stream into one record a caller (or the planner) can
+# act on — e.g. gate speculation off for workloads whose acceptance rate
+# doesn't pay for the verify forwards.
+
+@dataclass
+class RequestStats:
+    """Per-request generation statistics folded from an output stream."""
+
+    output_tokens: int = 0
+    finish_reason: Optional[str] = None
+    # speculative decoding (zero when the request didn't speculate)
+    spec_proposed: int = 0
+    spec_accepted: int = 0
+
+    @property
+    def spec_acceptance_rate(self) -> Optional[float]:
+        if self.spec_proposed <= 0:
+            return None
+        return self.spec_accepted / self.spec_proposed
+
+
+def request_stats(outputs: Iterable[Any]) -> RequestStats:
+    """Fold a request's LLMEngineOutput stream (objects or to_dict()
+    payloads) into a RequestStats."""
+    st = RequestStats()
+    for out in outputs:
+        if isinstance(out, dict):
+            toks = out.get("token_ids") or []
+            ann = out.get("annotations") or {}
+            fr = out.get("finish_reason")
+        else:
+            toks = out.token_ids or []
+            ann = out.annotations or {}
+            fr = out.finish_reason.value if out.finish_reason else None
+        st.output_tokens += len(toks)
+        if fr is not None:
+            st.finish_reason = fr
+        spec = ann.get("spec")
+        if spec:
+            st.spec_proposed = int(spec.get("proposed", 0))
+            st.spec_accepted = int(spec.get("accepted", 0))
+    return st
 
 
 @dataclass

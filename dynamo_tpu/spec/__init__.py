@@ -1,0 +1,34 @@
+"""Speculative decoding subsystem.
+
+Per-request speculation for the TPU engine: a proposer drafts K candidate
+tokens ahead of the target model, the verifier scores all of them in ONE
+target forward (the q_start>0 chunked-prefill program shape), and the
+engine commits the accepted prefix plus one bonus token — turning one
+memory-bound decode step into up to K+1 output tokens.
+
+  proposer.py   model-free n-gram/prompt-lookup proposer (host-side,
+                deterministic) and a draft-model proposer (small model
+                sharing the tokenizer, run through llama.prefill)
+  verifier.py   fused on-device verification: score + longest-prefix /
+                rejection-sampling acceptance in one jit
+  decoder.py    SpecDecoder — the engine-facing facade (eligibility,
+                proposal dispatch, counters, draft-KV rollback)
+
+The engine integration (dynamo_tpu/engine/engine.py) keeps speculating
+slots OUT of the fused decode round (their device lanes stay parked on
+the scratch lane, exactly like freed slots) and drives them through
+verify dispatches instead; rejected tokens need no device-side cleanup
+because the contiguous ctx region masks attention by sequence length and
+later writes overwrite the dead span — rollback is pointer truncation.
+"""
+from dynamo_tpu.spec.decoder import SpecDecoder
+from dynamo_tpu.spec.proposer import DraftModelProposer, NGramProposer
+from dynamo_tpu.spec.verifier import accept_tokens, spec_verify
+
+__all__ = [
+    "SpecDecoder",
+    "NGramProposer",
+    "DraftModelProposer",
+    "accept_tokens",
+    "spec_verify",
+]

@@ -1,0 +1,161 @@
+"""SpecDecoder: the engine-facing facade of the speculation subsystem.
+
+Owns the proposer (n-gram or draft model), the acceptance counters, and
+the verify dispatch plumbing. The engine scheduler calls:
+
+  eligible(req)           may this request speculate? (penalties and
+                          logprobs need the per-token sampler path)
+  propose(slot, history)  K candidate tokens — host list (n-gram) or
+                          device array (draft model, no host sync)
+  verify(...)             dispatch the fused score+accept program for a
+                          batch of speculating slots
+  on_result(...)          commit counters + roll the draft KV back to
+                          the accepted length
+  release(slot)           slot freed/de-speculated — drop draft state
+
+Counters feed three surfaces: engine.metrics() (WorkerStats spec
+fields -> metrics_exporter/system_server gauges), per-request
+annotations on the finishing LLMEngineOutput (sdk.request_stats), and
+the bench speculative phase.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dynamo_tpu.engine.config import EngineConfig
+from dynamo_tpu.models.config import ModelConfig
+from dynamo_tpu.spec.proposer import DraftModelProposer, NGramProposer
+from dynamo_tpu.spec.verifier import spec_verify
+
+
+class SpecDecoder:
+    def __init__(
+        self,
+        config: ModelConfig,
+        ecfg: EngineConfig,
+        *,
+        mesh: Optional[jax.sharding.Mesh] = None,
+        draft_config: Optional[ModelConfig] = None,
+        draft_params: Any = None,
+        rng_seed: int = 0,
+    ):
+        mode = ecfg.speculative
+        if mode not in ("ngram", "draft"):
+            raise ValueError(f"unknown speculative mode {mode!r}")
+        if ecfg.num_speculative_tokens < 1:
+            raise ValueError("num_speculative_tokens must be >= 1")
+        self.mode = mode
+        self.k = ecfg.num_speculative_tokens
+        self.config = config
+        self.ecfg = ecfg
+        self.ngram: Optional[NGramProposer] = None
+        self.draft: Optional[DraftModelProposer] = None
+        if mode == "ngram":
+            self.ngram = NGramProposer(
+                self.k, ecfg.spec_ngram_max, ecfg.spec_ngram_min
+            )
+        else:
+            if draft_config is None:
+                raise ValueError("speculative=draft needs a draft_config")
+            if draft_config.vocab_size != config.vocab_size:
+                raise ValueError(
+                    "draft model must share the target tokenizer "
+                    f"(vocab {draft_config.vocab_size} != "
+                    f"{config.vocab_size})"
+                )
+            self.draft = DraftModelProposer(
+                draft_config, ecfg, params=draft_params, mesh=mesh,
+                rng_seed=rng_seed + 1,
+            )
+        # acceptance statistics (engine-lifetime)
+        self.proposed_total = 0
+        self.accepted_total = 0
+        self.verify_steps = 0
+        self.reject_events = 0   # verify steps with a mid-batch rejection
+        self.despec_total = 0    # slots handed back to the fused round
+
+    # ------------------------------------------------------------------
+
+    def eligible(self, req: Any) -> bool:
+        """Penalties need the counts histogram advanced per token and
+        logprobs need the lp variant of the step — both stay on the
+        fused decode round."""
+        so = req.sampling_options
+        if req.output_options.logprobs is not None:
+            return False
+        if (so.frequency_penalty or 0.0) != 0.0:
+            return False
+        if (so.presence_penalty or 0.0) != 0.0:
+            return False
+        if (so.repetition_penalty or 1.0) != 1.0:
+            return False
+        return True
+
+    def propose(
+        self, slot: int, history: list[int]
+    ) -> Union[list[int], jnp.ndarray]:
+        if self.ngram is not None:
+            return self.ngram.propose(history)
+        return self.draft.propose(slot, history, self.k)
+
+    def verify(
+        self,
+        params: Any,
+        ctx_kv: Any,
+        tokens: jnp.ndarray,
+        slots: np.ndarray,
+        q_starts: np.ndarray,
+        seq_lens: np.ndarray,
+        keys: np.ndarray,
+        temps: np.ndarray,
+        top_ks: np.ndarray,
+        top_ps: np.ndarray,
+    ):
+        return spec_verify(
+            self.config, params, ctx_kv, tokens,
+            jnp.asarray(slots), jnp.asarray(q_starts),
+            jnp.asarray(seq_lens), jnp.asarray(keys),
+            jnp.asarray(temps), jnp.asarray(top_ks), jnp.asarray(top_ps),
+            self.ecfg.max_top_k, self.ecfg.max_context,
+        )
+
+    # ------------------------------------------------------------------
+
+    def on_result(self, slot: int, hist_len: int, accepted: int) -> None:
+        """One verify step landed: `accepted` of the K proposals matched;
+        the slot's true sequence is hist_len + accepted + 1 tokens (the
+        bonus token is pending, its KV unwritten)."""
+        self.proposed_total += self.k
+        self.accepted_total += accepted
+        self.verify_steps += 1
+        if accepted < self.k:
+            self.reject_events += 1
+        if self.draft is not None:
+            self.draft.truncate(slot, hist_len + accepted)
+
+    def on_despec(self, slot: int) -> None:
+        self.despec_total += 1
+        self.release(slot)
+
+    def release(self, slot: int) -> None:
+        if self.draft is not None:
+            self.draft.release(slot)
+
+    def acceptance_rate(self) -> float:
+        return self.accepted_total / max(self.proposed_total, 1)
+
+    def stats(self) -> dict[str, Any]:
+        return {
+            "mode": self.mode,
+            "k": self.k,
+            "spec_proposed_total": self.proposed_total,
+            "spec_accepted_total": self.accepted_total,
+            "spec_verify_steps": self.verify_steps,
+            "spec_reject_events": self.reject_events,
+            "spec_despec_total": self.despec_total,
+            "spec_acceptance_rate": self.acceptance_rate(),
+        }

@@ -1,0 +1,163 @@
+"""Speculative-token proposers.
+
+Two strategies behind one contract — ``propose(slot, history) -> K
+tokens`` where ``history`` is the request's full committed sequence
+(prompt + emitted output, the pending token last):
+
+  - NGramProposer: model-free prompt-lookup decoding. Matches the tail
+    n-gram of the history against an earlier occurrence and proposes the
+    tokens that followed it. Pure host code, deterministic, zero device
+    cost — wins on repetitive/structured text (code, extraction, long
+    copies) where the continuation literally appears earlier.
+  - DraftModelProposer: a small model sharing the target's tokenizer,
+    run through the EXISTING engine forward (llama.prefill): one
+    catch-up chunk to sync its private ctx region with the slot history,
+    then K greedy single-token steps. The argmax chain stays on device —
+    the proposed [K] array feeds the verifier without a host round trip.
+
+Correctness note: acceptance treats every proposal as a deterministic
+(point-mass) draft, so HOW tokens are proposed never biases the output
+distribution — a bad proposer only lowers the acceptance rate.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dynamo_tpu.engine.config import EngineConfig
+# runtime-safe: the engine imports spec/ lazily inside TpuEngine.__init__,
+# never at module scope, so this import cannot cycle
+from dynamo_tpu.engine.engine import pow2_cover
+from dynamo_tpu.models import llama
+from dynamo_tpu.models.config import ModelConfig
+
+
+class NGramProposer:
+    """Prompt-lookup proposer: propose the continuation of the most
+    recent earlier occurrence of the history's tail n-gram.
+
+    Tries n = max_n .. min_n; for each n, scans for the RIGHTMOST earlier
+    match (recent context predicts better than distant context) within a
+    bounded lookback window — the scan runs on the engine scheduler
+    thread once per verify step, and an unbounded pure-Python sweep over
+    a many-thousand-token history would stall dispatch for every slot
+    exactly on the low-acceptance workloads that match nothing. With no
+    match, proposes zeros — those verify like any other draft and simply
+    get rejected unless the target happens to agree.
+    """
+
+    def __init__(self, k: int, max_n: int = 3, min_n: int = 1,
+                 max_lookback: int = 1024):
+        if k < 1:
+            raise ValueError("num_speculative_tokens must be >= 1")
+        if min_n < 1 or max_n < min_n:
+            raise ValueError("need 1 <= min_n <= max_n")
+        self.k = k
+        self.max_n = max_n
+        self.min_n = min_n
+        self.max_lookback = max_lookback
+
+    def propose(self, history: list[int]) -> list[int]:
+        k = self.k
+        hist = history[-self.max_lookback:]
+        L = len(hist)
+        for n in range(min(self.max_n, L - 1), self.min_n - 1, -1):
+            tail = hist[-n:]
+            for j in range(L - n - 1, -1, -1):
+                if hist[j : j + n] == tail:
+                    cont = hist[j + n : j + n + k]
+                    return cont + [0] * (k - len(cont))
+        return [0] * k
+
+
+class DraftModelProposer:
+    """Draft-model proposer with a private contiguous ctx region.
+
+    The draft shares the target's tokenizer (vocab ids must line up) and
+    runs through ``llama.prefill``: a bucketed catch-up chunk writes the
+    history delta into the slot's draft lane, then K-1 single-token
+    prefills extend it greedily. Rollback after a rejected verify is
+    ``truncate(slot, n)`` — the draft region beyond ``n`` is dead weight
+    that the next catch-up chunk overwrites (attention masks by seq_len,
+    so it is never read meanwhile).
+    """
+
+    def __init__(
+        self,
+        config: ModelConfig,
+        ecfg: EngineConfig,
+        *,
+        params: Any = None,
+        mesh: Optional[jax.sharding.Mesh] = None,
+        rng_seed: int = 0,
+    ):
+        self.config = config
+        self.ecfg = ecfg
+        if params is None:
+            params = llama.init_params(config, rng_seed)
+        ctx = llama.init_ctx(
+            config, ecfg.max_decode_slots, ecfg.max_context,
+            jnp.dtype(ecfg.cache_dtype),
+        )
+        if mesh is not None:
+            params = jax.tree.map(
+                lambda x, s: jax.device_put(x, s),
+                params, llama.param_shardings(config, mesh),
+            )
+            ctx = jax.tree.map(
+                lambda x, s: jax.device_put(x, s),
+                ctx, llama.ctx_shardings(config, mesh),
+            )
+        self.params = params
+        self.ctx = ctx
+        # tokens of the slot's TRUE history whose KV the draft region
+        # holds at [0, pos) — the rollback pointer
+        self.pos = np.zeros(ecfg.max_decode_slots, np.int64)
+
+    def propose(self, slot: int, history: list[int], k: int) -> jnp.ndarray:
+        """Draft k continuation tokens for ``history`` (pending token
+        last). Returns a DEVICE [k] i32 array — no host sync; the caller
+        splices it straight into the verify batch."""
+        start = int(self.pos[slot])
+        chunk = history[start:]
+        assert chunk, "history must extend past the draft position"
+        # clamp the pow2 padding to the region end: a padded width that
+        # overflows would make prefill's dynamic_update_slice CLAMP the
+        # write start, silently shifting real KV onto earlier rows (the
+        # chunk itself always fits — the engine despeculates before the
+        # history can outgrow the region)
+        w = min(pow2_cover(len(chunk), 8), self.ecfg.max_context - start)
+        toks = np.zeros(w, np.int32)
+        toks[: len(chunk)] = chunk
+        self.ctx, logits = llama.prefill(
+            self.config, self.params, self.ctx,
+            jnp.asarray(toks), jnp.int32(slot),
+            jnp.int32(start), jnp.int32(len(history)),
+        )
+        drafted = [jnp.argmax(logits).astype(jnp.int32)]
+        pos = len(history)
+        for _ in range(k - 1):
+            self.ctx, logits = llama.prefill(
+                self.config, self.params, self.ctx,
+                drafted[-1][None], jnp.int32(slot),
+                jnp.int32(pos), jnp.int32(pos + 1),
+            )
+            drafted.append(jnp.argmax(logits).astype(jnp.int32))
+            pos += 1
+        # KV written: history plus drafted[:-1] (the last draft is never
+        # fed back, so its KV was never computed)
+        self.pos[slot] = len(history) + k - 1
+        return jnp.stack(drafted)
+
+    def truncate(self, slot: int, n_valid: int) -> None:
+        """Rollback after verification: only the first ``n_valid`` tokens
+        of the slot's draft KV match the true sequence."""
+        self.pos[slot] = min(int(self.pos[slot]), n_valid)
+
+    def release(self, slot: int) -> None:
+        """Slot freed/reused: the draft region content belongs to a dead
+        request — restart from scratch."""
+        self.pos[slot] = 0

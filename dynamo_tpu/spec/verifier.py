@@ -1,0 +1,146 @@
+"""Fused on-device speculative verification.
+
+One jit per (batch width, K) pair: the target model scores the pending
+token plus the K proposed tokens for every speculating slot in a single
+chunked-prefill-shaped forward (llama.batch_score_impl), then acceptance
+runs on device and only THREE small arrays come back to the host —
+accepted tokens [B, K+1], counts [B], and the advanced PRNG keys [B, 2].
+Logits never leave HBM (the same discipline as engine sampling).
+
+Acceptance semantics (toks[0] is the pending token, toks[1:] the
+proposals; logits row t scores the token following toks[t]):
+
+  greedy (temp<=0)   longest-prefix match against the raw-logit argmax;
+                     the bonus token is the argmax of the first
+                     mismatching row — exactly what non-speculative
+                     greedy decoding would have produced, so output is
+                     token-identical by construction.
+  sampled (temp>0)   rejection sampling against the TARGET distribution
+                     (same temperature/top-k/top-p masking as
+                     sampling.sample_step_impl). Proposals are treated
+                     as deterministic (point-mass) drafts: accept d with
+                     probability p(d); on rejection, resample from the
+                     leftover distribution — p with d masked out,
+                     renormalized — which makes every emitted token an
+                     exact sample from p regardless of the proposer.
+                     Draws consume the slot's SamplerState PRNG key
+                     stream, so seeded requests stay reproducible.
+
+Slots with frequency/presence/repetition penalties are gated OFF
+speculation by the scheduler (the counts histogram would have to advance
+token-by-token inside the accept loop); they decode on the normal fused
+round instead.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from dynamo_tpu.engine.sampling import NEG_INF
+from dynamo_tpu.models import llama
+
+
+def accept_tokens(
+    logits: jnp.ndarray,   # [K+1, V] f32 raw target logits
+    toks: jnp.ndarray,     # [K+1] i32 — pending token, then K proposals
+    key: jnp.ndarray,      # [2] uint32 — the slot's PRNG key
+    temp: jnp.ndarray,     # scalar f32; <=0 greedy
+    top_k: jnp.ndarray,    # scalar i32; 0 disables
+    top_p: jnp.ndarray,    # scalar f32; 1.0 disables
+    *,
+    max_top_k: int,
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Per-slot acceptance (vmapped by spec_verify). Returns
+    (out_tokens [K+1], n_out scalar, new_key [2]): out_tokens[:n_out] are
+    the emitted tokens — the accepted proposal prefix plus one bonus."""
+    T = logits.shape[0]
+    K = T - 1
+    proposed = toks[1:]                                          # [K]
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)       # [K+1]
+    match_g = proposed == greedy[:K]
+
+    # target distribution per row — the same masking order as
+    # sample_step_impl (top-k lanes, temperature scale, nucleus mask)
+    temps = jnp.maximum(temp, 1e-6)
+    vals, idxs = jax.lax.top_k(logits, max_top_k)                # [K+1, Kt]
+    scaled = vals / temps
+    pos = jnp.arange(max_top_k)[None, :]
+    k_eff = jnp.where(top_k <= 0, max_top_k, top_k)
+    mask_k = pos < jnp.minimum(k_eff, max_top_k)
+    probs = jax.nn.softmax(jnp.where(mask_k, scaled, NEG_INF), axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    mask_p = (cum - probs) < top_p
+    final_mask = mask_k & mask_p
+    p = jax.nn.softmax(jnp.where(final_mask, scaled, NEG_INF), axis=-1)
+
+    base = jax.random.wrap_key_data(key, impl="threefry2x32")
+    new_key, sub = jax.random.split(base)
+    subs = jax.random.split(sub, K + 1)
+    # accept proposal i with probability p_i(proposed_i); a proposal
+    # outside the masked support has p=0 and always rejects
+    lane_hit = (idxs[:K] == proposed[:, None]) & final_mask[:K]
+    p_prop = jnp.sum(jnp.where(lane_hit, p[:K], 0.0), axis=-1)   # [K]
+    u = jax.vmap(jax.random.uniform)(subs[:K])
+    match_s = u < p_prop
+
+    match = jnp.where(temp <= 0.0, match_g, match_s)
+    a = jnp.sum(jnp.cumprod(match.astype(jnp.int32)))            # 0..K
+
+    # bonus from row `a`: greedy argmax, or leftover-distribution
+    # resample (row a's dist with the rejected proposal masked; when
+    # a == K nothing was rejected and prop_pad[K] = -1 masks no lane)
+    prop_pad = jnp.concatenate(
+        [proposed, jnp.full((1,), -1, jnp.int32)]
+    )
+    row_scaled = jnp.take(
+        jnp.where(final_mask, scaled, NEG_INF), a, axis=0
+    )
+    row_idxs = jnp.take(idxs, a, axis=0)
+    row_final = jnp.where(row_idxs == prop_pad[a], NEG_INF, row_scaled)
+    choice = jax.random.categorical(subs[K], row_final)
+    bonus_s = row_idxs[choice].astype(jnp.int32)
+    bonus = jnp.where(temp <= 0.0, jnp.take(greedy, a), bonus_s)
+
+    idx = jnp.arange(T)
+    out = jnp.where(
+        idx < a,
+        jnp.concatenate([proposed, jnp.zeros((1,), jnp.int32)]),
+        jnp.where(idx == a, bonus, 0),
+    ).astype(jnp.int32)
+    return out, a + 1, jax.random.key_data(new_key)
+
+
+@functools.partial(jax.jit, static_argnums=(0, 11, 12),
+                   donate_argnums=(2,))
+def spec_verify(
+    config,                 # ModelConfig (static)
+    params,
+    ctx_kv,
+    tokens: jnp.ndarray,    # [B, K+1] i32 — col 0 pending, cols 1: proposed
+    slots: jnp.ndarray,     # [B] i32 (dummies -> scratch lane B)
+    q_starts: jnp.ndarray,  # [B] i32 — region KV length per slot
+    seq_lens: jnp.ndarray,  # [B] i32 — q_start + K + 1 live, 0 dummy
+    keys: jnp.ndarray,      # [B, 2] uint32 per-slot PRNG keys
+    temps: jnp.ndarray,     # [B] f32
+    top_ks: jnp.ndarray,    # [B] i32
+    top_ps: jnp.ndarray,    # [B] f32
+    max_top_k: int,         # static
+    ctx_span: int,          # static — full region window (q_starts > 0)
+):
+    """Score + accept for every speculating slot in one program.
+
+    Returns (ctx_kv, out_tokens [B, K+1], n_out [B], new_keys [B, 2]).
+    The forward optimistically writes all K+1 KV rows into each slot's
+    region at [q_start, q_start+K+1); the host commits only the first
+    n_out-1 proposals + pending (rollback = pointer truncation, see
+    llama.batch_score_impl).
+    """
+    ctx_kv, logits = llama.batch_score_impl(
+        config, params, ctx_kv, tokens, slots, q_starts, seq_lens, ctx_span
+    )
+    out, n_out, new_keys = jax.vmap(
+        functools.partial(accept_tokens, max_top_k=max_top_k)
+    )(logits, tokens, keys, temps, top_ks, top_ps)
+    return ctx_kv, out, n_out, new_keys

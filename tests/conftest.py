@@ -37,6 +37,11 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "asyncio_timeout(seconds): override the 120s default"
     )
+    config.addinivalue_line(
+        "markers",
+        "slow: needs a real multi-layer model / long wall time — "
+        "excluded from the tier-1 run (-m 'not slow')",
+    )
 
 
 def pytest_collection_modifyitems(items):
