@@ -392,7 +392,14 @@ async def _run_spec_phase() -> dict:
     engine and a plain one and reports accepted-tokens-per-verify-step
     plus the tok/s ratio. Greedy speculation is output-identical by
     construction (tests/test_spec.py), so the speedup is free quality-
-    wise whenever acceptance pays for the verify forwards."""
+    wise whenever acceptance pays for the verify forwards.
+
+    Also A/Bs DRAFT-model speculation with batched cross-slot drafting
+    (one llama.batch_draft program per round) against the legacy
+    per-slot dispatch loop (O(slots*K) programs per round): the tok/s
+    ratio and draft-dispatches-per-emitted-token for both land in the
+    bench JSON, so host-dispatch-overhead regressions on the drafting
+    path are visible round over round."""
     import numpy as np
 
     from dynamo_tpu.engine.config import EngineConfig
@@ -413,6 +420,7 @@ async def _run_spec_phase() -> dict:
             cache_dtype="float32",
         )
         n_req, isl, osl = 8, 96, 48
+        draft_cfg = cfg  # draft == target: near-total acceptance
     else:
         cfg = ModelConfig.llama3_1b()
         ecfg_kw = dict(
@@ -422,6 +430,10 @@ async def _run_spec_phase() -> dict:
             prefill_chunks_per_round=8,
         )
         n_req, isl, osl = 8, 192, 128
+        # a toy draft sharing the target vocab: acceptance is noise
+        # (random weights), but the batched-vs-per-slot DISPATCH cost
+        # comparison is exactly what this phase tracks
+        draft_cfg = ModelConfig.tiny(vocab_size=cfg.vocab_size)
     k = int(os.environ.get("DYNAMO_BENCH_SPEC_K", 4))
     rng = np.random.RandomState(0)
     # repetitive prompts: a short random cycle repeated to ISL — the
@@ -432,12 +444,22 @@ async def _run_spec_phase() -> dict:
         pat = rng.randint(1, cfg.vocab_size, 16).tolist()
         prompts.append((pat * (isl // 16 + 1))[:isl])
 
-    async def measure(speculative: str):
+    async def measure(speculative: str, *, draft=False, batch_draft=True,
+                      out_len=osl):
+        ekw = {}
+        if draft:
+            from dynamo_tpu.models import llama as _llama
+
+            ekw = dict(
+                draft_config=draft_cfg,
+                draft_params=_llama.init_params(draft_cfg, 0),
+            )
         eng = TpuEngine(
             cfg,
             EngineConfig(**ecfg_kw, speculative=speculative,
-                         num_speculative_tokens=k),
-            mesh_config=MeshConfig(tp=1),
+                         num_speculative_tokens=k,
+                         spec_batch_draft=batch_draft),
+            mesh_config=MeshConfig(tp=1), **ekw,
         )
         eng.start()
 
@@ -452,21 +474,21 @@ async def _run_spec_phase() -> dict:
                 n += len(out.token_ids)
             return n
 
-        # warmup compiles (prefill buckets, decode round / verify)
+        # warmup compiles (prefill buckets, decode round / draft / verify)
         await asyncio.gather(*[one(p, 8) for p in prompts[:2]])
         t0 = time.monotonic()
         tokens = sum(await asyncio.gather(
-            *[one(p, osl) for p in prompts]
+            *[one(p, out_len) for p in prompts]
         ))
         wall = time.monotonic() - t0
         stats = eng.spec.stats() if eng.spec else None
         await eng.stop()
-        return tokens / wall, stats
+        return tokens / wall, stats, tokens
 
-    base_tok_s, _ = await measure("off")
-    spec_tok_s, st = await measure("ngram")
+    base_tok_s, _, _ = await measure("off")
+    spec_tok_s, st, sp_toks = await measure("ngram")
     steps = max(st["spec_verify_steps"], 1)
-    return {
+    out = {
         "spec_decode_tok_s": round(spec_tok_s, 2),
         "spec_baseline_tok_s": round(base_tok_s, 2),
         "spec_speedup": round(spec_tok_s / base_tok_s, 3),
@@ -476,7 +498,32 @@ async def _run_spec_phase() -> dict:
         ),
         "spec_acceptance_rate": round(st["spec_acceptance_rate"], 4),
         "spec_k": k,
+        "spec_adaptive": st.get("spec_adaptive", False),
+        "spec_verify_dispatches_per_token": round(
+            st["spec_verify_dispatch_total"] / max(sp_toks, 1), 4
+        ),
     }
+    # draft-model drafting: batched (one program/round) vs per-slot
+    # (O(slots*K) programs/round) — shorter outputs, this is a dispatch-
+    # overhead A/B, not a quality phase
+    d_osl = max(osl // 2, 16)
+    bat_tok_s, bst, b_toks = await measure(
+        "draft", draft=True, batch_draft=True, out_len=d_osl)
+    per_tok_s, pst, p_toks = await measure(
+        "draft", draft=True, batch_draft=False, out_len=d_osl)
+    out.update({
+        "spec_draft_batched_tok_s": round(bat_tok_s, 2),
+        "spec_draft_per_slot_tok_s": round(per_tok_s, 2),
+        "spec_draft_batch_speedup": round(
+            bat_tok_s / per_tok_s, 3) if per_tok_s else None,
+        "spec_draft_dispatches_per_token": round(
+            bst["spec_draft_dispatch_total"] / max(b_toks, 1), 4
+        ),
+        "spec_draft_per_slot_dispatches_per_token": round(
+            pst["spec_draft_dispatch_total"] / max(p_toks, 1), 4
+        ),
+    })
+    return out
 
 
 def _extra_phase(fields_prefix: str, fn, out: dict,

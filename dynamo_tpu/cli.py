@@ -5,8 +5,9 @@ Subcommands:
   run   serve a graph: in=<http|text|stdin|batch:FILE|endpoint> out=<echo|mocker|tpu>
         (distributed mode: --control-plane HOST:PORT; workers use
          in=endpoint, frontends in=http discover models dynamically;
-         out=tpu takes --speculative {off,ngram,draft} and
-         --num-speculative-tokens K for speculative decoding)
+         out=tpu takes --speculative {off,ngram,draft},
+         --num-speculative-tokens K, and --spec-adaptive {on,off} /
+         --spec-min-k for acceptance-adaptive speculative decoding)
   cp    run the control-plane store (native dcp-server if built, else the
         wire-compatible Python fallback): cp --port 7111
   serve    launch a whole serving graph (store+workers+frontend) from a

@@ -48,6 +48,9 @@ class RuntimeConfig:
     # speculative decoding (dynamo_tpu/spec/): off | ngram | draft
     speculative: str = "off"
     num_speculative_tokens: int = 4
+    # acceptance-adaptive K (per-slot effective K in [spec_min_k, K])
+    spec_adaptive: bool = True
+    spec_min_k: int = 1
 
     @property
     def store_host_port(self) -> tuple[str, int]:

@@ -10,6 +10,18 @@ def _default_buckets() -> tuple[int, ...]:
     return (128, 256, 512, 1024, 2048, 4096)
 
 
+def pow2_cover(n: int, lo: int = 1) -> int:
+    """Smallest power of two >= max(n, lo) — the compile-cache bucketing
+    used for page-table widths, transfer sizes, and the speculative round
+    width (padding always targets scratch page 0 / the scratch lane).
+    Lives here (not engine.py) so spec/ can use it without a
+    module-scope import of the engine."""
+    w = lo
+    while w < n:
+        w *= 2
+    return w
+
+
 @dataclass
 class EngineConfig:
     """Knobs of the continuous-batching TPU engine."""
@@ -62,9 +74,31 @@ class EngineConfig:
     # slots (no penalties/logprobs) verify K proposed tokens per target
     # forward instead of taking the fused decode round.
     speculative: str = "off"
-    num_speculative_tokens: int = 4   # K proposals per verify step
+    num_speculative_tokens: int = 4   # K proposals per verify step (the CAP
+                                      # when spec_adaptive is on)
     spec_ngram_max: int = 3           # longest tail n-gram to match
     spec_ngram_min: int = 1
+    # acceptance-adaptive K (spec/decoder.py AdaptiveKController): each
+    # slot's effective K walks within [spec_min_k, num_speculative_tokens]
+    # on an EWMA of its per-step acceptance fraction — grow above
+    # grow_threshold, shrink below shrink_threshold; a slot whose rate
+    # stays at/below despec_threshold after spec_min_observations verify
+    # steps de-speculates back to the fused decode round (speculation is
+    # actively costing it a full forward per ~1 emitted token there).
+    # The round's draft/verify width is the bucketed max of the
+    # participants' effective K, so an all-low-acceptance batch really
+    # does less device work per round.
+    spec_adaptive: bool = True
+    spec_min_k: int = 1
+    spec_grow_threshold: float = 0.8
+    spec_shrink_threshold: float = 0.4
+    spec_despec_threshold: float = 0.125
+    spec_rate_ewma: float = 0.75      # weight of history in the rolling rate
+    spec_min_observations: int = 8    # verify steps before despec may fire
+    # fuse draft proposing across slots into ONE llama.batch_draft program
+    # per round (False = legacy per-slot dispatch loop, kept for A/B
+    # dispatch-overhead measurement in bench/profile_round)
+    spec_batch_draft: bool = True
 
     # prefix cache
     enable_prefix_caching: bool = True

@@ -58,7 +58,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from dynamo_tpu.engine.cache import PageAllocator
-from dynamo_tpu.engine.config import EngineConfig
+from dynamo_tpu.engine.config import EngineConfig, pow2_cover  # noqa: F401
+# (pow2_cover re-exported: engine.engine was its historical home)
 from dynamo_tpu.engine import sampling
 from dynamo_tpu.kv_router.protocols import (
     ForwardPassMetrics,
@@ -81,14 +82,6 @@ log = logging.getLogger(__name__)
 _FIRST_TOKEN_KEY_TAG = 0x46697273  # distinct PRNG stream for first tokens
 
 
-def pow2_cover(n: int, lo: int = 1) -> int:
-    """Smallest power of two >= max(n, lo) — the compile-cache bucketing
-    used for page-table widths and transfer sizes (padding always targets
-    scratch page 0)."""
-    w = lo
-    while w < n:
-        w *= 2
-    return w
 
 
 @dataclass
@@ -136,7 +129,14 @@ class _Request:
         return min(mt, cap) if mt is not None else cap
 
     def emit(self, item: LLMEngineOutput | Exception) -> None:
-        self.loop.call_soon_threadsafe(self.out.put_nowait, item)
+        # the client's event loop can be gone by the time the engine
+        # thread flushes (interpreter/test teardown, _fail_all during
+        # shutdown) — a raise here would mask the ORIGINAL engine
+        # failure with "RuntimeError: Event loop is closed"
+        try:
+            self.loop.call_soon_threadsafe(self.out.put_nowait, item)
+        except RuntimeError:
+            log.debug("dropped emit to a closed event loop (shutdown)")
 
 
 @dataclass
@@ -754,6 +754,15 @@ class TpuEngine:
                 spec_acceptance_rate=(
                     self.spec.acceptance_rate() if self.spec else 0.0
                 ),
+                # mean adaptive K over currently-speculating slots — the
+                # planner-facing signal for how deep speculation is
+                # actually running (0 when off / nothing speculates)
+                spec_effective_k=(
+                    self.spec.effective_k_mean([
+                        i for i, s in enumerate(self._slots)
+                        if s is not None and s.spec
+                    ]) if self.spec else 0.0
+                ),
             ),
             kv_stats=KvStats(
                 kv_active_blocks=a.active_pages,
@@ -787,7 +796,12 @@ class TpuEngine:
                 did_work = self._round()
             except Exception:  # noqa: BLE001 — engine loop must survive
                 log.exception("engine round failed")
-                self._fail_all(RuntimeError("engine step failed; see logs"))
+                try:
+                    self._fail_all(
+                        RuntimeError("engine step failed; see logs")
+                    )
+                except Exception:  # noqa: BLE001 — never mask the root cause
+                    log.exception("fail_all cleanup itself failed")
                 did_work = False
             if not did_work:
                 try:
@@ -906,7 +920,16 @@ class TpuEngine:
             _Entry(
                 kind="round",
                 handle=stacked,
-                slots=list(self._slots),
+                # snapshot EXCLUDES speculating slots: their device lanes
+                # are parked, so their columns in this round's stacked
+                # tokens are garbage — advancing them from here would
+                # corrupt the verify-driven history (the slot's spec flag
+                # may flip by the time the fetch lands, so the filter
+                # must happen at dispatch time, not at processing)
+                slots=[
+                    (r if r is None or not r.spec else None)
+                    for r in self._slots
+                ],
                 n_steps=n,
                 lp_handle=lp_stacked,
             )
@@ -948,15 +971,24 @@ class TpuEngine:
     # ---- speculative decoding (spec/): propose -> fused verify ----
 
     def _dispatch_spec(self) -> bool:
-        """Collect spec-ready slots, propose K tokens each, dispatch ONE
-        fused score+accept program (static width B; dummy rows target the
-        scratch lane). The verify optimistically writes K+1 KV rows per
-        slot; the host later commits only the accepted prefix — rollback
-        is pointer truncation because attention masks by sequence length
-        and the next write over the lane overwrites the dead span.
-        Returns True if anything was dispatched."""
+        """Collect spec-ready slots, draft K tokens for ALL of them in at
+        most ONE device dispatch (llama.batch_draft / host n-gram lookup),
+        and dispatch ONE fused score+accept program (static width B; dummy
+        rows target the scratch lane) — O(1) device dispatches per round
+        in the number of speculating slots AND in K (the draft steps run
+        inside a fori_loop). The verify optimistically writes K+1 KV rows
+        per slot; the host later commits only the accepted prefix —
+        rollback is pointer truncation because attention masks by
+        sequence length and the next write over the lane overwrites the
+        dead span.
+
+        K here is the ROUND width: the bucketed max of the participants'
+        per-slot effective K (acceptance-adaptive; spec/decoder.py) —
+        when every participant's acceptance sags, the whole round
+        shrinks. Returns True if anything was dispatched.
+        """
         e = self.ecfg
-        K = self.spec.k
+        K_cap = self.spec.k
         ready = [
             (i, r) for i, r in enumerate(self._slots)
             if r is not None and r.spec and r.spec_ready
@@ -964,20 +996,22 @@ class TpuEngine:
         ]
         if not ready:
             return False
-        rows: list[tuple[int, _Request, int]] = []
+        rows: list[tuple[int, _Request, int, int]] = []
         dispatched = False
         for slot, r in ready:
             n_hist = len(r.spec_tokens)
-            # the verify writes K+1 rows at [N, N+K+1); when that no
-            # longer fits the region, hand the slot back to the fused
-            # decode round for its final tokens
-            if (n_hist - 1) + K + 1 > e.max_context:
+            # the verify writes up to K_cap+1 rows at [N, N+K+1); when
+            # that no longer fits the region, hand the slot back to the
+            # fused decode round for its final tokens (checked against
+            # the CAP, not the round K — the round width isn't known yet)
+            if (n_hist - 1) + K_cap + 1 > e.max_context:
                 self._despeculate(slot, r)
                 dispatched = True
                 continue
-            rows.append((slot, r, n_hist))
+            rows.append((slot, r, n_hist, self.spec.k_for(slot)))
         if not rows:
             return dispatched
+        K = self.spec.round_k([k for *_, k in rows])
         B = self._B
         toks = np.zeros((B, K + 1), np.int32)
         slots_a = np.full(B, B, np.int32)     # dummies -> scratch lane
@@ -987,8 +1021,7 @@ class TpuEngine:
         temps = np.zeros(B, np.float32)
         top_ks = np.zeros(B, np.int32)
         top_ps = np.ones(B, np.float32)
-        draft_rows: list[tuple[int, Any]] = []
-        for j, (slot, r, n_hist) in enumerate(rows):
+        for j, (slot, r, n_hist, _k) in enumerate(rows):
             toks[j, 0] = r.spec_tokens[-1]    # pending token
             slots_a[j] = slot
             q_starts[j] = n_hist - 1
@@ -998,21 +1031,29 @@ class TpuEngine:
             temps[j] = so.temperature or 0.0
             top_ks[j] = so.top_k or 0
             top_ps[j] = so.top_p if so.top_p is not None else 1.0
-            proposal = self.spec.propose(slot, r.spec_tokens)
-            if isinstance(proposal, list):    # n-gram: host tokens
-                toks[j, 1:] = proposal
-            else:                             # draft: device [K], no sync
-                draft_rows.append((j, proposal))
-        toks_dev = jnp.asarray(toks)
-        for j, prop in draft_rows:
-            toks_dev = toks_dev.at[j, 1:].set(prop)
+        drafted = None
+        if self.spec.draft is not None and e.spec_batch_draft:
+            # ONE multi-slot multi-token draft program; the [B, K] device
+            # result splices into the verify tokens INSIDE the verify jit
+            drafted = self.spec.propose_batch(
+                [(slot, r.spec_tokens) for slot, r, _, _ in rows], B, K,
+            )
+        else:
+            for j, (slot, r, _n, _k) in enumerate(rows):
+                proposal = self.spec.propose(slot, r.spec_tokens, K)
+                if isinstance(proposal, list):    # n-gram: host tokens
+                    toks[j, 1:] = proposal
+                else:          # legacy per-slot draft: device [K], no sync
+                    if drafted is None:
+                        drafted = jnp.zeros((B, K), jnp.int32)
+                    drafted = drafted.at[j].set(proposal)
         self.ctx, out_toks, n_out, new_keys = self.spec.verify(
-            self.params, self.ctx, toks_dev, slots_a, q_starts,
-            seq_lens, keys, temps, top_ks, top_ps,
+            self.params, self.ctx, jnp.asarray(toks), drafted, slots_a,
+            q_starts, seq_lens, keys, temps, top_ks, top_ps,
         )
         for arr in (out_toks, n_out, new_keys):
             arr.copy_to_host_async()
-        for slot, r, _ in rows:
+        for slot, r, _, _ in rows:
             r.spec_ready = False
             r.spec_inflight = True
         self._entries.append(_Entry(
@@ -1044,11 +1085,21 @@ class TpuEngine:
     def _process_spec(self, entry: _Entry) -> None:
         """Consume one verify result: emit the accepted prefix + bonus
         token per slot, advance host history and PRNG keys, roll the
-        draft model's KV pointer back to the accepted length."""
+        draft model's KV pointer back to the accepted length.
+
+        Adaptive K lands here: every verified token is emitted (the
+        round already paid the forward for the full bucketed-max width —
+        discarding accepted tokens would waste exactly the mixed-K
+        rounds the controller creates), acceptance is accounted at the
+        ROUND width, the rolling rate updates, and a slot whose rate
+        collapsed is handed back to the fused decode round instead of
+        re-arming. Per-slot effective K shapes the NEXT round's width
+        vote, not this round's emission."""
         out = np.asarray(entry.handle)          # [B, K+1]
         n_out_arr = np.asarray(entry.aux[0])    # [B]
         new_keys = np.asarray(entry.aux[1])     # [B, 2]
-        for j, (slot, r, hist_len) in enumerate(entry.rows):
+        k_round = entry.n_steps
+        for j, (slot, r, hist_len, _k_eff) in enumerate(entry.rows):
             r.spec_inflight = False
             if r.finished or self._slots[slot] is not r:
                 continue
@@ -1057,8 +1108,8 @@ class TpuEngine:
                 continue
             n = int(n_out_arr[j])
             accepted = n - 1
-            self.spec.on_result(slot, hist_len, accepted)
-            r.spec_proposed += self.spec.k
+            self.spec.on_result(slot, hist_len, accepted, k_round)
+            r.spec_proposed += k_round
             r.spec_accepted += accepted
             toks = [int(t) for t in out[j, :n]]
             batch: list[int] = []
@@ -1084,6 +1135,13 @@ class TpuEngine:
                 continue
             r.spec_tokens.extend(toks)  # accepted + bonus, all emitted
             r.spec_keys = new_keys[j]
+            if self.spec.should_despec(slot):
+                # acceptance collapsed: every verify here costs a full
+                # forward for ~1 emitted token — strictly worse than the
+                # fused round. Token-identical continuation, like the
+                # context-limit despec.
+                self._despeculate(slot, r)
+                continue
             r.spec_ready = True
             self._ctx_disp[slot] = len(r.spec_tokens)
 
@@ -1427,11 +1485,25 @@ class TpuEngine:
         matchable = hashes[: max(0, (len(prompt) - 1) // ps)]
         matched_pages = self.allocator.match_prefix(matchable)
         matched_pages = self._onboard_from_host(matchable, matched_pages)
-        r.matched_blocks = len(matched_pages)
-        if matched_pages:
-            w = pow2_cover(len(matched_pages))
+        # a matched/onboarded run longer than the ctx region cannot be
+        # loaded (and the pow2 PADDING below can overflow the region even
+        # when the real run fits — load_ctx_pages clamps that statically;
+        # BENCH_r05: 46 matched pages padded to 64 vs a 52-page region).
+        # Drop overflow pages rather than failing the engine round; their
+        # refs are released with the rest after the load dispatch.
+        max_blocks = self.ecfg.max_context // ps
+        usable_pages = matched_pages[:max_blocks]
+        if len(matched_pages) > max_blocks:
+            log.warning(
+                "matched prefix run (%d pages) exceeds the ctx region "
+                "(%d pages); dropping overflow",
+                len(matched_pages), max_blocks,
+            )
+        r.matched_blocks = len(usable_pages)
+        if usable_pages:
+            w = pow2_cover(len(usable_pages))
             padded = np.zeros(w, np.int32)  # padding -> scratch page 0
-            padded[: len(matched_pages)] = matched_pages
+            padded[: len(usable_pages)] = usable_pages
             if self.on_dispatch is not None:
                 self.on_dispatch("load_ctx", {
                     "slot": slot, "pages": padded.tolist(),
@@ -1440,9 +1512,11 @@ class TpuEngine:
                 self.ctx, self.cache, jnp.int32(slot),
                 jnp.asarray(padded),
             )
-            # copy dispatched — device order lets us drop the refs now
+        if matched_pages:
+            # copy dispatched (if any) — device order lets us drop the
+            # refs now (all matched refs, including dropped overflow)
             self.allocator.free(matched_pages)
-        r.prefill_pos = len(matched_pages) * ps
+        r.prefill_pos = len(usable_pages) * ps
 
     def _prefill_step(self, r: _Request) -> str:
         """Advance one prefill chunk; on the final chunk, sample the first

@@ -80,6 +80,10 @@ class WorkerStats:
     spec_proposed_total: int = 0
     spec_accepted_total: int = 0
     spec_acceptance_rate: float = 0.0
+    # mean acceptance-adaptive effective K over currently-speculating
+    # slots (0 when speculation is off or nothing speculates) — how deep
+    # speculation is actually running vs the configured cap
+    spec_effective_k: float = 0.0
 
 
 @dataclass

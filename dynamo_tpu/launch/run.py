@@ -86,11 +86,24 @@ def build_parser() -> argparse.ArgumentParser:
                         "tokens per target forward")
     p.add_argument("--num-speculative-tokens", type=int,
                    default=cfg.num_speculative_tokens,
-                   help="K: proposed tokens per verify step")
+                   help="K: proposed tokens per verify step (the cap "
+                        "when --spec-adaptive is on)")
+    p.add_argument("--spec-adaptive",
+                   default="on" if cfg.spec_adaptive else "off",
+                   choices=["on", "off"],
+                   help="acceptance-adaptive K: each slot's effective K "
+                        "walks within [--spec-min-k, K] on its rolling "
+                        "acceptance rate, and slots whose rate collapses "
+                        "de-speculate back to the fused decode round "
+                        "(exported as dynamo_spec_effective_k)")
+    p.add_argument("--spec-min-k", type=int, default=cfg.spec_min_k,
+                   help="adaptive-K floor per slot")
     p.add_argument("--draft-model-config", default=None,
                    help="canned ModelConfig name for the draft model "
                         "(speculative=draft; must share the target "
-                        "vocab, e.g. tiny for --model-config tiny)")
+                        "vocab, e.g. tiny for --model-config tiny). "
+                        "Drafting is batched across speculating slots "
+                        "into one device program per round")
     # distributed mode (reference: etcd/NATS endpoints; here the dcp store).
     # --control-plane default stays None (it's the discovery-mode switch);
     # RuntimeConfig.control_plane is None unless the config file or
@@ -415,6 +428,8 @@ def build_chain(args) -> "Any":
             disk_offload_path=args.disk_offload_path,
             speculative=args.speculative,
             num_speculative_tokens=args.num_speculative_tokens,
+            spec_adaptive=args.spec_adaptive == "on",
+            spec_min_k=args.spec_min_k,
         )
         draft_cfg = None
         if args.speculative == "draft":

@@ -11,6 +11,7 @@ Exposed (all labelled by worker):
   dynamo_kv_active_blocks / total_blocks / usage_perc / hit_rate
   dynamo_kv_host_blocks / host_onboard_hits
   dynamo_spec_proposed_total / accepted_total / acceptance_rate
+  dynamo_spec_effective_k (mean adaptive K over speculating slots)
 Run: ``dynamo-tpu metrics --control-plane HOST:PORT --port 9090``.
 """
 from __future__ import annotations
@@ -123,6 +124,10 @@ class MetricsExporter:
         gauge("dynamo_spec_acceptance_rate",
               "rolling speculative acceptance rate",
               {w: m.worker_stats.spec_acceptance_rate
+               for w, m in snap.metrics.items()})
+        gauge("dynamo_spec_effective_k",
+              "mean acceptance-adaptive effective K over speculating slots",
+              {w: m.worker_stats.spec_effective_k
                for w, m in snap.metrics.items()})
         lines.append(f"dynamo_metrics_workers {len(snap.metrics)}")
         return "\n".join(lines) + "\n"

@@ -656,6 +656,74 @@ def batch_score_impl(
     return ctx_kv, _logits(config, params, h)
 
 
+def batch_draft_impl(
+    config: ModelConfig,
+    params: Params,
+    ctx_kv: Cache,
+    tokens: jnp.ndarray,    # [B, T] i32 — per-slot history catch-up chunk
+    slots: jnp.ndarray,     # [B] i32 (dummies -> scratch lane)
+    q_starts: jnp.ndarray,  # [B] i32 — draft KV already in each region
+    seq_lens: jnp.ndarray,  # [B] i32 — q_start + chunk for live rows, 0 dummy
+    ctx_span: int,          # STATIC prior-context window
+    k: int,                 # STATIC draft depth
+) -> tuple[Cache, jnp.ndarray]:
+    """Draft ``k`` greedy continuation tokens for EVERY speculating slot
+    in ONE program: the catch-up chunk (the tokens accepted since the
+    slot's last draft) runs as a batch_prefill-shaped forward, then a
+    ``lax.fori_loop`` runs k-1 single-token batched steps with argmax
+    feedback entirely on device — the cross-slot fusion of what
+    DraftModelProposer.propose dispatched as 1 + (k-1) programs PER SLOT.
+    Returns (ctx_kv, drafted [B, k] i32); nothing touches the host.
+
+    KV bookkeeping matches the per-slot path: the catch-up chunk lands at
+    [q_start, seq_len), draft step s writes at seq_len + s, and the last
+    drafted token's KV is never computed (it is never fed back). Rollback
+    stays pointer truncation. Dummy rows (seq_len 0) write the scratch
+    lane at position 0 and are masked out of attention and MoE routing.
+    """
+    B, T = tokens.shape
+    ks, vs, h = _batch_forward(
+        config, params, ctx_kv, tokens, slots, q_starts, seq_lens, ctx_span
+    )
+    ctx_kv = _write_chunks(ctx_kv, ks, vs, slots, q_starts)
+    last = jnp.maximum(seq_lens - q_starts - 1, 0)
+    h_last = jnp.take_along_axis(h, last[:, None, None], axis=1)[:, 0]
+    logits = _logits(config, params, h_last)
+    drafted = jnp.zeros((B, k), jnp.int32)
+    drafted = drafted.at[:, 0].set(
+        jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    )
+    if k == 1:
+        return ctx_kv, drafted
+    live = seq_lens > 0
+
+    def body(s, carry):
+        ctx_kv, drafted = carry
+        toks_s = jax.lax.dynamic_slice_in_dim(drafted, s, 1, axis=1)
+        # dummy rows stay pinned at (pos 0, seq_len 0): their garbage
+        # writes target scratch row 0 and attention masks them entirely
+        pos = jnp.where(live, seq_lens + s, 0)
+        sl = jnp.where(live, pos + 1, 0)
+        ks, vs, h = _batch_forward(
+            config, params, ctx_kv, toks_s, slots, pos, sl, ctx_span
+        )
+        ctx_kv = _write_chunks(ctx_kv, ks, vs, slots, pos)
+        logits = _logits(config, params, h[:, 0])
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        drafted = jax.lax.dynamic_update_slice_in_dim(
+            drafted, nxt[:, None], s + 1, axis=1
+        )
+        return ctx_kv, drafted
+
+    ctx_kv, drafted = jax.lax.fori_loop(0, k - 1, body, (ctx_kv, drafted))
+    return ctx_kv, drafted
+
+
+batch_draft = jax.jit(
+    batch_draft_impl, static_argnums=(0, 7, 8), donate_argnums=(2,)
+)
+
+
 # ---------------------------------------------------------------------------
 # Decode
 
@@ -769,13 +837,27 @@ def load_ctx_pages_impl(
     """Copy a matched prefix run of pool pages into the slot's context
     region at [0, n*ps). The admission-side half of prefix reuse: padding
     pages write scratch-page garbage BEYOND the valid prefix (the engine
-    passes q_start = real_blocks*ps, so garbage is never attended)."""
+    passes q_start = real_blocks*ps, so garbage is never attended).
+
+    The page list is pow2-padded by the caller, so n*ps can EXCEED the
+    region length (e.g. 46 matched pages pad to 64 while the region holds
+    52 — a dynamic_update_slice whose update outgrows the operand is a
+    trace-time TypeError that kills the whole engine round). The load is
+    clamped to the region statically: overflow pages are dropped, which
+    is always safe because real matched runs fit the region by admission
+    contract — only padding can overflow."""
     n = page_ids.shape[0]
+    ps = cache["k"].shape[3]
+    S = ctx_kv["k"].shape[3]
+    usable = min(n, S // ps)
+    if usable <= 0:
+        return {"k": ctx_kv["k"], "v": ctx_kv["v"]}
+    page_ids = page_ids[:usable]
     out = {}
     for name in ("k", "v"):
-        pages = cache[name][:, :, page_ids]      # [L, kvh, n, ps, hd]
-        L, kvh, _, ps, hd = pages.shape
-        span = pages.reshape(L, kvh, n * ps, hd)
+        pages = cache[name][:, :, page_ids]      # [L, kvh, usable, ps, hd]
+        L, kvh, _, _, hd = pages.shape
+        span = pages.reshape(L, kvh, usable * ps, hd)
         out[name] = jax.lax.dynamic_update_slice(
             ctx_kv[name], span[:, :, None],
             (0, 0, slot, 0, 0),

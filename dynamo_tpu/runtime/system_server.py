@@ -102,6 +102,9 @@ class SystemServer:
                 g("dynamo_spec_acceptance_rate",
                   "rolling speculative acceptance rate",
                   ws.spec_acceptance_rate)
+                g("dynamo_spec_effective_k",
+                  "mean acceptance-adaptive effective K over "
+                  "speculating slots", ws.spec_effective_k)
         return "\n".join(lines) + "\n"
 
     async def handle_metrics(self, request: web.Request) -> web.Response:

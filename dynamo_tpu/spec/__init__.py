@@ -8,11 +8,17 @@ memory-bound decode step into up to K+1 output tokens.
 
   proposer.py   model-free n-gram/prompt-lookup proposer (host-side,
                 deterministic) and a draft-model proposer (small model
-                sharing the tokenizer, run through llama.prefill)
+                sharing the tokenizer); drafting for ALL speculating
+                slots is fused into ONE llama.batch_draft program per
+                round (propose_batch) — O(1) device dispatches in both
+                the slot count and K
   verifier.py   fused on-device verification: score + longest-prefix /
-                rejection-sampling acceptance in one jit
+                rejection-sampling acceptance in one jit (the batched
+                draft output splices in on device, no host round trip)
   decoder.py    SpecDecoder — the engine-facing facade (eligibility,
-                proposal dispatch, counters, draft-KV rollback)
+                proposal dispatch, counters, draft-KV rollback) plus
+                AdaptiveKController: per-slot rolling acceptance shrinks/
+                grows the effective K and de-speculates collapsed slots
 
 The engine integration (dynamo_tpu/engine/engine.py) keeps speculating
 slots OUT of the fused decode round (their device lanes stay parked on
@@ -21,12 +27,13 @@ verify dispatches instead; rejected tokens need no device-side cleanup
 because the contiguous ctx region masks attention by sequence length and
 later writes overwrite the dead span — rollback is pointer truncation.
 """
-from dynamo_tpu.spec.decoder import SpecDecoder
+from dynamo_tpu.spec.decoder import AdaptiveKController, SpecDecoder
 from dynamo_tpu.spec.proposer import DraftModelProposer, NGramProposer
 from dynamo_tpu.spec.verifier import accept_tokens, spec_verify
 
 __all__ = [
     "SpecDecoder",
+    "AdaptiveKController",
     "NGramProposer",
     "DraftModelProposer",
     "accept_tokens",

@@ -1,22 +1,31 @@
 """SpecDecoder: the engine-facing facade of the speculation subsystem.
 
-Owns the proposer (n-gram or draft model), the acceptance counters, and
-the verify dispatch plumbing. The engine scheduler calls:
+Owns the proposer (n-gram or draft model), the acceptance counters, the
+acceptance-adaptive K controller, and the verify dispatch plumbing. The
+engine scheduler calls:
 
   eligible(req)           may this request speculate? (penalties and
                           logprobs need the per-token sampler path)
-  propose(slot, history)  K candidate tokens — host list (n-gram) or
+  k_for(slot)/round_k()   the slot's effective K and the bucketed round
+                          width covering a batch of slots
+  propose(slot, hist, k)  K candidate tokens — host list (n-gram) or
                           device array (draft model, no host sync)
+  propose_batch(...)      ONE batched draft dispatch for every
+                          speculating slot (llama.batch_draft)
   verify(...)             dispatch the fused score+accept program for a
                           batch of speculating slots
-  on_result(...)          commit counters + roll the draft KV back to
-                          the accepted length
+  on_result(...)          commit counters, update the adaptive-K rate,
+                          roll the draft KV back to the accepted length
+  should_despec(slot)     has this slot's acceptance collapsed?
   release(slot)           slot freed/de-speculated — drop draft state
 
 Counters feed three surfaces: engine.metrics() (WorkerStats spec
-fields -> metrics_exporter/system_server gauges), per-request
-annotations on the finishing LLMEngineOutput (sdk.request_stats), and
-the bench speculative phase.
+fields -> metrics_exporter/system_server gauges, incl. the mean
+effective K as dynamo_spec_effective_k), per-request annotations on the
+finishing LLMEngineOutput (sdk.request_stats), and the bench speculative
+phase. Dispatch counters (spec_draft_dispatch_total /
+spec_verify_dispatch_total) make the O(dispatches)-per-token cost
+directly observable — tools/profile_round.py --spec reads them.
 """
 from __future__ import annotations
 
@@ -26,10 +35,81 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from dynamo_tpu.engine.config import EngineConfig
+from dynamo_tpu.engine.config import EngineConfig, pow2_cover
 from dynamo_tpu.models.config import ModelConfig
 from dynamo_tpu.spec.proposer import DraftModelProposer, NGramProposer
 from dynamo_tpu.spec.verifier import spec_verify
+
+
+class AdaptiveKController:
+    """Per-slot acceptance-adaptive speculation depth.
+
+    Each verify result updates an EWMA of the slot's per-step acceptance
+    fraction (accepted / k_used). The effective K walks one step at a
+    time — +1 above ``grow_at``, -1 below ``shrink_at`` — bounded by
+    [k_min, k_max]; hysteresis between the thresholds keeps K stable on
+    noisy workloads. A slot whose rate stays at/below ``despec_at`` after
+    ``min_obs`` observations has speculation actively costing it (every
+    verify is a full forward that emits ~1 token) and should be handed
+    back to the fused decode round (Leviathan et al.'s adaptive
+    speculation; vLLM's dynamic speculative config is the serving-stack
+    analogue).
+    """
+
+    def __init__(self, k_max: int, k_min: int, *, grow_at: float,
+                 shrink_at: float, despec_at: float, ewma: float,
+                 min_obs: int):
+        if not 1 <= k_min <= k_max:
+            raise ValueError("need 1 <= spec_min_k <= num_speculative_tokens")
+        if not 0.0 <= despec_at <= shrink_at <= grow_at <= 1.0:
+            raise ValueError(
+                "need 0 <= despec_at <= shrink_at <= grow_at <= 1"
+            )
+        self.k_max = k_max
+        self.k_min = k_min
+        self.grow_at = grow_at
+        self.shrink_at = shrink_at
+        self.despec_at = despec_at
+        self.ewma = ewma
+        self.min_obs = min_obs
+        self._k: dict[int, int] = {}
+        self._rate: dict[int, float] = {}
+        self._obs: dict[int, int] = {}
+        self.grow_total = 0
+        self.shrink_total = 0
+
+    def k_for(self, slot: int) -> int:
+        # optimistic start at k_max: identical to static-K behavior until
+        # evidence says otherwise
+        return self._k.get(slot, self.k_max)
+
+    def rate_for(self, slot: int) -> Optional[float]:
+        return self._rate.get(slot)
+
+    def observe(self, slot: int, accepted: int, k_used: int) -> None:
+        step = accepted / max(k_used, 1)
+        prev = self._rate.get(slot)
+        rate = step if prev is None else (
+            self.ewma * prev + (1.0 - self.ewma) * step
+        )
+        self._rate[slot] = rate
+        self._obs[slot] = self._obs.get(slot, 0) + 1
+        k = self.k_for(slot)
+        if rate >= self.grow_at and k < self.k_max:
+            self._k[slot] = k + 1
+            self.grow_total += 1
+        elif rate <= self.shrink_at and k > self.k_min:
+            self._k[slot] = k - 1
+            self.shrink_total += 1
+
+    def should_despec(self, slot: int) -> bool:
+        return (self._obs.get(slot, 0) >= self.min_obs
+                and self._rate.get(slot, 1.0) <= self.despec_at)
+
+    def release(self, slot: int) -> None:
+        self._k.pop(slot, None)
+        self._rate.pop(slot, None)
+        self._obs.pop(slot, None)
 
 
 class SpecDecoder:
@@ -52,6 +132,16 @@ class SpecDecoder:
         self.k = ecfg.num_speculative_tokens
         self.config = config
         self.ecfg = ecfg
+        self.adaptive: Optional[AdaptiveKController] = None
+        if ecfg.spec_adaptive:
+            self.adaptive = AdaptiveKController(
+                self.k, min(ecfg.spec_min_k, self.k),
+                grow_at=ecfg.spec_grow_threshold,
+                shrink_at=ecfg.spec_shrink_threshold,
+                despec_at=ecfg.spec_despec_threshold,
+                ewma=ecfg.spec_rate_ewma,
+                min_obs=ecfg.spec_min_observations,
+            )
         self.ngram: Optional[NGramProposer] = None
         self.draft: Optional[DraftModelProposer] = None
         if mode == "ngram":
@@ -77,6 +167,10 @@ class SpecDecoder:
         self.verify_steps = 0
         self.reject_events = 0   # verify steps with a mid-batch rejection
         self.despec_total = 0    # slots handed back to the fused round
+        # device-program dispatch counters — the batched-drafting win is
+        # draft_dispatch_total growing O(rounds), not O(slots * K)
+        self.draft_dispatch_total = 0
+        self.verify_dispatch_total = 0
 
     # ------------------------------------------------------------------
 
@@ -95,18 +189,52 @@ class SpecDecoder:
             return False
         return True
 
+    # ------------------------------------------------------------------
+    # adaptive K
+
+    def k_for(self, slot: int) -> int:
+        if self.adaptive is None:
+            return self.k
+        return self.adaptive.k_for(slot)
+
+    def round_k(self, ks: list[int]) -> int:
+        """The round's verify/draft width covering every participating
+        slot: the max effective K, bucketed up to a power of two (each
+        distinct width is its own XLA compile of the draft AND verify
+        programs — bucketing bounds that at log2(K) variants) and clamped
+        to the CLI K."""
+        return min(pow2_cover(max(ks)), self.k)
+
+    def should_despec(self, slot: int) -> bool:
+        return self.adaptive is not None and self.adaptive.should_despec(slot)
+
+    # ------------------------------------------------------------------
+    # proposing
+
     def propose(
-        self, slot: int, history: list[int]
+        self, slot: int, history: list[int], k: int
     ) -> Union[list[int], jnp.ndarray]:
+        """Per-slot proposal (n-gram host lookup, or the LEGACY per-slot
+        draft path kept for spec_batch_draft=False A/B runs)."""
         if self.ngram is not None:
-            return self.ngram.propose(history)
-        return self.draft.propose(slot, history, self.k)
+            return self.ngram.propose(history, k)
+        # 1 catch-up prefill + (k-1) single-token programs
+        self.draft_dispatch_total += k
+        return self.draft.propose(slot, history, k)
+
+    def propose_batch(
+        self, rows: list[tuple[int, list[int]]], width: int, k: int
+    ) -> jnp.ndarray:
+        """ONE batched draft dispatch for all speculating slots."""
+        self.draft_dispatch_total += 1
+        return self.draft.propose_batch(rows, width, k)
 
     def verify(
         self,
         params: Any,
         ctx_kv: Any,
         tokens: jnp.ndarray,
+        draft: Optional[jnp.ndarray],
         slots: np.ndarray,
         q_starts: np.ndarray,
         seq_lens: np.ndarray,
@@ -115,8 +243,9 @@ class SpecDecoder:
         top_ks: np.ndarray,
         top_ps: np.ndarray,
     ):
+        self.verify_dispatch_total += 1
         return spec_verify(
-            self.config, params, ctx_kv, tokens,
+            self.config, params, ctx_kv, tokens, draft,
             jnp.asarray(slots), jnp.asarray(q_starts),
             jnp.asarray(seq_lens), jnp.asarray(keys),
             jnp.asarray(temps), jnp.asarray(top_ks), jnp.asarray(top_ps),
@@ -125,15 +254,20 @@ class SpecDecoder:
 
     # ------------------------------------------------------------------
 
-    def on_result(self, slot: int, hist_len: int, accepted: int) -> None:
-        """One verify step landed: `accepted` of the K proposals matched;
-        the slot's true sequence is hist_len + accepted + 1 tokens (the
-        bonus token is pending, its KV unwritten)."""
-        self.proposed_total += self.k
+    def on_result(
+        self, slot: int, hist_len: int, accepted: int, k_used: int
+    ) -> None:
+        """One verify step landed: `accepted` of the round's `k_used`
+        proposals (the bucketed round width) matched; the slot's true
+        sequence is hist_len + accepted + 1 tokens (the bonus token is
+        pending, its KV unwritten)."""
+        self.proposed_total += k_used
         self.accepted_total += accepted
         self.verify_steps += 1
-        if accepted < self.k:
+        if accepted < k_used:
             self.reject_events += 1
+        if self.adaptive is not None:
+            self.adaptive.observe(slot, accepted, k_used)
         if self.draft is not None:
             self.draft.truncate(slot, hist_len + accepted)
 
@@ -144,12 +278,21 @@ class SpecDecoder:
     def release(self, slot: int) -> None:
         if self.draft is not None:
             self.draft.release(slot)
+        if self.adaptive is not None:
+            self.adaptive.release(slot)
 
     def acceptance_rate(self) -> float:
         return self.accepted_total / max(self.proposed_total, 1)
 
+    def effective_k_mean(self, slots: list[int]) -> float:
+        """Mean effective K over the given (speculating) slots — the
+        dynamo_spec_effective_k gauge; 0 when nothing speculates."""
+        if not slots:
+            return 0.0
+        return sum(self.k_for(s) for s in slots) / len(slots)
+
     def stats(self) -> dict[str, Any]:
-        return {
+        out = {
             "mode": self.mode,
             "k": self.k,
             "spec_proposed_total": self.proposed_total,
@@ -158,4 +301,11 @@ class SpecDecoder:
             "spec_reject_events": self.reject_events,
             "spec_despec_total": self.despec_total,
             "spec_acceptance_rate": self.acceptance_rate(),
+            "spec_draft_dispatch_total": self.draft_dispatch_total,
+            "spec_verify_dispatch_total": self.verify_dispatch_total,
+            "spec_adaptive": self.adaptive is not None,
         }
+        if self.adaptive is not None:
+            out["spec_k_grow_total"] = self.adaptive.grow_total
+            out["spec_k_shrink_total"] = self.adaptive.shrink_total
+        return out

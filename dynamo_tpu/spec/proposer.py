@@ -27,10 +27,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from dynamo_tpu.engine.config import EngineConfig
-# runtime-safe: the engine imports spec/ lazily inside TpuEngine.__init__,
-# never at module scope, so this import cannot cycle
-from dynamo_tpu.engine.engine import pow2_cover
+from dynamo_tpu.engine.config import EngineConfig, pow2_cover
 from dynamo_tpu.models import llama
 from dynamo_tpu.models.config import ModelConfig
 
@@ -60,8 +57,10 @@ class NGramProposer:
         self.min_n = min_n
         self.max_lookback = max_lookback
 
-    def propose(self, history: list[int]) -> list[int]:
-        k = self.k
+    def propose(self, history: list[int], k: int = 0) -> list[int]:
+        """Propose ``k`` tokens (0 = the constructor default). Callers
+        with adaptive K pass the round's effective width."""
+        k = k or self.k
         hist = history[-self.max_lookback:]
         L = len(hist)
         for n in range(min(self.max_n, L - 1), self.min_n - 1, -1):
@@ -151,6 +150,58 @@ class DraftModelProposer:
         # fed back, so its KV was never computed)
         self.pos[slot] = len(history) + k - 1
         return jnp.stack(drafted)
+
+    def propose_batch(
+        self, rows: list[tuple[int, list[int]]], width: int, k: int
+    ) -> jnp.ndarray:
+        """Draft k tokens for EVERY speculating slot in ONE device
+        dispatch (llama.batch_draft): the per-slot catch-up chunks run as
+        one [width, T] batched forward, then k-1 batched single-token
+        steps advance greedily inside a fori_loop — O(1) dispatches per
+        round where the per-slot path issued O(len(rows) * k).
+
+        ``rows`` is [(slot, history)] for the live rows; the remaining
+        lanes up to ``width`` are dummies (scratch lane, seq_len 0),
+        mirroring the verifier's batch layout so the returned [width, k]
+        array splices row-aligned into the verify dispatch.
+        """
+        S = self.ecfg.max_context
+        scratch = self.ecfg.max_decode_slots
+        chunks: list[tuple[int, list[int], int]] = []
+        max_len = 1
+        for slot, hist in rows:
+            start = int(self.pos[slot])
+            assert len(hist) > start, \
+                "history must extend past the draft position"
+            chunks.append((slot, hist, start))
+            max_len = max(max_len, len(hist) - start)
+        # one shared pow2 chunk width, clamped to the region (see
+        # propose: an overflowing padded write start would be CLAMPED by
+        # dynamic_update_slice, silently shifting real KV). Rows whose
+        # start + T would overflow re-feed a little extra history
+        # instead (start_eff < start recomputes identical KV — harmless).
+        T = min(pow2_cover(max_len, 8), S)
+        toks = np.zeros((width, T), np.int32)
+        slots_a = np.full(width, scratch, np.int32)
+        q_starts = np.zeros(width, np.int32)
+        seq_lens = np.zeros(width, np.int32)   # 0: dummy rows fully masked
+        for j, (slot, hist, start) in enumerate(chunks):
+            start_eff = min(start, S - T)
+            chunk = hist[start_eff:]
+            toks[j, : len(chunk)] = chunk
+            slots_a[j] = slot
+            q_starts[j] = start_eff
+            seq_lens[j] = len(hist)
+        self.ctx, drafted = llama.batch_draft(
+            self.config, self.params, self.ctx,
+            jnp.asarray(toks), jnp.asarray(slots_a),
+            jnp.asarray(q_starts), jnp.asarray(seq_lens), S, k,
+        )
+        for slot, hist, _ in chunks:
+            # KV written: history plus drafted[:-1] (the last draft is
+            # never fed back, so its KV was never computed)
+            self.pos[slot] = len(hist) + k - 1
+        return drafted
 
     def truncate(self, slot: int, n_valid: int) -> None:
         """Rollback after verification: only the first ``n_valid`` tokens

@@ -112,13 +112,17 @@ def accept_tokens(
     return out, a + 1, jax.random.key_data(new_key)
 
 
-@functools.partial(jax.jit, static_argnums=(0, 11, 12),
+@functools.partial(jax.jit, static_argnums=(0, 12, 13),
                    donate_argnums=(2,))
 def spec_verify(
     config,                 # ModelConfig (static)
     params,
     ctx_kv,
     tokens: jnp.ndarray,    # [B, K+1] i32 — col 0 pending, cols 1: proposed
+    draft: jnp.ndarray,     # [B, K] i32 device draft tokens, or None —
+                            # spliced into cols 1: INSIDE the program so a
+                            # batched draft feeds verify with zero extra
+                            # host dispatches (llama.batch_draft output)
     slots: jnp.ndarray,     # [B] i32 (dummies -> scratch lane B)
     q_starts: jnp.ndarray,  # [B] i32 — region KV length per slot
     seq_lens: jnp.ndarray,  # [B] i32 — q_start + K + 1 live, 0 dummy
@@ -136,7 +140,18 @@ def spec_verify(
     region at [q_start, q_start+K+1); the host commits only the first
     n_out-1 proposals + pending (rollback = pointer truncation, see
     llama.batch_score_impl).
+
+    Adaptive-K contract: K here is the ROUND width — the bucketed max
+    of the participating slots' effective K, so the program (and its
+    device cost) shrinks only when every participant's acceptance sags.
+    The full accepted chain is always emitted (each accepted proposal
+    independently passed the acceptance rule, so any prefix — including
+    the whole chain — is a valid emission); per-slot effective K shapes
+    the next round's width vote and the despec decision, never this
+    round's output.
     """
+    if draft is not None:
+        tokens = jax.lax.dynamic_update_slice(tokens, draft, (0, 1))
     ctx_kv, logits = llama.batch_score_impl(
         config, params, ctx_kv, tokens, slots, q_starts, seq_lens, ctx_span
     )

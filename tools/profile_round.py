@@ -3,10 +3,22 @@
 Times engine_round at (B=32, S_max=1024) with: the serving chunk config,
 a bigger chunk, no-flush, and flush-only — to attribute device ms/step.
 Run: PYTHONPATH=/root/.axon_site:/root/repo python tools/profile_round.py
+
+Spec mode (--spec): count DEVICE DISPATCHES per emitted token for the
+speculative paths instead of timing kernels — the regression guard for
+host dispatch overhead. Runs a tiny engine (CPU-friendly:
+JAX_PLATFORMS=cpu works) through off / ngram / draft-batched /
+draft-per-slot and prints one JSON line per mode with the per-token
+dispatch breakdown (rounds, patches, draft programs, verify programs).
+Batched drafting must show O(1) draft dispatches per round regardless of
+the speculating slot count; the per-slot path shows the O(slots*K) cost
+it replaced. Run: python tools/profile_round.py --spec all
 """
 from __future__ import annotations
 
+import argparse
 import functools
+import json
 import time
 
 import jax
@@ -111,5 +123,116 @@ def main():
            (st[0], st[1], dest, ctx0 - 1))
 
 
+def _spec_dispatch_mode(modes: list[str], n_req: int, osl: int) -> int:
+    """Count device dispatches per emitted token for each speculative
+    path. Dispatch sources on the decode path: fused rounds
+    (engine_round), state patches, first-token samples, draft programs
+    (SpecDecoder.draft_dispatch_total — 1/round batched, ~K/slot/round
+    per-slot), and verify programs (verify_dispatch_total)."""
+    import asyncio
+
+    from dynamo_tpu.engine.config import EngineConfig
+    from dynamo_tpu.engine.engine import TpuEngine
+    from dynamo_tpu.parallel.mesh import MeshConfig
+    from dynamo_tpu.protocols.common import (
+        PreprocessedRequest,
+        StopConditions,
+    )
+
+    cfg = ModelConfig.tiny(dtype="float32")
+    params = llama.init_params(cfg, 0)
+    rng = np.random.RandomState(0)
+    # repetitive prompts so the ngram path actually accepts drafts
+    pat = rng.randint(1, cfg.vocab_size, 8).tolist()
+    prompts = [pat * 6 for _ in range(n_req)]
+
+    async def run_mode(mode: str) -> dict:
+        speculative, batch_draft = {
+            "off": ("off", True),
+            "ngram": ("ngram", True),
+            "draft": ("draft", True),
+            "draft-perslot": ("draft", False),
+        }[mode]
+        ekw = {}
+        if speculative == "draft":
+            ekw = dict(draft_config=cfg, draft_params=params)
+        eng = TpuEngine(
+            cfg,
+            EngineConfig(
+                num_pages=64, page_size=16, max_pages_per_seq=8,
+                max_decode_slots=max(n_req, 2), prefill_buckets=(64,),
+                cache_dtype="float32", speculative=speculative,
+                num_speculative_tokens=4, spec_batch_draft=batch_draft,
+            ),
+            mesh_config=MeshConfig(tp=1), **ekw,
+        )
+        counts = {"round": 0, "patch": 0, "first": 0}
+
+        def wrap(name, fn):
+            def w(*a, **k):
+                counts[name] += 1
+                return fn(*a, **k)
+            return w
+
+        eng._engine_round = wrap("round", eng._engine_round)
+        eng._patch = wrap("patch", eng._patch)
+        eng._sample_first = wrap("first", eng._sample_first)
+        eng.start()
+
+        async def one(p):
+            n = 0
+            async for out in eng.generate(PreprocessedRequest(
+                token_ids=list(p),
+                stop_conditions=StopConditions(
+                    max_tokens=osl, ignore_eos=True
+                ),
+            )):
+                n += len(out.token_ids)
+            return n
+
+        tokens = sum(await asyncio.gather(*[one(p) for p in prompts]))
+        st = eng.spec.stats() if eng.spec else {}
+        await eng.stop()
+        draft_d = st.get("spec_draft_dispatch_total", 0)
+        verify_d = st.get("spec_verify_dispatch_total", 0)
+        total = sum(counts.values()) + draft_d + verify_d
+        return {
+            "mode": mode,
+            "slots": n_req,
+            "tokens": tokens,
+            "round_dispatches": counts["round"],
+            "patch_dispatches": counts["patch"],
+            "first_dispatches": counts["first"],
+            "draft_dispatches": draft_d,
+            "verify_dispatches": verify_d,
+            "draft_dispatches_per_verify": round(
+                draft_d / max(verify_d, 1), 3
+            ),
+            "dispatches_per_token": round(total / max(tokens, 1), 4),
+            "spec_acceptance_rate": round(
+                st.get("spec_acceptance_rate", 0.0), 4
+            ),
+        }
+
+    for mode in modes:
+        print(json.dumps(asyncio.run(run_mode(mode))))
+    return 0
+
+
 if __name__ == "__main__":
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument(
+        "--spec", default=None, nargs="?", const="all",
+        choices=["off", "ngram", "draft", "draft-perslot", "all"],
+        help="dispatch-count mode instead of kernel timing",
+    )
+    ap.add_argument("--requests", type=int, default=4,
+                    help="concurrent requests (= speculating slots)")
+    ap.add_argument("--osl", type=int, default=32,
+                    help="output tokens per request in --spec mode")
+    args = ap.parse_args()
+    if args.spec:
+        modes = (["off", "ngram", "draft", "draft-perslot"]
+                 if args.spec == "all" else [args.spec])
+        raise SystemExit(_spec_dispatch_mode(modes, args.requests, args.osl))
     main()
