@@ -7,7 +7,11 @@ Llama-3.2-1B shapes, bf16, on whatever accelerator `jax.devices()` offers
 
 Fields beyond the driver contract (metric/value/unit/vs_baseline):
   prefill_tok_s        prompt tokens consumed per second (batch prefill)
-  ttft_p50_s/p99_s     submit->first-token under full concurrency
+  ttft_p50/p95/p99_s   submit->first-token under full concurrency, read
+                       from the engine's dynamo_request_ttft_seconds
+                       histogram (telemetry plane, not ad-hoc timers)
+  itl_p50/p95/p99_s    steady-state inter-token latency percentiles from
+                       dynamo_request_itl_seconds
   decode_ms_per_step   wall per fused step at steady state
   device_ms_per_step   device-only time per step (blocking round / steps)
   mfu                  decode model-flops utilization vs chip peak
@@ -149,20 +153,30 @@ async def run_bench() -> dict:
     iso_ok = sorted(f for f, _ in iso if f is not None)
     ttft_isolated = iso_ok[len(iso_ok) // 2] if iso_ok else None
 
-    # ---- phase A: prefill throughput + TTFT under full concurrency ----
+    # ---- phase A: prefill throughput + TTFT under full concurrency.
+    # TTFT percentiles come from the engine's telemetry histograms
+    # (dynamo_request_ttft_seconds — the same series /metrics exports)
+    # instead of ad-hoc timers; reset first so warmup/iso observations
+    # don't pollute the phase. ----
+    eng.telemetry.reset()
     t0 = time.monotonic()
     pre = await asyncio.gather(
         *[drive(make_req(1), t0) for _ in range(n_requests)]
     )
     prefill_wall = time.monotonic() - t0
-    ttfts = sorted(f for f, _ in pre if f is not None)
+    h_ttft = eng.telemetry.get("dynamo_request_ttft_seconds")
+    ttft_p50 = h_ttft.percentile(0.50)
+    ttft_p95 = h_ttft.percentile(0.95)
+    ttft_p99 = h_ttft.percentile(0.99)
     prefill_tok_s = n_requests * prompt_len / prefill_wall
     # prefill is compute-bound: MFU against chip peak
     prefill_mfu = (
         n_requests * prompt_len * 2 * n_params / prefill_wall / peak_flops
     )
 
-    # ---- phase B: steady-state decode ----
+    # ---- phase B: steady-state decode (ITL distribution from
+    # dynamo_request_itl_seconds, this phase's observations only) ----
+    eng.telemetry.reset()
     steps0 = eng.step_count
     t0 = time.monotonic()
     results = await asyncio.gather(
@@ -170,6 +184,10 @@ async def run_bench() -> dict:
     )
     decode_wall = time.monotonic() - t0
     steps = eng.step_count - steps0
+    h_itl = eng.telemetry.get("dynamo_request_itl_seconds")
+    itl_p50 = h_itl.percentile(0.50)
+    itl_p95 = h_itl.percentile(0.95)
+    itl_p99 = h_itl.percentile(0.99)
     await eng.stop()
 
     total_tokens = sum(n for _, n in results)
@@ -228,9 +246,12 @@ async def run_bench() -> dict:
     return {
         "decode_tok_s": decode_tok_s,
         "prefill_tok_s": prefill_tok_s,
-        "ttft_p50_s": ttfts[len(ttfts) // 2] if ttfts else None,
-        "ttft_p99_s": ttfts[min(len(ttfts) - 1, int(len(ttfts) * 0.99))]
-        if ttfts else None,
+        "ttft_p50_s": ttft_p50,
+        "ttft_p95_s": ttft_p95,
+        "ttft_p99_s": ttft_p99,
+        "itl_p50_s": itl_p50,
+        "itl_p95_s": itl_p95,
+        "itl_p99_s": itl_p99,
         "decode_ms_per_step": 1e3 / steps_per_s if steps_per_s else None,
         "ttft_isolated_s": ttft_isolated,
         "prefill_mfu": prefill_mfu,
@@ -589,7 +610,8 @@ def main():
         "unit": "tok/s/chip",
         "vs_baseline": round(stats["decode_tok_s"] / BASELINE_DECODE_TOK_S, 3),
     }
-    for k in ("prefill_tok_s", "prefill_mfu", "ttft_p50_s", "ttft_p99_s",
+    for k in ("prefill_tok_s", "prefill_mfu", "ttft_p50_s", "ttft_p95_s",
+              "ttft_p99_s", "itl_p50_s", "itl_p95_s", "itl_p99_s",
               "ttft_isolated_s", "decode_ms_per_step",
               "device_ms_per_step", "mfu",
               "roofline_frac", "chip", "params_m", "batch",

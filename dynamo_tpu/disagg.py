@@ -323,6 +323,12 @@ class DisaggDecodeEngine:
         return self.engine.allocator
 
     @property
+    def flight(self):
+        """Flight recorder passthrough: /debug/flight must keep working
+        when the system server holds this wrapper, not the TpuEngine."""
+        return getattr(self.engine, "flight", None)
+
+    @property
     def on_metrics(self):
         return self.engine.on_metrics
 
@@ -368,11 +374,22 @@ class DisaggDecodeEngine:
     async def generate(
         self, request: PreprocessedRequest
     ) -> AsyncIterator[LLMEngineOutput]:
+        from dynamo_tpu.telemetry.trace import span_now
+
+        t0 = time.monotonic()
+        span = None
         if await self._maybe_remote_prefill(request):
             self.remote_prefills += 1
+            # trace the remote KV transfer: injected into the finishing
+            # output's span payload so the frontend's span tree carries
+            # it alongside the engine's queue/prefill spans
+            span = span_now("disagg_kv_transfer", t0).to_dict()
         else:
             self.local_prefills += 1
         async for out in self.engine.generate(request):
+            if span is not None and out.finish_reason is not None:
+                tr = out.annotations.setdefault("trace", {})
+                tr.setdefault("spans", []).insert(0, span)
             yield out
 
     async def _should_remote(self, request: PreprocessedRequest,

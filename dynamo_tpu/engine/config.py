@@ -124,6 +124,11 @@ class EngineConfig:
     # gather size; pow2-bucketed for compile-cache reuse)
     offload_batch: int = 8
 
+    # flight recorder (telemetry/flight.py): ring capacity of recent
+    # engine-round events served at /debug/flight and dumped to the log
+    # when an engine round fails
+    flight_recorder_events: int = 256
+
     # model memory
     cache_dtype: str = "bfloat16"
 

@@ -75,11 +75,30 @@ from dynamo_tpu.protocols.common import (
     LLMEngineOutput,
     PreprocessedRequest,
 )
+from dynamo_tpu.telemetry import (
+    TRACES,
+    FlightRecorder,
+    TelemetryRegistry,
+    request_histograms,
+)
+from dynamo_tpu.telemetry import metrics as tmetrics
+from dynamo_tpu.telemetry.trace import span_now
 from dynamo_tpu.tokens import TokenBlockSequence
 
 log = logging.getLogger(__name__)
 
 _FIRST_TOKEN_KEY_TAG = 0x46697273  # distinct PRNG stream for first tokens
+
+# per-request trace spans shipped back in the finishing annotation are
+# capped (a 10k-token generation must not grow a 10k-entry span list);
+# the total decode-round count still travels in the timing annotation
+_MAX_ROUND_SPANS = 24
+
+
+def _span_dict(name: str, t0_monotonic: float, **attrs) -> dict:
+    """Span ending now that began at monotonic ``t0_monotonic`` — the
+    annotation-ready wire form (telemetry.trace.span_now)."""
+    return span_now(name, t0_monotonic, **attrs).to_dict()
 
 
 
@@ -105,6 +124,14 @@ class _Request:
     finished: bool = False
     enqueue_time: float = field(default_factory=time.monotonic)
     first_token_time: Optional[float] = None
+    # telemetry: worker-side span dicts (queue/prefill/decode rounds —
+    # telemetry/trace.py), round-batched inter-token gaps as (gap_s, n),
+    # and timestamps backing them
+    trace_spans: list[dict] = field(default_factory=list)
+    itl_gaps: list[tuple] = field(default_factory=list)
+    t_prefill_start: Optional[float] = None
+    t_last_emit: Optional[float] = None
+    decode_rounds: int = 0
     # speculative decoding (spec/): a speculating slot's device lane
     # stays PARKED (dest=scratch) — its real state lives here on the
     # host and in the ctx region, driven by verify dispatches instead of
@@ -163,6 +190,8 @@ class _Entry:
     # spec verify: (n_out [B], new_keys [B, 2]) device handles fetched
     # alongside `handle` (the [B, K+1] accepted-token array)
     aux: Any = None
+    # telemetry: dispatch time, for dynamo_engine_round_seconds
+    t_dispatch: float = 0.0
 
 
 class TpuEngine:
@@ -288,6 +317,22 @@ class TpuEngine:
                 draft_config=draft_config, draft_params=draft_params,
                 rng_seed=rng_seed,
             )
+
+        # telemetry: latency histograms (scraped by the system server,
+        # shipped to the exporter inside ForwardPassMetrics) + the
+        # flight-recorder ring of recent dispatches
+        self.telemetry = request_histograms(TelemetryRegistry(), engine=True)
+        self._h_ttft = self.telemetry.get(tmetrics.TTFT[0])
+        self._h_itl = self.telemetry.get(tmetrics.ITL[0])
+        self._h_e2e = self.telemetry.get(tmetrics.E2E[0])
+        self._h_queue = self.telemetry.get(tmetrics.QUEUE[0])
+        self._h_round = self.telemetry.get(tmetrics.ROUND[0])
+        # histogram snapshots are built per metrics() call, which the
+        # engine loop makes EVERY round via on_metrics while the
+        # publisher throttles to ~4 Hz — cache at the publish cadence so
+        # the per-round cost is a timestamp compare, not 5 locked walks
+        self._hist_snap: tuple[float, dict] = (0.0, {})
+        self.flight = FlightRecorder(e.flight_recorder_events)
 
         B = e.max_decode_slots
         self._B = B
@@ -718,6 +763,16 @@ class TpuEngine:
         )
         return np.asarray(out, np.float32).tolist()
 
+    def _histograms_snapshot(self) -> dict:
+        """Telemetry snapshot refreshed at most every 0.25 s (the
+        publisher's own throttle) — metrics() runs every round."""
+        now = time.monotonic()
+        t, snap = self._hist_snap
+        if now - t >= 0.25:
+            snap = self.telemetry.snapshot()
+            self._hist_snap = (now, snap)
+        return snap
+
     def metrics(self) -> ForwardPassMetrics:
         a = self.allocator
         # "gpu cache usage" must reflect LIVE serving occupancy, not the
@@ -764,6 +819,7 @@ class TpuEngine:
                     ]) if self.spec else 0.0
                 ),
             ),
+            histograms=self._histograms_snapshot(),
             kv_stats=KvStats(
                 kv_active_blocks=a.active_pages,
                 kv_total_blocks=a.total_pages,
@@ -794,8 +850,11 @@ class TpuEngine:
         while not self._stop.is_set():
             try:
                 did_work = self._round()
-            except Exception:  # noqa: BLE001 — engine loop must survive
+            except Exception as exc:  # noqa: BLE001 — engine loop must survive
                 log.exception("engine round failed")
+                # the last N dispatches before the failure are the
+                # postmortem; logs alone never have them
+                self.flight.dump(log, reason=repr(exc))
                 try:
                     self._fail_all(
                         RuntimeError("engine step failed; see logs")
@@ -900,11 +959,20 @@ class TpuEngine:
                 "want_sample": want_sample,
             })
         # one fused program: n decode+sample steps + flush (engine_round)
+        t_disp = time.monotonic()
         self.ctx, self.ring, self._dev, stacked, lp_stacked = (
             self._engine_round(
                 self.params, self.ctx, self.ring, self._dev, n,
                 want_lp, want_sample,
             )
+        )
+        self.flight.record(
+            "round", slots=list(active), n_steps=n,
+            spec_slots=[
+                i for i, s in enumerate(self._slots)
+                if s is not None and s.spec
+            ],
+            dispatch_ms=round((time.monotonic() - t_disp) * 1e3, 3),
         )
         # only dispatched lanes advance (spec slots track their own
         # lengths through verify processing)
@@ -919,6 +987,7 @@ class TpuEngine:
         self._entries.append(
             _Entry(
                 kind="round",
+                t_dispatch=t_disp,
                 handle=stacked,
                 # snapshot EXCLUDES speculating slots: their device lanes
                 # are parked, so their columns in this round's stacked
@@ -1031,6 +1100,7 @@ class TpuEngine:
             temps[j] = so.temperature or 0.0
             top_ks[j] = so.top_k or 0
             top_ps[j] = so.top_p if so.top_p is not None else 1.0
+        t_disp = time.monotonic()
         drafted = None
         if self.spec.draft is not None and e.spec_batch_draft:
             # ONE multi-slot multi-token draft program; the [B, K] device
@@ -1053,12 +1123,16 @@ class TpuEngine:
         )
         for arr in (out_toks, n_out, new_keys):
             arr.copy_to_host_async()
+        self.flight.record(
+            "spec_verify", slots=[slot for slot, *_ in rows], k=K,
+            dispatch_ms=round((time.monotonic() - t_disp) * 1e3, 3),
+        )
         for slot, r, _, _ in rows:
             r.spec_ready = False
             r.spec_inflight = True
         self._entries.append(_Entry(
             kind="spec", handle=out_toks, rows=rows,
-            aux=(n_out, new_keys), n_steps=K,
+            aux=(n_out, new_keys), n_steps=K, t_dispatch=t_disp,
         ))
         return True
 
@@ -1121,9 +1195,11 @@ class TpuEngine:
                 batch.append(tok)
                 if finish is not None:
                     break
+            if batch:
+                self._note_emit(r, len(batch), entry, "spec_verify_round")
             if batch or finish is not None:
                 extra = (
-                    {"annotations": self._spec_annotations(r)}
+                    {"annotations": self._final_annotations(r)}
                     if finish is not None else {}
                 )
                 r.emit(LLMEngineOutput(
@@ -1144,6 +1220,62 @@ class TpuEngine:
                 continue
             r.spec_ready = True
             self._ctx_disp[slot] = len(r.spec_tokens)
+
+    def _note_emit(
+        self, r: _Request, n_tokens: int, entry: _Entry, kind: str
+    ) -> None:
+        """Telemetry for one round's emitted batch: per-token gaps into
+        the ITL histogram (the batch arrives together — its gap is the
+        round wall split over the tokens) and a capped round span."""
+        now = time.monotonic()
+        if r.t_last_emit is not None:
+            gap = (now - r.t_last_emit) / n_tokens
+            self._h_itl.observe(gap, n_tokens)
+            if len(r.itl_gaps) < 4096:
+                r.itl_gaps.append((gap, n_tokens))
+        r.t_last_emit = now
+        r.decode_rounds += 1
+        if len(r.trace_spans) < _MAX_ROUND_SPANS and entry.t_dispatch:
+            r.trace_spans.append(
+                _span_dict(kind, entry.t_dispatch, tokens=n_tokens)
+            )
+
+    def _final_annotations(self, r: _Request) -> dict:
+        """Annotations for the FINISHING output: speculation counters,
+        per-request timing (TTFT / ITL p50/p95 / queue / E2E — what
+        sdk.request_stats folds), and the worker-side trace spans the
+        frontend merges into its span tree. Called exactly once per
+        normally-finished request; also registers the spans in the
+        worker-local trace store when no frontend owns the trace in this
+        process (remote-worker mode)."""
+        ann = self._spec_annotations(r)
+        now = time.monotonic()
+        e2e = now - r.enqueue_time
+        self._h_e2e.observe(e2e)
+        timing: dict[str, Any] = {
+            "e2e_s": round(e2e, 6),
+            "output_tokens": r.produced,
+            "decode_rounds": r.decode_rounds,
+        }
+        if r.first_token_time is not None:
+            timing["ttft_s"] = round(
+                r.first_token_time - r.enqueue_time, 6
+            )
+        if r.t_prefill_start is not None:
+            timing["queue_s"] = round(
+                r.t_prefill_start - r.enqueue_time, 6
+            )
+        for key, q in (("itl_p50_s", 0.50), ("itl_p95_s", 0.95)):
+            v = tmetrics.weighted_percentile(r.itl_gaps, q)
+            if v is not None:
+                timing[key] = round(v, 6)
+        ann["timing"] = timing
+        if r.trace_spans:
+            ann["trace"] = {"spans": list(r.trace_spans)}
+            rid = r.req.request_id
+            if rid and not TRACES.has_active(rid):
+                TRACES.record_remote(rid, r.trace_spans)
+        return ann
 
     def _spec_annotations(self, r: _Request) -> dict:
         """Per-request speculation stats for the finishing output — the
@@ -1227,8 +1359,13 @@ class TpuEngine:
             batch.append(cand)
         if not batch:
             return
+        t_disp = time.monotonic()
         out = self._gather_padded([p for p, _, _ in batch])
         out.copy_to_host_async()
+        self.flight.record(
+            "g2_offload", pages=len(batch),
+            dispatch_ms=round((time.monotonic() - t_disp) * 1e3, 3),
+        )
         self._entries.append(_Entry(
             kind="offload", handle=out, n_steps=len(batch),
             hashes=[h for _, h, _ in batch],
@@ -1442,10 +1579,15 @@ class TpuEngine:
                 "q_starts": q_starts.tolist(),
                 "seq_lens": seq_lens.tolist(), "ctx_span": ctx_span,
             })
+        t_disp = time.monotonic()
         self.ctx, logits = llama.batch_prefill(
             self.config, self.params, self.ctx, jnp.asarray(toks),
             jnp.asarray(slots), jnp.asarray(q_starts),
             jnp.asarray(seq_lens), ctx_span,
+        )
+        self.flight.record(
+            "prefill_batch", slots=[r.slot for r in group], width=width,
+            dispatch_ms=round((time.monotonic() - t_disp) * 1e3, 3),
         )
         done: list[_Request] = []
         for i, r in enumerate(group):
@@ -1469,6 +1611,16 @@ class TpuEngine:
         r.slot = -1
         r.prefill_pos = -1
 
+    def _note_queue_wait(self, r: _Request) -> None:
+        """Account the admission queue wait once, when the request first
+        gets a lane (multi-chunk continuations keep the original mark)."""
+        if r.t_prefill_start is not None:
+            return
+        now = time.monotonic()
+        self._h_queue.observe(now - r.enqueue_time)
+        r.trace_spans.append(_span_dict("queue", r.enqueue_time))
+        r.t_prefill_start = now
+
     def _prefill_begin(self, r: _Request) -> None:
         """Start a request's prefill: reserve a lane, prefix-match (HBM,
         then host tiers) and copy the matched run pool -> ctx. Seals
@@ -1481,10 +1633,18 @@ class TpuEngine:
         assert slot is not None, "caller checks slot availability"
         r.slot = slot
         self._prefilling[slot] = r
+        self._note_queue_wait(r)
         hashes = r.seq.block_hashes()
         matchable = hashes[: max(0, (len(prompt) - 1) // ps)]
         matched_pages = self.allocator.match_prefix(matchable)
+        t_onboard = time.monotonic()
+        g1_matched = len(matched_pages)
         matched_pages = self._onboard_from_host(matchable, matched_pages)
+        if len(matched_pages) > g1_matched:
+            r.trace_spans.append(_span_dict(
+                "g2_onboard", t_onboard,
+                blocks=len(matched_pages) - g1_matched,
+            ))
         # a matched/onboarded run longer than the ctx region cannot be
         # loaded (and the pow2 PADDING below can overflow the region even
         # when the real run fits — load_ctx_pages clamps that statically;
@@ -1582,11 +1742,16 @@ class TpuEngine:
                 "tokens": toks.tolist(), "slot": r.slot,
                 "start": start, "end": start + len(chunk),
             })
+        t_disp = time.monotonic()
         self.ctx, logits = llama.prefill(
             self.config, self.params, self.ctx,
             jnp.asarray(toks), jnp.int32(r.slot),
             jnp.int32(start), jnp.int32(start + len(chunk)),
             embeds, embeds_mask,
+        )
+        self.flight.record(
+            "prefill", slots=[r.slot], tokens=len(chunk), start=start,
+            dispatch_ms=round((time.monotonic() - t_disp) * 1e3, 3),
         )
         r.prefill_pos = start + len(chunk)
         if r.prefill_pos < len(prompt):
@@ -1611,6 +1776,7 @@ class TpuEngine:
         assert slot is not None, "caller checks slot availability"
         r.slot = slot
         self._prefilling[slot] = r
+        self._note_queue_wait(r)
         sp_n = self.mesh.shape["sp"]
         pad = -len(prompt) % sp_n
         toks = np.zeros(len(prompt) + pad, np.int32)
@@ -1619,10 +1785,15 @@ class TpuEngine:
             self.on_dispatch("sp_prefill", {
                 "tokens": toks.tolist(), "slot": slot, "n": len(prompt),
             })
+        t_disp = time.monotonic()
         kv, logits = llama.sp_prefill(
             self.config, self.params,
             sp_shard(jnp.asarray(toks), self.mesh),
             jnp.int32(len(prompt)), self.mesh,
+        )
+        self.flight.record(
+            "sp_prefill", slots=[slot], tokens=len(prompt),
+            dispatch_ms=round((time.monotonic() - t_disp) * 1e3, 3),
         )
         self.ctx = llama.write_ctx_span(self.ctx, jnp.int32(slot), kv)
         r.prefill_pos = len(prompt)
@@ -1636,6 +1807,12 @@ class TpuEngine:
         when `logits` was sliced from a batched prefill — broadcast so
         followers slice their own replayed [K, V] logits identically."""
         prompt = r.tokens
+        if r.t_prefill_start is not None:
+            r.trace_spans.append(_span_dict(
+                "prefill", r.t_prefill_start,
+                prompt_tokens=len(prompt), matched_blocks=r.matched_blocks,
+                slot=r.slot,
+            ))
         # copy-commit complete prompt blocks beyond the match into the
         # prefix cache
         for blk in r.seq.blocks[r.matched_blocks:]:
@@ -1737,6 +1914,8 @@ class TpuEngine:
             block = False  # only force at most one blocking wait
 
     def _consume_entry(self, entry: _Entry) -> None:
+        if entry.kind in ("round", "spec") and entry.t_dispatch:
+            self._h_round.observe(time.monotonic() - entry.t_dispatch)
         data = np.asarray(entry.handle)
         if entry.kind == "first":
             lp = None
@@ -1770,6 +1949,8 @@ class TpuEngine:
             return
         if r.first_token_time is None:
             r.first_token_time = time.monotonic()
+            r.t_last_emit = r.first_token_time
+            self._h_ttft.observe(r.first_token_time - r.enqueue_time)
         sc = r.req.stop_conditions
         if not sc.ignore_eos and tok in (sc.stop_token_ids or []) and (
             sc.min_tokens is None or r.produced >= sc.min_tokens
@@ -1824,14 +2005,14 @@ class TpuEngine:
                     )
                 if finish is not None:
                     break
+            if batch:
+                self._note_emit(r, len(batch), entry, "decode_round")
             if batch or finish is not None:
                 extra = {}
                 if lp_chosen:
                     extra = {"log_probs": lp_chosen, "top_logprobs": lp_top}
                 if finish is not None:
-                    ann = self._spec_annotations(r)
-                    if ann:  # de-speculated requests finishing here
-                        extra["annotations"] = ann
+                    extra["annotations"] = self._final_annotations(r)
                 r.emit(LLMEngineOutput(
                     token_ids=batch, finish_reason=finish, **extra
                 ))
@@ -1881,7 +2062,7 @@ class TpuEngine:
         if reason is not None:
             r.emit(LLMEngineOutput(
                 token_ids=[], finish_reason=reason,
-                annotations=self._spec_annotations(r),
+                annotations=self._final_annotations(r),
             ))
         self._to_release.append(r)
 

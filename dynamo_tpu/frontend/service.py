@@ -44,6 +44,13 @@ from dynamo_tpu.protocols.openai import (
     model_list_response,
 )
 from dynamo_tpu.protocols.sse import encode_done, encode_event
+from dynamo_tpu.telemetry import (
+    TRACES,
+    TelemetryRegistry,
+    request_histograms,
+)
+from dynamo_tpu.telemetry import metrics as tmetrics
+from dynamo_tpu.telemetry.trace import span_now
 
 log = logging.getLogger(__name__)
 
@@ -96,6 +103,72 @@ class _ApiError(Exception):
         self.etype = etype
 
 
+class _RequestTiming:
+    """Per-request latency bookkeeping shared by the unary and streaming
+    paths: frontend-observed TTFT / per-token ITL gaps / E2E into the
+    service histograms, and worker-side trace spans merged into the
+    trace store."""
+
+    def __init__(self, svc: "HttpService", request_id: str, t_start: float):
+        self.svc = svc
+        self.rid = request_id
+        self.t_start = t_start
+        self.t_first: dict[int, float] = {}
+        self.t_last: dict[int, float] = {}
+        self.tok_counts: dict[int, int] = {}
+        self.gaps: list[tuple[float, int]] = []   # (gap_s, n) all streams
+        self._finished = False
+
+    def on_output(self, i: int, out: LLMEngineOutput) -> None:
+        if out.token_ids:
+            now = time.monotonic()
+            prev = self.t_last.get(i)
+            n = len(out.token_ids)
+            if prev is not None:
+                gap = (now - prev) / n
+                self.svc._h_itl.observe(gap, n)
+                if len(self.gaps) < 4096:  # percentile fidelity cap
+                    self.gaps.append((gap, n))
+            self.t_last[i] = now
+            self.t_first.setdefault(i, now)
+            self.tok_counts[i] = self.tok_counts.get(i, 0) + n
+        spans = ((out.annotations or {}).get("trace") or {}).get("spans")
+        if spans:
+            TRACES.merge(self.rid, spans)
+
+    @property
+    def ttft(self) -> Optional[float]:
+        if not self.t_first:
+            return None
+        return min(self.t_first.values()) - self.t_start
+
+    def itl_avg(self) -> Optional[float]:
+        # per generation, not the n-way interleave
+        itls = [
+            (self.t_last[i] - self.t_first[i]) / (self.tok_counts[i] - 1)
+            for i in self.t_first
+            if self.tok_counts.get(i, 0) > 1
+        ]
+        return sum(itls) / len(itls) if itls else None
+
+    def itl_percentile(self, q: float) -> Optional[float]:
+        return tmetrics.weighted_percentile(self.gaps, q)
+
+    def finish(self) -> None:
+        """Observe the request-level histograms (once). Runs from the
+        finally paths too — a client that disconnects mid-stream already
+        contributed ITL gaps, so TTFT/E2E must count it as well; a
+        request that never produced a token contributes to none of the
+        three series (counts stay mutually consistent)."""
+        if self._finished:
+            return
+        self._finished = True
+        if not self.t_first:
+            return
+        self.svc._h_ttft.observe(self.ttft)
+        self.svc._h_e2e.observe(time.monotonic() - self.t_start)
+
+
 class HttpService:
     """The OpenAI-compatible frontend over a ModelManager."""
 
@@ -114,6 +187,12 @@ class HttpService:
         self.host = host
         self.port = port
         self.metrics = ServiceMetrics()
+        # request-latency histograms (TTFT / ITL / E2E), observed at the
+        # frontend's measurement points and appended to /metrics
+        self.telemetry = request_histograms(TelemetryRegistry())
+        self._h_ttft = self.telemetry.get(tmetrics.TTFT[0])
+        self._h_itl = self.telemetry.get(tmetrics.ITL[0])
+        self._h_e2e = self.telemetry.get(tmetrics.E2E[0])
         self.app = web.Application()
         self.app.add_routes(
             [
@@ -126,6 +205,9 @@ class HttpService:
                 web.get("/live", self.handle_health),
                 web.get("/metrics", self.handle_metrics),
                 web.post("/clear_kv_blocks", self.handle_clear_kv),
+                web.get("/debug/trace", self.handle_trace_index),
+                web.get("/debug/trace/{request_id}", self.handle_trace),
+                web.get("/debug/flight", self.handle_flight),
             ]
         )
         self._runner: Optional[web.AppRunner] = None
@@ -162,9 +244,39 @@ class HttpService:
         return web.json_response(model_list_response(self.manager.list_models()))
 
     async def handle_metrics(self, request: web.Request) -> web.Response:
+        body = self.metrics.render() + self.telemetry.render().encode()
         return web.Response(
-            body=self.metrics.render(), content_type=CONTENT_TYPE_LATEST.split(";")[0]
+            body=body, content_type=CONTENT_TYPE_LATEST.split(";")[0]
         )
+
+    # ------------------------------------------------------------------
+    # debug plane: span trees + flight recorders of in-process engines
+
+    async def handle_trace_index(self, request: web.Request) -> web.Response:
+        return web.json_response({"recent": TRACES.recent_ids()})
+
+    async def handle_trace(self, request: web.Request) -> web.Response:
+        rid = request.match_info["request_id"]
+        tr = TRACES.get(rid)
+        if tr is None:
+            return web.json_response(
+                {"error": f"no trace for request {rid!r}"}, status=404
+            )
+        return web.json_response(tr.to_dict())
+
+    async def handle_flight(self, request: web.Request) -> web.Response:
+        """Flight rings of every local engine (keyed by model). Remote
+        workers serve their own at the per-worker system server."""
+        out = {}
+        for name in self.manager.list_models():
+            engine = self.manager.get(name).engine
+            flight = getattr(engine, "flight", None)
+            if flight is not None:
+                out[name] = {
+                    "recorded_total": flight.recorded_total,
+                    "events": flight.snapshot(),
+                }
+        return web.json_response({"engines": out})
 
     async def handle_clear_kv(self, request: web.Request) -> web.Response:
         from dynamo_tpu.runtime.remote_engine import invoke_clear
@@ -446,10 +558,20 @@ class HttpService:
             env["model"] = req.model
             chain = self._resolve_model(req.model, chat=chat,
                                         completion=not chat)
+            t_tok = time.monotonic()
             try:
                 pre = chain.preprocess(req)
             except ValueError as e:
                 raise _ApiError(400, str(e))
+            # trace context: minted here, keyed by the engine-facing
+            # request id (it travels through the runtime protocol to the
+            # router and worker; their spans come back via output
+            # annotations and merge into this tree — /debug/trace/{id})
+            trace = TRACES.start(pre.request_id)
+            trace.add(span_now(
+                "tokenize", t_tok,
+                model=req.model, prompt_tokens=len(pre.token_ids),
+            ))
 
             self.metrics.inflight.labels(req.model).inc()
             try:
@@ -457,9 +579,11 @@ class HttpService:
                     return await self._stream_response(
                         request, req, chain, pre, chat,
                         t_received=env["t0"])
-                return await self._unary_response(req, chain, pre, chat)
+                return await self._unary_response(
+                    req, chain, pre, chat, t_received=env["t0"])
             finally:
                 self.metrics.inflight.labels(req.model).dec()
+                TRACES.finish(pre.request_id)
 
         return await self._run_endpoint(request, endpoint, run)
 
@@ -470,17 +594,24 @@ class HttpService:
         streams = []
         for i in range(n):
             p = pre if n == 1 else _with_choice_seed(pre, i)
+            if p.request_id != pre.request_id:
+                # extra choices get fresh request ids — alias them so
+                # their route/worker spans land on the parent's tree
+                TRACES.alias(p.request_id, pre.request_id)
             streams.append(chain.generate(p))
         return streams
 
     async def _unary_response(
-        self, req, chain, pre, chat: bool
+        self, req, chain, pre, chat: bool,
+        t_received: Optional[float] = None,
     ) -> web.Response:
         streams = self._fanout(req, chain, pre)
         texts = [""] * len(streams)
         tokens = [0] * len(streams)
         finishes: list[FinishReason] = [FinishReason.EOS] * len(streams)
         lp_entries: list[list[dict]] = [[] for _ in streams]
+        t_start = t_received if t_received is not None else time.monotonic()
+        timing = _RequestTiming(self, pre.request_id, t_start)
 
         async def drain(i: int) -> None:
             try:
@@ -488,6 +619,7 @@ class HttpService:
                     if out.text:
                         texts[i] += out.text
                     tokens[i] += len(out.token_ids)
+                    timing.on_output(i, out)
                     if out.logprob_entries:
                         lp_entries[i].extend(out.logprob_entries)
                     if out.finish_reason is not None:
@@ -497,12 +629,16 @@ class HttpService:
                 if close is not None:
                     await close()
 
-        results = await asyncio.gather(
-            *[drain(i) for i in range(len(streams))], return_exceptions=True
-        )
-        for r in results:
-            if isinstance(r, BaseException):
-                raise r
+        try:
+            results = await asyncio.gather(
+                *[drain(i) for i in range(len(streams))],
+                return_exceptions=True,
+            )
+            for r in results:
+                if isinstance(r, BaseException):
+                    raise r
+        finally:
+            timing.finish()
         if chat:
             choices = []
             for i in range(len(streams)):
@@ -557,7 +693,9 @@ class HttpService:
                 prompt_tokens=len(pre.token_ids),
                 completion_tokens=sum(tokens),
             )
-        return web.json_response(body)
+        return web.json_response(
+            body, headers={"X-Request-Id": pre.request_id}
+        )
 
     async def _stream_response(
         self, request: web.Request, req, chain, pre, chat: bool,
@@ -569,6 +707,8 @@ class HttpService:
                 "Content-Type": "text/event-stream",
                 "Cache-Control": "no-cache",
                 "Connection": "keep-alive",
+                # the trace key: GET /debug/trace/{this} after the stream
+                "X-Request-Id": pre.request_id,
             },
         )
         await resp.prepare(request)
@@ -585,9 +725,7 @@ class HttpService:
         # (envelope entry — includes preprocess/route time, matching the
         # reference's measurement point)
         t_start = t_received if t_received is not None else time.monotonic()
-        t_first: dict[int, float] = {}
-        t_last: dict[int, float] = {}
-        tok_counts: dict[int, int] = {}
+        timing = _RequestTiming(self, pre.request_id, t_start)
         # tool-call detection: hold back tool-shaped text until it parses
         tool_accs: dict[int, Any] = {}
         if chat and getattr(req, "tools", None):
@@ -632,10 +770,7 @@ class HttpService:
                         encode_event({"error": {"message": str(item)}})
                     )
                     continue
-                if item.token_ids:
-                    t_last[i] = time.monotonic()
-                    t_first.setdefault(i, t_last[i])
-                    tok_counts[i] = tok_counts.get(i, 0) + len(item.token_ids)
+                timing.on_output(i, item)
                 completion_tokens += len(item.token_ids)
                 text = item.text or ""
                 if i in tool_accs and text:
@@ -677,19 +812,20 @@ class HttpService:
                     )
                 )
             if want_llm_metrics:
-                ttft = (min(t_first.values()) - t_start) if t_first else None
-                itls = [
-                    (t_last[i] - t_first[i]) / (tok_counts[i] - 1)
-                    for i in t_first
-                    if tok_counts.get(i, 0) > 1
-                ]
-                itl = sum(itls) / len(itls) if itls else None
+                ttft = timing.ttft
+                itl = timing.itl_avg()
+                itl_p50 = timing.itl_percentile(0.50)
+                itl_p95 = timing.itl_percentile(0.95)
                 await resp.write(encode_event({
                     "nvext": {"annotation": "llm_metrics", "metrics": {
                         "prompt_tokens": len(pre.token_ids),
                         "completion_tokens": completion_tokens,
                         "ttft_s": round(ttft, 6) if ttft is not None else None,
                         "itl_avg_s": round(itl, 6) if itl is not None else None,
+                        "itl_p50_s": round(itl_p50, 6)
+                        if itl_p50 is not None else None,
+                        "itl_p95_s": round(itl_p95, 6)
+                        if itl_p95 is not None else None,
                     }}
                 }))
             await resp.write(encode_done())
@@ -702,6 +838,9 @@ class HttpService:
             log.info("request cancelled mid-stream")
             raise
         finally:
+            # disconnect/cancel paths too: tokens already streamed must
+            # count in TTFT/E2E alongside their observed ITL gaps
+            timing.finish()
             for t in tasks:
                 t.cancel()
             for s in streams:

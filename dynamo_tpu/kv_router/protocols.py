@@ -94,6 +94,11 @@ class ForwardPassMetrics:
     worker_id: str = ""
     worker_stats: WorkerStats = field(default_factory=WorkerStats)
     kv_stats: KvStats = field(default_factory=KvStats)
+    # latency histogram snapshots (telemetry/metrics.py Histogram wire
+    # form: name -> {help, buckets, counts, sum, count}) — how TTFT/ITL
+    # distributions reach the aggregating exporter without a second
+    # transport; empty when the worker exports none
+    histograms: dict[str, Any] = field(default_factory=dict)
 
     def to_dict(self) -> dict[str, Any]:
         return asdict(self)
@@ -103,4 +108,5 @@ class ForwardPassMetrics:
         d = dict(d)
         d["worker_stats"] = WorkerStats(**d.get("worker_stats") or {})
         d["kv_stats"] = KvStats(**d.get("kv_stats") or {})
+        d.setdefault("histograms", {})
         return cls(**d)

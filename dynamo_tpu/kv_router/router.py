@@ -11,6 +11,7 @@ engines, mockers, or remote endpoint clients from the distributed runtime.
 from __future__ import annotations
 
 import logging
+import time
 from typing import Any, AsyncIterator, Callable, Optional
 
 from dynamo_tpu.kv_router.indexer import KvIndexer, WorkerId
@@ -23,6 +24,7 @@ from dynamo_tpu.kv_router.scheduler import (
 )
 from dynamo_tpu.kv_router.sequence import ActiveSequencesMultiWorker
 from dynamo_tpu.protocols.common import LLMEngineOutput, PreprocessedRequest
+from dynamo_tpu.telemetry.trace import TRACES, span_now
 from dynamo_tpu.tokens import TokenBlockSequence
 
 log = logging.getLogger(__name__)
@@ -129,10 +131,19 @@ class KvPushRouter:
         for attempt in range(attempts):
             if not self.workers:
                 break
+            t_route = time.monotonic()
             worker_id, overlap = self.router.find_best_match(
                 rid, request.token_ids, salt=request.model
             )
             request.estimated_prefix_hit_num_blocks = overlap
+            # trace context: the routing decision + KV-match score, onto
+            # the frontend's span tree when it lives in this process
+            # (no-op otherwise; see telemetry/trace.py)
+            TRACES.add_span(rid, span_now(
+                "route", t_route,
+                worker=str(worker_id), overlap_blocks=overlap,
+                attempt=attempt,
+            ))
             engine = self.workers.get(worker_id)
             if engine is None:  # scheduler raced a removal
                 self.router.free(rid)

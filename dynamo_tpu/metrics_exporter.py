@@ -12,6 +12,8 @@ Exposed (all labelled by worker):
   dynamo_kv_host_blocks / host_onboard_hits
   dynamo_spec_proposed_total / accepted_total / acceptance_rate
   dynamo_spec_effective_k (mean adaptive K over speculating slots)
+  dynamo_request_{ttft,itl,e2e,queue}_seconds / dynamo_engine_round_seconds
+      (latency histograms shipped inside ForwardPassMetrics.histograms)
 Run: ``dynamo-tpu metrics --control-plane HOST:PORT --port 9090``.
 """
 from __future__ import annotations
@@ -27,6 +29,7 @@ from dynamo_tpu.kv_router.metrics_aggregator import MetricsAggregator
 from dynamo_tpu.kv_router.protocols import ForwardPassMetrics
 from dynamo_tpu.runtime.client import KvClient
 from dynamo_tpu.runtime.publisher import METRICS_TOPIC
+from dynamo_tpu.telemetry.metrics import render_histogram
 
 log = logging.getLogger(__name__)
 
@@ -81,11 +84,16 @@ class MetricsExporter:
         snap = self.aggregator.snapshot()
         lines: list[str] = []
 
-        def gauge(name: str, help_: str, values: dict[str, float]) -> None:
+        def gauge(name: str, help_: str, values) -> None:
+            """Emit one gauge family with HELP/TYPE; ``values`` is either
+            a worker->value dict (labelled series) or a scalar."""
             lines.append(f"# HELP {name} {help_}")
             lines.append(f"# TYPE {name} gauge")
-            for worker, v in sorted(values.items()):
-                lines.append(f'{name}{{worker="{worker}"}} {v}')
+            if isinstance(values, dict):
+                for worker, v in sorted(values.items()):
+                    lines.append(f'{name}{{worker="{worker}"}} {v}')
+            else:
+                lines.append(f"{name} {values}")
 
         gauge("dynamo_worker_active_slots", "requests in decode slots",
               {w: m.worker_stats.request_active_slots
@@ -129,7 +137,27 @@ class MetricsExporter:
               "mean acceptance-adaptive effective K over speculating slots",
               {w: m.worker_stats.spec_effective_k
                for w, m in snap.metrics.items()})
-        lines.append(f"dynamo_metrics_workers {len(snap.metrics)}")
+        # latency histograms shipped inside ForwardPassMetrics: one
+        # HELP/TYPE block per family, all workers' labelled series under
+        # it (the Prometheus text-format grouping requirement)
+        families: dict[str, dict[str, dict]] = {}
+        for w, m in snap.metrics.items():
+            for name, hsnap in (getattr(m, "histograms", None) or {}).items():
+                families.setdefault(name, {})[w] = hsnap
+        for name in sorted(families):
+            per_worker = families[name]
+            first = next(iter(per_worker.values()))
+            help_ = first.get("help", name)
+            lines.append(f"# HELP {name} {help_}")
+            lines.append(f"# TYPE {name} histogram")
+            for w in sorted(per_worker):
+                # render_histogram's own HELP/TYPE head is dropped: it
+                # must appear once per family, not once per worker
+                lines.extend(render_histogram(
+                    name, help_, per_worker[w], label=f'worker="{w}"',
+                )[2:])
+        gauge("dynamo_metrics_workers",
+              "workers in the last load-plane snapshot", len(snap.metrics))
         return "\n".join(lines) + "\n"
 
     async def handle_metrics(self, request: web.Request) -> web.Response:

@@ -1,9 +1,16 @@
-"""Per-process system HTTP server: /metrics + /health on every worker.
+"""Per-process system HTTP server: /metrics + /health + /debug on every
+worker.
 
 Parity: reference lib/runtime/src/http_server.rs:27-45,91 — each process
 exposes its own Prometheus endpoint (uptime + process-local stats) so
 operators can scrape workers directly, independent of the frontend's
-service metrics and the standalone re-exporter.
+service metrics and the standalone re-exporter. On top of the gauges this
+renders the engine's latency histograms (telemetry/metrics.py) and serves
+the debug plane:
+
+  /debug/flight               recent engine-round events (flight ring)
+  /debug/trace/{request_id}   this worker's span tree for a request
+  /debug/trace                recent completed trace ids
 """
 from __future__ import annotations
 
@@ -13,13 +20,17 @@ from typing import Any, Optional
 
 from aiohttp import web
 
+from dynamo_tpu.telemetry import TRACES
+from dynamo_tpu.telemetry.metrics import render_histogram
+
 log = logging.getLogger(__name__)
 
 
 class SystemServer:
     """Tiny per-process observability server. `engine` is optional: when
-    it exposes `metrics()` (ForwardPassMetrics), those gauges are
-    rendered alongside uptime."""
+    it exposes `metrics()` (ForwardPassMetrics), those gauges — and any
+    histogram snapshots it carries — are rendered alongside uptime; when
+    it exposes `flight`, the ring serves at /debug/flight."""
 
     def __init__(
         self,
@@ -40,6 +51,9 @@ class SystemServer:
             web.get("/metrics", self.handle_metrics),
             web.get("/health", self.handle_health),
             web.get("/live", self.handle_health),
+            web.get("/debug/flight", self.handle_flight),
+            web.get("/debug/trace", self.handle_trace_index),
+            web.get("/debug/trace/{request_id}", self.handle_trace),
         ])
 
     async def start(self) -> "SystemServer":
@@ -105,6 +119,13 @@ class SystemServer:
                 g("dynamo_spec_effective_k",
                   "mean acceptance-adaptive effective K over "
                   "speculating slots", ws.spec_effective_k)
+                for name, snap in sorted(
+                    (getattr(m, "histograms", None) or {}).items()
+                ):
+                    lines.extend(render_histogram(
+                        name, snap.get("help", name), snap,
+                        label=f'worker="{w}"',
+                    ))
         return "\n".join(lines) + "\n"
 
     async def handle_metrics(self, request: web.Request) -> web.Response:
@@ -116,3 +137,27 @@ class SystemServer:
             "uptime_s": round(time.monotonic() - self._started, 3),
             "worker_id": self.worker_id,
         })
+
+    async def handle_flight(self, request: web.Request) -> web.Response:
+        flight = getattr(self.engine, "flight", None)
+        if flight is None:
+            return web.json_response(
+                {"error": "engine exposes no flight recorder"}, status=404
+            )
+        return web.json_response({
+            "worker_id": self.worker_id,
+            "recorded_total": flight.recorded_total,
+            "events": flight.snapshot(),
+        })
+
+    async def handle_trace_index(self, request: web.Request) -> web.Response:
+        return web.json_response({"recent": TRACES.recent_ids()})
+
+    async def handle_trace(self, request: web.Request) -> web.Response:
+        rid = request.match_info["request_id"]
+        tr = TRACES.get(rid)
+        if tr is None:
+            return web.json_response(
+                {"error": f"no trace for request {rid!r}"}, status=404
+            )
+        return web.json_response(tr.to_dict())

@@ -59,6 +59,14 @@ class RequestStats:
     # speculative decoding (zero when the request didn't speculate)
     spec_proposed: int = 0
     spec_accepted: int = 0
+    # per-request timing from the engine's finishing annotation
+    # (annotations["timing"], telemetry plane) — None when the engine
+    # exported none (e.g. the echo/mocker engines)
+    ttft_s: Optional[float] = None
+    itl_p50_s: Optional[float] = None
+    itl_p95_s: Optional[float] = None
+    e2e_s: Optional[float] = None
+    queue_s: Optional[float] = None
 
     @property
     def spec_acceptance_rate(self) -> Optional[float]:
@@ -87,6 +95,12 @@ def request_stats(outputs: Iterable[Any]) -> RequestStats:
         if spec:
             st.spec_proposed = int(spec.get("proposed", 0))
             st.spec_accepted = int(spec.get("accepted", 0))
+        timing = ann.get("timing")
+        if timing:
+            for key in ("ttft_s", "itl_p50_s", "itl_p95_s", "e2e_s",
+                        "queue_s"):
+                if timing.get(key) is not None:
+                    setattr(st, key, float(timing[key]))
     return st
 
 

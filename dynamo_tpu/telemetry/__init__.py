@@ -1,0 +1,40 @@
+"""Request-lifecycle telemetry: trace spans, latency histograms, and the
+per-worker flight recorder.
+
+Parity: the reference Dynamo stack's observability plane (Prometheus +
+Grafana dashboards fed by per-worker ForwardPassMetrics, request
+annotations carrying per-request timings, and the planner consuming the
+resulting distributions). Three pieces:
+
+  trace.py    trace context minted at the frontend, spans recorded at
+              every pipeline stage, worker spans returned in-band via
+              output annotations and merged into one tree served at
+              ``/debug/trace/{request_id}``
+  metrics.py  explicit-bucket Prometheus histograms (TTFT / ITL / E2E /
+              queue wait / engine round) rendered by the frontend, the
+              per-worker system server, and the aggregating exporter
+  flight.py   fixed-size ring of recent engine-round events served at
+              ``/debug/flight`` and dumped to the log on engine failure
+"""
+from dynamo_tpu.telemetry.flight import FlightRecorder
+from dynamo_tpu.telemetry.metrics import (
+    DEFAULT_TIME_BUCKETS,
+    Histogram,
+    TelemetryRegistry,
+    percentile_from_snapshot,
+    request_histograms,
+)
+from dynamo_tpu.telemetry.trace import TRACES, Span, Trace, TraceStore
+
+__all__ = [
+    "DEFAULT_TIME_BUCKETS",
+    "FlightRecorder",
+    "Histogram",
+    "Span",
+    "TelemetryRegistry",
+    "Trace",
+    "TraceStore",
+    "TRACES",
+    "percentile_from_snapshot",
+    "request_histograms",
+]

@@ -1,0 +1,233 @@
+"""Explicit-bucket Prometheus histograms for the request latency plane.
+
+Hand-rolled rather than prometheus_client because the engine thread
+observes them, three different servers render them (frontend, per-worker
+system server, aggregating exporter), and their SNAPSHOTS must travel
+inside ForwardPassMetrics across the pub/sub plane — a plain
+dict-of-counts representation does all three; a client registry does
+none of them cleanly.
+
+Buckets follow the Prometheus contract: ``le``-labelled CUMULATIVE
+counts with a ``+Inf`` terminal bucket, plus ``_sum`` and ``_count``.
+"""
+from __future__ import annotations
+
+import math
+import threading
+from typing import Any, Optional
+
+# decode steps run ~1-100 ms, TTFT ~10 ms-10 s, E2E up to minutes: a
+# 1-2-3.5-5-7.5 per-decade ladder covers every request-latency series.
+# Resolution matters beyond dashboards — bench.py reports percentiles
+# interpolated from these buckets, so each step is kept under ~1.6x
+# (a within-bucket shift quantizes to at most that).
+DEFAULT_TIME_BUCKETS = (
+    0.0005, 0.001, 0.002, 0.0035, 0.005, 0.0075,
+    0.01, 0.02, 0.035, 0.05, 0.075,
+    0.1, 0.2, 0.35, 0.5, 0.75,
+    1.0, 2.0, 3.5, 5.0, 7.5,
+    10.0, 20.0, 35.0, 60.0, 120.0,
+)
+
+
+class Histogram:
+    """One histogram series (no labels — renderers attach the worker
+    label). Thread-safe: observed from the engine thread, rendered from
+    asyncio handlers."""
+
+    def __init__(
+        self,
+        name: str,
+        help_: str,
+        buckets: tuple[float, ...] = DEFAULT_TIME_BUCKETS,
+    ):
+        self.name = name
+        self.help = help_
+        self.buckets = tuple(sorted(buckets))
+        self._counts = [0] * (len(self.buckets) + 1)  # last = +Inf
+        self._sum = 0.0
+        self._count = 0
+        self._lock = threading.Lock()
+
+    def observe(self, value: float, n: int = 1) -> None:
+        """Record ``value`` ``n`` times (n>1: a batch of identical
+        observations, e.g. per-token gaps derived from one round)."""
+        if n <= 0 or not math.isfinite(value):
+            return
+        i = len(self.buckets)
+        for j, b in enumerate(self.buckets):
+            if value <= b:
+                i = j
+                break
+        with self._lock:
+            self._counts[i] += n
+            self._sum += value * n
+            self._count += n
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    @property
+    def sum(self) -> float:
+        return self._sum
+
+    def snapshot(self) -> dict[str, Any]:
+        """Wire form: cumulative counts aligned with ``buckets`` + +Inf."""
+        with self._lock:
+            cum = []
+            total = 0
+            for c in self._counts:
+                total += c
+                cum.append(total)
+            return {
+                "buckets": list(self.buckets),
+                "counts": cum,        # cumulative, last entry == count
+                "sum": self._sum,
+                "count": self._count,
+            }
+
+    def percentile(self, q: float) -> Optional[float]:
+        return percentile_from_snapshot(self.snapshot(), q)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counts = [0] * (len(self.buckets) + 1)
+            self._sum = 0.0
+            self._count = 0
+
+    def render(self, label: str = "") -> list[str]:
+        return render_histogram(self.name, self.help, self.snapshot(), label)
+
+
+def percentile_from_snapshot(
+    snap: dict[str, Any], q: float
+) -> Optional[float]:
+    """Estimate the q-th percentile (0..1) from cumulative bucket counts
+    by linear interpolation inside the target bucket (the standard
+    ``histogram_quantile`` estimator). None when empty; observations in
+    the +Inf bucket clamp to the top finite edge."""
+    total = snap.get("count", 0)
+    buckets = snap.get("buckets") or []
+    counts = snap.get("counts") or []
+    if not total or not buckets or len(counts) != len(buckets) + 1:
+        return None
+    rank = q * total
+    prev_cum = 0
+    lo = 0.0
+    for edge, cum in zip(buckets, counts[:-1]):
+        if rank <= cum:
+            in_bucket = cum - prev_cum
+            frac = (rank - prev_cum) / in_bucket if in_bucket else 0.0
+            return lo + (edge - lo) * frac
+        prev_cum = cum
+        lo = edge
+    return buckets[-1]
+
+
+def weighted_percentile(
+    pairs: list, q: float
+) -> Optional[float]:
+    """q-th percentile (0..1) over (value, weight) pairs — the
+    per-request ITL estimator shared by the engine's timing annotation
+    and the frontend's llm_metrics event."""
+    if not pairs:
+        return None
+    pairs = sorted(pairs)
+    total = sum(n for _, n in pairs)
+    if total <= 0:
+        return None
+    rank = q * total
+    seen = 0
+    for value, n in pairs:
+        seen += n
+        if seen >= rank:
+            return value
+    return pairs[-1][0]
+
+
+def render_histogram(
+    name: str, help_: str, snap: dict[str, Any], label: str = ""
+) -> list[str]:
+    """Prometheus text-format lines for one snapshot. ``label`` is a
+    pre-rendered extra label pair (e.g. ``worker="w0"``) or empty."""
+
+    def fmt(le: str) -> str:
+        pairs = f'le="{le}"' if not label else f'{label},le="{le}"'
+        return f"{name}_bucket{{{pairs}}}"
+
+    lines = [f"# HELP {name} {help_}", f"# TYPE {name} histogram"]
+    for edge, cum in zip(snap["buckets"], snap["counts"][:-1]):
+        lines.append(f"{fmt(repr(float(edge)))} {cum}")
+    lines.append(f"{fmt('+Inf')} {snap['counts'][-1]}")
+    suffix = f"{{{label}}}" if label else ""
+    lines.append(f"{name}_sum{suffix} {snap['sum']}")
+    lines.append(f"{name}_count{suffix} {snap['count']}")
+    return lines
+
+
+class TelemetryRegistry:
+    """Ordered set of histograms with one render/snapshot surface."""
+
+    def __init__(self) -> None:
+        self._hists: dict[str, Histogram] = {}
+
+    def histogram(
+        self,
+        name: str,
+        help_: str,
+        buckets: tuple[float, ...] = DEFAULT_TIME_BUCKETS,
+    ) -> Histogram:
+        h = self._hists.get(name)
+        if h is None:
+            h = self._hists[name] = Histogram(name, help_, buckets)
+        return h
+
+    def get(self, name: str) -> Optional[Histogram]:
+        return self._hists.get(name)
+
+    def snapshot(self) -> dict[str, dict[str, Any]]:
+        """name -> {help, buckets, counts, sum, count} — the wire form
+        carried in ForwardPassMetrics.histograms."""
+        return {
+            name: dict(h.snapshot(), help=h.help)
+            for name, h in self._hists.items()
+        }
+
+    def render(self, label: str = "") -> str:
+        lines: list[str] = []
+        for h in self._hists.values():
+            lines.extend(h.render(label))
+        return "\n".join(lines) + ("\n" if lines else "")
+
+    def reset(self) -> None:
+        for h in self._hists.values():
+            h.reset()
+
+
+# canonical request-latency series (names are the metrics contract —
+# tests/test_metrics_contract.py asserts they render with HELP/TYPE and
+# are documented in README)
+TTFT = ("dynamo_request_ttft_seconds",
+        "time from request receipt to first emitted token")
+ITL = ("dynamo_request_itl_seconds",
+       "inter-token latency (per-token gaps within one generation)")
+E2E = ("dynamo_request_e2e_seconds",
+       "end-to-end request latency (receipt to finish)")
+QUEUE = ("dynamo_request_queue_seconds",
+         "admission queue wait (enqueue to prefill start)")
+ROUND = ("dynamo_engine_round_seconds",
+         "engine round wall time (dispatch to result processed)")
+
+
+def request_histograms(
+    reg: TelemetryRegistry, *, engine: bool = False
+) -> TelemetryRegistry:
+    """Install the canonical request series on ``reg``. ``engine=True``
+    adds the engine-only series (queue wait, round time)."""
+    for name, help_ in (TTFT, ITL, E2E):
+        reg.histogram(name, help_)
+    if engine:
+        for name, help_ in (QUEUE, ROUND):
+            reg.histogram(name, help_)
+    return reg

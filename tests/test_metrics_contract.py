@@ -1,0 +1,150 @@
+"""Metrics contract: every ``dynamo_*`` series rendered by the system
+server, the aggregating exporter, and the frontend must carry HELP/TYPE
+metadata and be documented in README's Observability section — the
+scrape surfaces and the docs cannot drift apart silently.
+"""
+import os
+import re
+
+from dynamo_tpu.kv_router.protocols import (
+    ForwardPassMetrics,
+    KvStats,
+    WorkerStats,
+)
+from dynamo_tpu.telemetry import TelemetryRegistry, request_histograms
+
+README = os.path.join(os.path.dirname(__file__), "..", "README.md")
+
+# sample-name suffixes that belong to a declared family rather than
+# being families themselves (histogram series + prometheus_client extras)
+_SUFFIXES = ("_bucket", "_sum", "_count", "_total", "_created")
+
+
+class _StubEngine:
+    """Engine double: gauges + populated histogram snapshots."""
+
+    def __init__(self):
+        self.telemetry = request_histograms(TelemetryRegistry(),
+                                            engine=True)
+        for h in ("dynamo_request_ttft_seconds",
+                  "dynamo_request_itl_seconds"):
+            self.telemetry.get(h).observe(0.1)
+
+    def metrics(self) -> ForwardPassMetrics:
+        return ForwardPassMetrics(
+            worker_id="w0",
+            worker_stats=WorkerStats(request_active_slots=1,
+                                     request_total_slots=4),
+            kv_stats=KvStats(kv_active_blocks=2, kv_total_blocks=8),
+            histograms=self.telemetry.snapshot(),
+        )
+
+
+def _parse_families(text: str):
+    """(declared families with both HELP and TYPE, sample names)."""
+    helped, typed, samples = set(), set(), set()
+    for line in text.splitlines():
+        if not line.strip():
+            continue
+        if line.startswith("# HELP "):
+            helped.add(line.split()[2])
+        elif line.startswith("# TYPE "):
+            typed.add(line.split()[2])
+        elif not line.startswith("#"):
+            samples.add(re.split(r"[{ ]", line, 1)[0])
+    return helped & typed, samples
+
+
+def _families_of(samples, declared):
+    """Map each sample name onto its declared family (or fail)."""
+    out = {}
+    for s in samples:
+        fam = None
+        if s in declared:
+            fam = s
+        else:
+            for suf in _SUFFIXES:
+                if s.endswith(suf) and s[: -len(suf)] in declared:
+                    fam = s[: -len(suf)]
+                    break
+                # prometheus_client counters: family "x_total" declares
+                # HELP/TYPE as x_total but _created samples are x_created
+                if s.endswith("_created") and (
+                    s[: -len("_created")] + "_total" in declared
+                ):
+                    fam = s[: -len("_created")] + "_total"
+                    break
+        assert fam is not None, f"sample {s!r} has no HELP/TYPE family"
+        out[s] = fam
+    return out
+
+
+def _assert_contract(text: str, readme: str):
+    declared, samples = _parse_families(text)
+    fams = _families_of(samples, declared)
+    for fam in set(fams.values()):
+        # prometheus_client exposes the *_created companion series as its
+        # own gauge family — documentation-wise it's part of the parent
+        if fam.endswith("_created"):
+            fam = fam[: -len("_created")]
+        if fam.startswith("dynamo_"):
+            assert fam in readme, f"{fam} not documented in README"
+
+
+def _readme_text() -> str:
+    with open(README) as f:
+        return f.read()
+
+
+def test_system_server_render_contract():
+    from dynamo_tpu.runtime.system_server import SystemServer
+
+    text = SystemServer(_StubEngine(), worker_id="w0").render()
+    # histograms made it into the per-worker render, worker-labelled
+    assert 'dynamo_request_ttft_seconds_bucket{worker="w0",le=' in text
+    _assert_contract(text, _readme_text())
+
+
+def test_exporter_render_contract():
+    from dynamo_tpu.metrics_exporter import MetricsExporter
+
+    exp = MetricsExporter(kv=None)
+    m = _StubEngine().metrics()
+    exp.aggregator.update(m)
+    m2 = _StubEngine().metrics()
+    m2.worker_id = "w1"
+    exp.aggregator.update(m2)
+    text = exp.render()
+    # the satellite fix: dynamo_metrics_workers now has HELP/TYPE
+    assert "# HELP dynamo_metrics_workers" in text
+    assert "# TYPE dynamo_metrics_workers gauge" in text
+    assert "dynamo_metrics_workers 2" in text
+    # one HELP/TYPE head per histogram family, both workers' series
+    assert text.count("# TYPE dynamo_request_ttft_seconds histogram") == 1
+    assert 'dynamo_request_ttft_seconds_count{worker="w0"}' in text
+    assert 'dynamo_request_ttft_seconds_count{worker="w1"}' in text
+    _assert_contract(text, _readme_text())
+
+
+def test_frontend_render_contract():
+    from dynamo_tpu.frontend.service import HttpService
+
+    svc = HttpService()
+    svc.metrics.requests_total.labels("m", "chat_completions", "200").inc()
+    svc.metrics.duration.labels("m").observe(0.1)
+    svc._h_ttft.observe(0.05)
+    text = svc.metrics.render().decode() + svc.telemetry.render()
+    _assert_contract(text, _readme_text())
+
+
+def test_readme_documents_canonical_series():
+    readme = _readme_text()
+    for name in (
+        "dynamo_request_ttft_seconds", "dynamo_request_itl_seconds",
+        "dynamo_request_e2e_seconds", "dynamo_request_queue_seconds",
+        "dynamo_engine_round_seconds", "dynamo_spec_acceptance_rate",
+        "dynamo_spec_effective_k", "dynamo_metrics_workers",
+    ):
+        assert name in readme, f"{name} missing from README"
+    for endpoint in ("/debug/trace", "/debug/flight"):
+        assert endpoint in readme

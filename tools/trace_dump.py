@@ -1,0 +1,97 @@
+#!/usr/bin/env python3
+"""Pretty-print a /debug/trace span tree.
+
+Usage:
+    python tools/trace_dump.py http://HOST:PORT/debug/trace/REQUEST_ID
+    python tools/trace_dump.py trace.json
+    curl -s .../debug/trace/ID | python tools/trace_dump.py -
+
+Renders the spans as a time-ordered tree with durations and attributes,
+e.g.::
+
+    trace 3f9c... (finished)
+      0.000s  tokenize          0.4ms   model=tiny prompt_tokens=19
+      0.001s  route             0.1ms   worker=1 overlap_blocks=0
+      0.002s  queue             0.2ms
+      0.003s  prefill          41.3ms   prompt_tokens=19 matched_blocks=0
+      0.045s  decode_round      5.1ms   tokens=4
+
+Offsets are relative to the earliest span start.
+"""
+from __future__ import annotations
+
+import json
+import sys
+from typing import Any
+
+
+def _fmt_dur(s: float) -> str:
+    if s >= 1.0:
+        return f"{s:7.3f}s "
+    return f"{s * 1e3:7.1f}ms"
+
+
+def _fmt_attrs(attrs: dict[str, Any]) -> str:
+    return " ".join(f"{k}={v}" for k, v in sorted(attrs.items()))
+
+
+def _walk(span: dict[str, Any], t0: float, depth: int,
+          out: list[str]) -> None:
+    pad = "  " * depth
+    out.append(
+        f"  {span.get('start_s', t0) - t0:7.3f}s  "
+        f"{pad}{span.get('name', '?'):<18}"
+        f"{_fmt_dur(float(span.get('duration_s', 0.0)))}"
+        f"   {_fmt_attrs(span.get('attrs') or {})}".rstrip()
+    )
+    for child in span.get("children") or []:
+        _walk(child, t0, depth + 1, out)
+
+
+def render_trace(trace: dict[str, Any]) -> str:
+    spans = sorted(
+        trace.get("spans") or [], key=lambda s: s.get("start_s", 0.0)
+    )
+    state = "finished" if trace.get("finished") else "in flight"
+    lines = [f"trace {trace.get('trace_id', '?')} ({state})"]
+    if not spans:
+        lines.append("  (no spans)")
+        return "\n".join(lines)
+    t0 = min(s.get("start_s", 0.0) for s in spans)
+    for span in spans:
+        _walk(span, t0, 0, lines)
+    total = max(
+        s.get("start_s", 0.0) + float(s.get("duration_s", 0.0))
+        for s in spans
+    ) - t0
+    lines.append(f"  total {_fmt_dur(total).strip()} across "
+                 f"{len(spans)} spans")
+    return "\n".join(lines)
+
+
+def load(source: str) -> dict[str, Any]:
+    if source == "-":
+        return json.load(sys.stdin)
+    if source.startswith(("http://", "https://")):
+        from urllib.request import urlopen
+
+        with urlopen(source, timeout=10) as resp:  # noqa: S310 — operator URL
+            return json.load(resp)
+    with open(source) as f:
+        return json.load(f)
+
+
+def main(argv: list[str]) -> int:
+    if len(argv) != 1 or argv[0] in ("-h", "--help"):
+        print(__doc__)
+        return 2
+    trace = load(argv[0])
+    if "error" in trace:
+        print(f"error: {trace['error']}", file=sys.stderr)
+        return 1
+    print(render_trace(trace))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))
