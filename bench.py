@@ -268,8 +268,10 @@ async def run_bench() -> dict:
 
 def _routing_mode_fields() -> dict:
     """BASELINE config-3 tracking (KV-aware routing TTFT, the reference's
-    3x headline): run the CPU mocker experiment in a subprocess so it
-    never touches the TPU run. Best-effort."""
+    3x headline) plus the resilience fault phase (mid-stream worker-death
+    recovery latency, tokens lost, migration counts): run the CPU mocker
+    experiments in a subprocess so they never touch the TPU run.
+    Best-effort."""
     import subprocess
     import sys
 
@@ -616,7 +618,12 @@ def main():
               "device_ms_per_step", "mfu",
               "roofline_frac", "chip", "params_m", "batch",
               "routing_kv_ttft_ms", "routing_random_ttft_ms",
-              "routing_ttft_speedup"):
+              "routing_ttft_speedup",
+              # fault phase (bench_modes.fault_experiment): mid-stream
+              # worker-death recovery latency + exactly-once accounting
+              "fault_requests", "fault_kills", "fault_migrations",
+              "fault_tokens_lost", "fault_recovery_p50_ms",
+              "fault_recovery_p95_ms"):
         v = stats.get(k)
         out[k] = round(v, 4) if isinstance(v, float) else v
     if (os.environ.get("DYNAMO_BENCH_EXTRA", "1") != "0"

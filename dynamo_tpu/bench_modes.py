@@ -8,6 +8,12 @@ reporting mean TTFT for each. Mockers simulate prefill cost proportional
 to the UNCACHED suffix (mocker.py), so routing turns onto warm workers is
 exactly what the experiment measures — CPU-only, seconds to run.
 
+``fault`` — the resilience experiment (reference fault-tolerance suite):
+streams under load with workers dying mid-stream; reports recovery
+latency p50/p95 (last-token-before-death to first-token-after, i.e. the
+re-route + replay-prefill cost the client observes), tokens lost (0 with
+migration's exactly-once replay), and migration counts.
+
 Run standalone (``python -m dynamo_tpu.bench_modes``) or via bench.py,
 which shells out with JAX_PLATFORMS=cpu and merges the JSON fields.
 """
@@ -107,8 +113,107 @@ async def routing_experiment(
     }
 
 
+class _AssassinEngine:
+    """Engine proxy that kills a stream mid-flight: after ``kill_after``
+    tokens of a not-yet-killed request, raise ConnectionError (the wire
+    shape of a worker dying). Each request is killed at most once
+    fleet-wide (``killed`` is shared), so the migrated replay survives."""
+
+    def __init__(self, inner, kill_after: int, killed: dict):
+        self.inner = inner
+        self.kill_after = kill_after
+        self.killed = killed  # rid -> kill wall time (shared across fleet)
+
+    async def generate(self, req):
+        rid = req.request_id
+        arm = rid not in self.killed
+        n = 0
+        async for out in self.inner.generate(req):
+            yield out
+            n += len(out.token_ids)
+            if arm and n >= self.kill_after:
+                self.killed[rid] = time.monotonic()
+                raise ConnectionError("bench fault: worker died mid-stream")
+
+    async def stop(self):
+        await self.inner.stop()
+
+
+async def fault_experiment(
+    n_workers: int = 3,
+    n_requests: int = 24,
+    prompt_tokens: int = 64,
+    out_tokens: int = 32,
+    kill_after: int = 8,
+    block_size: int = 16,
+) -> dict:
+    """Recovery latency + tokens lost under mid-stream worker death."""
+    from dynamo_tpu.kv_router.router import KvPushRouter, KvRouter
+    from dynamo_tpu.kv_router.scheduler import KvRouterConfig
+    from dynamo_tpu.mocker import MockerArgs, MockerEngine
+    from dynamo_tpu.protocols.common import (
+        PreprocessedRequest,
+        StopConditions,
+    )
+
+    rng = np.random.RandomState(11)
+    router = KvRouter(block_size, KvRouterConfig(router_temperature=0.0))
+    push = KvPushRouter(router)
+    killed: dict = {}
+    for i in range(n_workers):
+        eng = MockerEngine(MockerArgs(
+            num_pages=512, page_size=block_size, max_decode_slots=16,
+            worker_id=f"w{i}", speedup_ratio=10.0,
+        ), on_kv_event=router.indexer.apply_event)
+        push.add_worker(f"w{i}", _AssassinEngine(eng, kill_after, killed))
+
+    recoveries: list[float] = []
+    received = 0
+
+    async def one(_):
+        nonlocal received
+        req = PreprocessedRequest(
+            token_ids=rng.randint(1, 10_000, size=prompt_tokens).tolist(),
+            stop_conditions=StopConditions(max_tokens=out_tokens,
+                                           ignore_eos=True),
+        )
+        rid = req.request_id
+        n = 0
+        async for out in push.generate(req):
+            now = time.monotonic()
+            if out.token_ids and rid in killed and killed[rid] > 0:
+                recoveries.append(now - killed[rid])
+                killed[rid] = 0.0  # first post-death token seen
+            n += len(out.token_ids)
+        received += n
+
+    await asyncio.gather(*[one(i) for i in range(n_requests)])
+    for proxy in push.workers.values():
+        await proxy.stop()
+    recoveries.sort()
+    expected = n_requests * out_tokens
+
+    def pct(q):
+        if not recoveries:
+            return None
+        return round(
+            recoveries[min(len(recoveries) - 1,
+                           int(q * len(recoveries)))] * 1e3, 2
+        )
+
+    return {
+        "fault_requests": n_requests,
+        "fault_kills": len(killed),
+        "fault_migrations": push.migrations,
+        "fault_tokens_lost": expected - received,
+        "fault_recovery_p50_ms": pct(0.50),
+        "fault_recovery_p95_ms": pct(0.95),
+    }
+
+
 def main():
     out = asyncio.run(routing_experiment())
+    out.update(asyncio.run(fault_experiment()))
     print(json.dumps(out))
 
 

@@ -7,7 +7,10 @@ Subcommands:
          in=endpoint, frontends in=http discover models dynamically;
          out=tpu takes --speculative {off,ngram,draft},
          --num-speculative-tokens K, and --spec-adaptive {on,off} /
-         --spec-min-k for acceptance-adaptive speculative decoding)
+         --spec-min-k for acceptance-adaptive speculative decoding;
+         resilience: --chaos SPEC arms fault injection, --drain-timeout
+         bounds graceful drain (SIGTERM / POST /drain), frontends take
+         --trace-sample-rate for high-QPS trace sampling)
   cp    run the control-plane store (native dcp-server if built, else the
         wire-compatible Python fallback): cp --port 7111
   serve    launch a whole serving graph (store+workers+frontend) from a

@@ -261,10 +261,18 @@ class PrefillWorker:
             desc.host, desc.port, job.dst_pages[:n_send], data,
             job_id=job.request_id,
         )
+        from dynamo_tpu.telemetry.trace import span_now
+
         await self.rt.kv.qpush(job.done_queue, json.dumps({
             "ok": True,
             "blocks": n_send,
             "prefill_ms": (time.monotonic() - t0) * 1e3,
+            # the prefill worker's own span, folded into the decode
+            # side's trace payload (DisaggDecodeEngine.generate)
+            "span": span_now(
+                "remote_prefill", t0,
+                tokens=len(job.token_ids), blocks=n_send,
+            ).to_dict(),
         }))
         log.info(
             "remote prefill %s: %d tokens, %d blocks -> %s in %.1f ms",
@@ -302,6 +310,7 @@ class DisaggDecodeEngine:
         self.worker_id = worker_id
         self.conf = conf
         self.prefill_timeout_s = prefill_timeout_s
+        self._draining = False
         # live remote-prefill jobs: a write for a job not in here is
         # REJECTED — protects against a stale queued job scribbling over
         # pages that were freed on fallback and reallocated to another
@@ -316,6 +325,9 @@ class DisaggDecodeEngine:
         self.remote_prefills = 0
         self.local_prefills = 0
         self.remote_fallbacks = 0
+        # prefill-worker spans shipped back on the done queue, keyed by
+        # request id until generate() folds them into the trace payload
+        self._remote_spans: dict[str, dict] = {}
 
     # engine delegation so register_llm/serve_engine treat us as the engine
     @property
@@ -340,6 +352,21 @@ class DisaggDecodeEngine:
         start = getattr(self.engine, "start", None)
         if start is not None:
             start()
+
+    # graceful-drain passthrough (resilience/drain.py contract): the
+    # DrainController holds this wrapper when the worker runs disagg.
+    # The wrapper keeps its own flag so generate() rejects BEFORE the
+    # remote-prefill decision — otherwise a draining worker would pay a
+    # full cross-worker KV transfer for a request it then refuses.
+    def begin_drain(self) -> None:
+        self._draining = True
+        begin = getattr(self.engine, "begin_drain", None)
+        if begin is not None:
+            begin()
+
+    def drained(self) -> bool:
+        fn = getattr(self.engine, "drained", None)
+        return bool(fn()) if fn is not None else True
 
     async def stop(self) -> None:
         await self.engine.stop()
@@ -376,20 +403,33 @@ class DisaggDecodeEngine:
     ) -> AsyncIterator[LLMEngineOutput]:
         from dynamo_tpu.telemetry.trace import span_now
 
+        if self._draining:
+            from dynamo_tpu.resilience.drain import WorkerDrainingError
+
+            raise WorkerDrainingError(
+                "worker draining: not admitting new requests"
+            )
         t0 = time.monotonic()
-        span = None
+        spans: list = []
         if await self._maybe_remote_prefill(request):
             self.remote_prefills += 1
             # trace the remote KV transfer: injected into the finishing
             # output's span payload so the frontend's span tree carries
-            # it alongside the engine's queue/prefill spans
-            span = span_now("disagg_kv_transfer", t0).to_dict()
+            # it alongside the engine's queue/prefill spans. The prefill
+            # worker's own remote_prefill span (shipped back on the done
+            # queue) rides along, so the remote hop is visible
+            # end-to-end in /debug/trace/{request_id}.
+            spans.append(span_now("disagg_kv_transfer", t0).to_dict())
+            remote_span = self._remote_spans.pop(request.request_id, None)
+            if remote_span:
+                spans.append(remote_span)
         else:
             self.local_prefills += 1
+            self._remote_spans.pop(request.request_id, None)
         async for out in self.engine.generate(request):
-            if span is not None and out.finish_reason is not None:
+            if spans and out.finish_reason is not None:
                 tr = out.annotations.setdefault("trace", {})
-                tr.setdefault("spans", []).insert(0, span)
+                tr["spans"] = spans + tr.get("spans", [])
             yield out
 
     async def _should_remote(self, request: PreprocessedRequest,
@@ -455,6 +495,8 @@ class DisaggDecodeEngine:
                     (resp or {}).get("error", "remote prefill timed out")
                 )
             n_got = int(resp.get("blocks", 0))
+            if resp.get("span"):
+                self._remote_spans[rid] = resp["span"]
             with self._jobs_lock:
                 self._pending_jobs.discard(rid)
             # commit the transferred blocks under their chained hashes; the

@@ -143,6 +143,10 @@ class _Request:
     # the last element) — the proposers' lookup corpus
     spec_tokens: list[int] = field(default_factory=list)
     spec_keys: Optional[np.ndarray] = None  # [2] uint32 PRNG key
+    # host mirror of the sampler's output-token counts histogram [V] —
+    # allocated only for penalized requests (the verifier's penalized
+    # accept path consumes it; despec restores it onto the device state)
+    spec_counts: Optional[np.ndarray] = None
     spec_proposed: int = 0
     spec_accepted: int = 0
 
@@ -380,6 +384,10 @@ class TpuEngine:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._started = False
+        # graceful drain (resilience/drain.py): begin_drain() stops
+        # admissions; drained() flips once in-flight work finishes
+        self._draining = False
+        self._drained_evt = threading.Event()
         self.step_count = 0
         self.tokens_generated = 0
         self.sp_prefills = 0
@@ -485,6 +493,7 @@ class TpuEngine:
             admit_slot, admit_ctx, admit_tok, admit_keys,
             admit_temp, admit_top_k, admit_top_p,
             admit_freq, admit_pres, admit_rep,
+            admit_counts,
         ):
             B = dev["tokens"].shape[0]
             dev = dict(dev)
@@ -503,7 +512,9 @@ class TpuEngine:
             dev["ctx"] = dev["ctx"].at[s].set(admit_ctx)
             dev["dest"] = dev["dest"].at[s].set(admit_slot)
             dev["keys"] = dev["keys"].at[s].set(admit_keys)
-            dev["counts"] = dev["counts"].at[s].set(0)
+            # fresh admissions pass the cached zero row; a penalized slot
+            # despeculating back to the fused round restores its histogram
+            dev["counts"] = dev["counts"].at[s].set(admit_counts)
             dev["temp"] = dev["temp"].at[s].set(admit_temp)
             dev["top_k"] = dev["top_k"].at[s].set(admit_top_k)
             dev["top_p"] = dev["top_p"].at[s].set(admit_top_p)
@@ -530,6 +541,9 @@ class TpuEngine:
         self._engine_round = engine_round
         self._patch = patch
         self._sample_first = sample_first
+        # reusable zero counts row for ordinary admissions (no per-patch
+        # [V]-sized H2D upload)
+        self._zero_counts = jnp.zeros(c.vocab_size, jnp.int32)
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -552,6 +566,19 @@ class TpuEngine:
         if self.offload is not None and self.offload.spill is not None:
             self.offload.spill.close()
 
+    # ---- graceful drain (resilience/drain.py DrainController contract) --
+
+    def begin_drain(self) -> None:
+        """Stop admitting: subsequent generate() calls raise the retriable
+        WorkerDrainingError; requests already accepted run to completion."""
+        self._draining = True
+        if not self._started:
+            # the loop never ran: nothing can be in flight
+            self._drained_evt.set()
+
+    def drained(self) -> bool:
+        return self._drained_evt.is_set()
+
     # ------------------------------------------------------------------
     # AsyncEngine surface
 
@@ -559,6 +586,12 @@ class TpuEngine:
         self, request: PreprocessedRequest
     ) -> AsyncIterator[LLMEngineOutput]:
         """Stream engine outputs (token-id deltas) for one request."""
+        if self._draining:
+            from dynamo_tpu.resilience.drain import WorkerDrainingError
+
+            raise WorkerDrainingError(
+                "worker draining: not admitting new requests"
+            )
         if not self._started:
             self.start()
         if len(request.token_ids) == 0:
@@ -847,6 +880,7 @@ class TpuEngine:
     # engine loop
 
     def _run_loop(self) -> None:
+        last_idle_beat = 0.0
         while not self._stop.is_set():
             try:
                 did_work = self._round()
@@ -863,6 +897,17 @@ class TpuEngine:
                     log.exception("fail_all cleanup itself failed")
                 did_work = False
             if not did_work:
+                # idle heartbeat: busy rounds publish metrics themselves;
+                # an IDLE engine must keep heartbeating too, or the
+                # health plane's soft leases (resilience/health.py
+                # heartbeat_ttl_s) would read silence as wedged
+                now = time.monotonic()
+                if self.on_metrics is not None and now - last_idle_beat >= 0.5:
+                    last_idle_beat = now
+                    try:
+                        self.on_metrics(self.metrics())
+                    except Exception:  # noqa: BLE001 — never kill the loop
+                        log.exception("idle metrics publish failed")
                 try:
                     self._waiting.append(self._intake.get(timeout=0.02))
                 except queue_mod.Empty:
@@ -920,6 +965,11 @@ class TpuEngine:
             # live slot is waiting on its verify result) — block on the
             # head entry instead of spinning the loop
             self._process_entries(block=True)
+        if (self._draining
+                and not self._entries and not self._waiting
+                and not self._prefilling and self._intake.empty()
+                and all(s is None for s in self._slots)):
+            self._drained_evt.set()
         return did_work
 
     def _drain_intake(self) -> None:
@@ -1012,6 +1062,7 @@ class TpuEngine:
         if self.on_dispatch is not None:
             a = dict(admit or {})
             a.pop("tok", None)  # followers use their own sample_first result
+            a.pop("counts", None)  # spec-only (spec is rejected multihost)
             if "keys" in a:
                 a["keys"] = np.asarray(a["keys"]).tolist()
             self.on_dispatch("patch", {
@@ -1022,6 +1073,7 @@ class TpuEngine:
         for s in clear_slots:
             clear[s] = True
         a = admit or {}
+        counts = a.get("counts")
         self._dev = self._patch(
             self._dev,
             jnp.asarray(clear),
@@ -1035,6 +1087,8 @@ class TpuEngine:
             jnp.float32(a.get("freq", 0.0)),
             jnp.float32(a.get("pres", 0.0)),
             jnp.float32(a.get("rep", 1.0)),
+            self._zero_counts if counts is None
+            else jnp.asarray(counts, jnp.int32),
         )
 
     # ---- speculative decoding (spec/): propose -> fused verify ----
@@ -1090,6 +1144,17 @@ class TpuEngine:
         temps = np.zeros(B, np.float32)
         top_ks = np.zeros(B, np.int32)
         top_ps = np.ones(B, np.float32)
+        # penalties: built only when some row carries them — the [B, V]
+        # counts upload (and the verifier's histogram-advancing scan
+        # variant) costs nothing on penalty-free rounds
+        penalties = None
+        if any(r.spec_counts is not None for _, r, _, _ in rows):
+            penalties = (
+                np.zeros((B, self.config.vocab_size), np.int32),
+                np.zeros(B, np.float32),          # freq
+                np.zeros(B, np.float32),          # pres
+                np.ones(B, np.float32),           # rep
+            )
         for j, (slot, r, n_hist, _k) in enumerate(rows):
             toks[j, 0] = r.spec_tokens[-1]    # pending token
             slots_a[j] = slot
@@ -1100,6 +1165,11 @@ class TpuEngine:
             temps[j] = so.temperature or 0.0
             top_ks[j] = so.top_k or 0
             top_ps[j] = so.top_p if so.top_p is not None else 1.0
+            if penalties is not None and r.spec_counts is not None:
+                penalties[0][j] = r.spec_counts
+                penalties[1][j] = so.frequency_penalty or 0.0
+                penalties[2][j] = so.presence_penalty or 0.0
+                penalties[3][j] = so.repetition_penalty or 1.0
         t_disp = time.monotonic()
         drafted = None
         if self.spec.draft is not None and e.spec_batch_draft:
@@ -1120,6 +1190,7 @@ class TpuEngine:
         self.ctx, out_toks, n_out, new_keys = self.spec.verify(
             self.params, self.ctx, jnp.asarray(toks), drafted, slots_a,
             q_starts, seq_lens, keys, temps, top_ks, top_ps,
+            penalties=penalties,
         )
         for arr in (out_toks, n_out, new_keys):
             arr.copy_to_host_async()
@@ -1154,6 +1225,13 @@ class TpuEngine:
             temp=so.temperature or 0.0,
             top_k=so.top_k or 0,
             top_p=so.top_p if so.top_p is not None else 1.0,
+            # penalized slots restore their sampler state in full: the
+            # fused continuation must see the same histogram the verify
+            # loop advanced, or penalties would reset mid-request
+            freq=so.frequency_penalty or 0.0,
+            pres=so.presence_penalty or 0.0,
+            rep=so.repetition_penalty or 1.0,
+            counts=r.spec_counts,
         ))
 
     def _process_spec(self, entry: _Entry) -> None:
@@ -1209,6 +1287,13 @@ class TpuEngine:
             if finish is not None:
                 self._finish(r, None)
                 continue
+            if r.spec_counts is not None:
+                # host mirror of the penalty histogram: every emitted
+                # token counts (matching the fused sampler's per-token
+                # advance; the request's first-ever token is excluded
+                # there too — see _process_first)
+                for t in toks:
+                    r.spec_counts[t] += 1
             r.spec_tokens.extend(toks)  # accepted + bonus, all emitted
             r.spec_keys = new_keys[j]
             if self.spec.should_despec(slot):
@@ -1421,6 +1506,7 @@ class TpuEngine:
         missing = matchable[i:]
         if not missing:
             return
+        t_fetch = time.monotonic()
         try:
             found, data = await self.remote_kv.fetch(
                 [b.block_hash for b in missing]
@@ -1430,6 +1516,13 @@ class TpuEngine:
             return
         if not found or data is None:
             return
+        # trace the peer-pool fetch: rides the request's worker-side span
+        # list so migration replays / disagg flows show the G4 hop
+        # end-to-end in /debug/trace/{request_id}
+        r.trace_spans.append(_span_dict(
+            "g4_fetch", t_fetch,
+            blocks=int(found), requested=len(missing),
+        ))
         self._host_ingest.put((
             [b.block_hash for b in missing[:found]],
             [b.parent_hash for b in missing[:found]],
@@ -1864,6 +1957,13 @@ class TpuEngine:
             # (_process_first marks it spec-ready)
             r.spec = True
             r.spec_keys = np.asarray(step_keys, np.uint32)
+            if self.spec.penalized(r.req):
+                # penalized slots carry the sampler's output-token
+                # histogram host-side; the verifier's penalized accept
+                # path advances it per accepted token
+                r.spec_counts = np.zeros(
+                    self.config.vocab_size, np.int32
+                )
         else:
             self._dispatch_patch(
                 admit=dict(

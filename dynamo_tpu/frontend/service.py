@@ -178,7 +178,16 @@ class HttpService:
         *,
         host: str = "0.0.0.0",
         port: int = 8080,
+        trace_sample_rate: float = 1.0,
     ):
+        # fraction of requests minting a FULL trace (--trace-sample-rate):
+        # high-QPS deployments trace a sample instead of every request;
+        # unsampled requests carry a shell trace that migration/failure
+        # paths promote, so those are ALWAYS fully traced from that point
+        self.trace_sample_rate = trace_sample_rate
+        import random as _random
+
+        self._trace_rng = _random.Random()
         # `is not None`, NOT truthiness: an EMPTY manager (len 0 -> falsy)
         # must be kept — discovery registers models into it later; replacing
         # it would silently split the watcher and the HTTP handlers onto
@@ -244,7 +253,10 @@ class HttpService:
         return web.json_response(model_list_response(self.manager.list_models()))
 
     async def handle_metrics(self, request: web.Request) -> web.Response:
-        body = self.metrics.render() + self.telemetry.render().encode()
+        from dynamo_tpu.resilience.metrics import RESILIENCE
+
+        body = (self.metrics.render() + self.telemetry.render().encode()
+                + RESILIENCE.render().encode())
         return web.Response(
             body=body, content_type=CONTENT_TYPE_LATEST.split(";")[0]
         )
@@ -566,8 +578,12 @@ class HttpService:
             # trace context: minted here, keyed by the engine-facing
             # request id (it travels through the runtime protocol to the
             # router and worker; their spans come back via output
-            # annotations and merge into this tree — /debug/trace/{id})
-            trace = TRACES.start(pre.request_id)
+            # annotations and merge into this tree — /debug/trace/{id}).
+            # Below the sample rate, the trace is an unsampled shell the
+            # migration/failure paths can still promote.
+            sampled = (self.trace_sample_rate >= 1.0
+                       or self._trace_rng.random() < self.trace_sample_rate)
+            trace = TRACES.start(pre.request_id, sampled=sampled)
             trace.add(span_now(
                 "tokenize", t_tok,
                 model=req.model, prompt_tokens=len(pre.token_ids),
@@ -766,6 +782,9 @@ class HttpService:
                                 gen.text_chunk(leftover, index=i)
                             ))
                     log.warning("engine stream %d failed: %s", i, item)
+                    # failed requests are always traced (sampling shell
+                    # promoted so the failure context survives)
+                    TRACES.promote(pre.request_id)
                     await resp.write(
                         encode_event({"error": {"message": str(item)}})
                     )

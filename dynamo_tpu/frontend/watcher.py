@@ -168,16 +168,32 @@ class ModelWatcher:
         namespace: str = "dynamo",
         router_config: Optional[KvRouterConfig] = None,
         kv_recorder: Optional[Any] = None,  # KvRecorder: tees kv_events
+        health: Optional[Any] = None,       # WorkerHealthTracker override
+        heartbeat_ttl_s: Optional[float] = None,
     ):
+        from dynamo_tpu.resilience.health import WorkerHealthTracker
+
         self.rt = rt
         self.manager = manager
         self.namespace = namespace
         self.router_config = router_config
         self.kv_recorder = kv_recorder
+        # one health tracker shared by every model's router: per-worker
+        # circuit breakers, plus heartbeats off the load-metrics plane
+        # when ``heartbeat_ttl_s`` is set (each ForwardPassMetrics
+        # publication refreshes the worker's soft lease — TpuEngine
+        # publishes on idle ticks too, so silence really means wedged).
+        # The metrics subscription only runs when something consumes it:
+        # a TTL here, or a caller-provided tracker.
+        self._follow_heartbeats = health is not None or heartbeat_ttl_s
+        self.health = health or WorkerHealthTracker(
+            heartbeat_ttl_s=heartbeat_ttl_s
+        )
         self._task: Optional[asyncio.Task] = None
         self._models: dict[str, dict[int, ModelEntry]] = {}  # name -> lease -> entry
         self._chains: dict[str, Any] = {}
         self._kv_sub_task: Optional[asyncio.Task] = None
+        self._metrics_sub_task: Optional[asyncio.Task] = None
         self._routers: dict[str, KvPushRouter] = {}
         # KV events that raced worker discovery, replayed on sync
         self._unclaimed_events: deque = deque(maxlen=4096)
@@ -199,13 +215,17 @@ class ModelWatcher:
         self._kv_sub_task = asyncio.get_running_loop().create_task(
             self._follow_kv_events()
         )
+        if self._follow_heartbeats:
+            self._metrics_sub_task = asyncio.get_running_loop().create_task(
+                self._follow_metrics()
+            )
         return self
 
     async def stop(self) -> None:
-        for t in (self._task, self._kv_sub_task):
+        for t in (self._task, self._kv_sub_task, self._metrics_sub_task):
             if t is not None:
                 t.cancel()
-        self._task = self._kv_sub_task = None
+        self._task = self._kv_sub_task = self._metrics_sub_task = None
 
     async def _follow(self, watch) -> None:
         async for ev in watch:
@@ -234,6 +254,21 @@ class ModelWatcher:
                     log.exception("kv recorder failed; disabling recording")
                     self.kv_recorder = None
             self._route_kv_event(event)
+
+    async def _follow_metrics(self) -> None:
+        """Heartbeat tap on the load-metrics plane: every worker metrics
+        publication refreshes that worker's soft lease in the shared
+        health tracker (resilience/health.py)."""
+        from dynamo_tpu.kv_router.protocols import ForwardPassMetrics
+        from dynamo_tpu.runtime.publisher import METRICS_TOPIC
+
+        sub = await self.rt.kv.subscribe(f"{METRICS_TOPIC}.>")
+        async for ev in sub:
+            try:
+                m = ForwardPassMetrics.from_dict(json.loads(ev["value"]))
+            except (KeyError, ValueError, TypeError):
+                continue
+            self.health.observe_metrics(m)
 
     def _route_kv_event(self, event: KvCacheEvent, *,
                         buffer_unclaimed: bool = True) -> bool:
@@ -302,7 +337,7 @@ class ModelWatcher:
 
         if entry.router_mode == "kv":
             router = KvRouter(entry.block_size, self.router_config)
-            push = KvPushRouter(router)
+            push = KvPushRouter(router, health=self.health)
             self._routers[name] = push
 
             def sync_workers(instances: list[Instance], push=push,

@@ -7,6 +7,13 @@ track generated tokens per request (push) and free on completion.
 
 Workers are anything with the AsyncEngine ``generate()`` contract — local
 engines, mockers, or remote endpoint clients from the distributed runtime.
+
+Resilience (dynamo_tpu/resilience/): routing consults a per-worker
+circuit-breaker/heartbeat tracker; a worker unreachable before the first
+token is evicted and the request re-routes; a worker dying MID-STREAM
+triggers live migration — the request is rebuilt as prompt + emitted
+tokens and replayed as a prefill on a healthy worker, with exactly-once
+token delivery (greedy output is token-identical to an uninterrupted run).
 """
 from __future__ import annotations
 
@@ -20,10 +27,22 @@ from dynamo_tpu.kv_router.scheduler import (
     KvRouterConfig,
     KvScheduler,
     KVHitRateEvent,
+    NoEndpoints,
     SchedulingRequest,
 )
 from dynamo_tpu.kv_router.sequence import ActiveSequencesMultiWorker
-from dynamo_tpu.protocols.common import LLMEngineOutput, PreprocessedRequest
+from dynamo_tpu.protocols.common import (
+    FinishReason,
+    LLMEngineOutput,
+    PreprocessedRequest,
+)
+from dynamo_tpu.resilience.health import WorkerHealthTracker
+from dynamo_tpu.resilience.metrics import RESILIENCE
+from dynamo_tpu.resilience.migration import (
+    MigrationPolicy,
+    build_replay_request,
+)
+from dynamo_tpu.resilience.policy import RetryPolicy
 from dynamo_tpu.telemetry.trace import TRACES, span_now
 from dynamo_tpu.tokens import TokenBlockSequence
 
@@ -53,20 +72,28 @@ class KvRouter:
         self.sequences.update_workers(worker_ids)
 
     def find_best_match(
-        self, request_id: str, tokens: list[int], salt: str = ""
+        self,
+        request_id: str,
+        tokens: list[int],
+        salt: str = "",
+        exclude: Optional[set[WorkerId]] = None,
     ) -> tuple[WorkerId, int]:
         """(worker_id, overlap_blocks). Registers the request against the
-        chosen worker's predicted active set (kv_router.rs:178-214)."""
+        chosen worker's predicted active set (kv_router.rs:178-214).
+        ``exclude`` drops workers from consideration (dead/tripped workers
+        during re-route and migration); raises NoEndpoints when nothing
+        remains — the caller decides whether to relax the exclusion."""
         seq = TokenBlockSequence.from_tokens(tokens, self.block_size, salt=salt)
         overlap = self.indexer.find_matches(seq.block_hashes())
+        candidates = self.sequences.worker_ids()
+        if exclude:
+            candidates = [w for w in candidates if w not in exclude]
         req = SchedulingRequest(
             isl_tokens=len(tokens),
             overlap=overlap,
             potential_blocks=self.sequences.potential_blocks(seq),
         )
-        worker, overlap_blocks = self.scheduler.schedule(
-            self.sequences.worker_ids(), req
-        )
+        worker, overlap_blocks = self.scheduler.schedule(candidates, req)
         self.sequences.add_request(request_id, worker, seq)
         return worker, overlap_blocks
 
@@ -79,15 +106,30 @@ class KvRouter:
 
 class KvPushRouter:
     """AsyncEngine wrapper: route + stream + per-token tracking
-    (kv_router.rs:242-304)."""
+    (kv_router.rs:242-304), plus the resilience plane: breaker-aware
+    worker selection, pre-first-token re-route, and mid-stream migration
+    with exactly-once token delivery."""
 
     def __init__(
         self,
         router: KvRouter,
         workers: Optional[dict[WorkerId, Any]] = None,
+        *,
+        health: Optional[WorkerHealthTracker] = None,
+        migration: Optional[MigrationPolicy] = None,
+        retry: Optional[RetryPolicy] = None,
     ):
         self.router = router
         self.workers: dict[WorkerId, Any] = workers or {}
+        self.health = health or WorkerHealthTracker()
+        self.migration = migration or MigrationPolicy()
+        # backoff between failover attempts (small base: failover latency
+        # is client-visible TTFT)
+        self.retry = retry or RetryPolicy(
+            max_attempts=8, base_delay_s=0.05, max_delay_s=1.0
+        )
+        self.migrations = 0       # replays dispatched (instance-local)
+        self.reroutes = 0         # pre-first-token re-routes
         self.router.update_workers(list(self.workers))
 
     def add_worker(self, worker_id: WorkerId, engine: Any) -> None:
@@ -98,6 +140,7 @@ class KvPushRouter:
         self.workers.pop(worker_id, None)
         self.router.update_workers(list(self.workers))
         self.router.indexer.remove_worker(worker_id)
+        self.health.forget(worker_id)
 
     async def clear_kv_blocks(self) -> int:
         """Fan /clear_kv_blocks out to every routed worker and drop their
@@ -116,26 +159,66 @@ class KvPushRouter:
             self.router.indexer.remove_worker(wid)
         return total
 
+    def _route(
+        self, rid: str, cur: PreprocessedRequest, tried: set[WorkerId]
+    ) -> tuple[WorkerId, int]:
+        """One routing decision: exclude workers already tried for this
+        request AND workers the health plane blocks (tripped breakers,
+        stale heartbeats). When the breaker exclusion leaves nothing,
+        relax it — availability beats precision; the dead ones stay
+        excluded via ``tried``. Raises NoEndpoints when no worker is
+        routable at all."""
+        blocked = self.health.blocked(list(self.workers))
+        try:
+            return self.router.find_best_match(
+                rid, cur.token_ids, salt=cur.model,
+                exclude=tried | blocked,
+            )
+        except NoEndpoints:
+            if not blocked:
+                raise
+            return self.router.find_best_match(
+                rid, cur.token_ids, salt=cur.model, exclude=tried,
+            )
+
     async def generate(
         self, request: PreprocessedRequest
     ) -> AsyncIterator[LLMEngineOutput]:
-        """Route + stream. An unreachable worker (connection refused, or
-        died before producing anything) is evicted — its warm-prefix blocks
-        leave the indexer so they stop attracting traffic for the rest of
-        the lease window — and the request re-routes to the next-best
-        worker. Once tokens have streamed, failures propagate (the decode
-        state died with the worker; resume is the caller's call)."""
+        """Route + stream, surviving worker failure at any point:
+
+        - Unreachable before the first token: the worker is evicted (its
+          warm-prefix blocks leave the indexer so they stop attracting
+          traffic for the rest of the lease window) and the request
+          re-routes to the next-best worker.
+        - Died MID-STREAM: live migration — the request is rebuilt as
+          prompt + tokens-emitted-so-far and replayed as a prefill on a
+          healthy worker (excluding every worker already tried). The
+          replay prompt suppresses the already-delivered suffix by
+          construction, so the client receives each token exactly once
+          and greedy output is token-identical to an uninterrupted run.
+          The failed worker is NOT evicted here (it may be alive but
+          degraded — chaos, stall); the breaker and lease plane decide
+          its fate.
+        """
         rid = request.request_id
-        attempts = max(1, len(self.workers))
+        emitted: list[int] = []
+        tried: set[WorkerId] = set()
+        cur = request
+        route_attempts = max(1, len(self.workers))
+        migrations_left = self.migration.budget(len(self.workers))
         last_err: Optional[BaseException] = None
-        for attempt in range(attempts):
+        attempt = 0
+        while attempt < route_attempts + self.migration.max_migrations:
             if not self.workers:
                 break
+            if attempt > 0:
+                await self.retry.sleep(attempt - 1)
             t_route = time.monotonic()
-            worker_id, overlap = self.router.find_best_match(
-                rid, request.token_ids, salt=request.model
-            )
-            request.estimated_prefix_hit_num_blocks = overlap
+            try:
+                worker_id, overlap = self._route(rid, cur, tried)
+            except NoEndpoints:
+                break
+            cur.estimated_prefix_hit_num_blocks = overlap
             # trace context: the routing decision + KV-match score, onto
             # the frontend's span tree when it lives in this process
             # (no-op otherwise; see telemetry/trace.py)
@@ -152,25 +235,85 @@ class KvPushRouter:
             log.debug(
                 "routing %s to %s (overlap %d blocks)", rid, worker_id, overlap
             )
+            # consume the half-open probe grant (if any) for the worker
+            # the request actually dispatches to
+            self.health.on_routed(worker_id)
+            attempt += 1
             streamed = False
+            finish_seen = False
             try:
-                async for out in engine.generate(request):
+                async for out in engine.generate(cur):
                     for tok in out.token_ids:
                         self.router.push(rid, tok)
+                        emitted.append(tok)
                     streamed = True
+                    if out.finish_reason is not None:
+                        finish_seen = True
                     yield out
+                self.health.record_success(worker_id)
                 return
             except (ConnectionError, OSError) as e:
-                if streamed or attempt == attempts - 1:
-                    raise
                 last_err = e
-                log.warning(
-                    "worker %s unreachable (%s); evicting and re-routing %s",
-                    worker_id, e, rid,
+                self.health.record_failure(worker_id)
+                tried.add(worker_id)
+                if finish_seen:
+                    # the finish output was already delivered — the worker
+                    # died between it and the stream close. The request is
+                    # COMPLETE; migrating would regenerate past the stop
+                    # point and push tokens after a finish chunk.
+                    log.warning(
+                        "worker %s died after finishing %s; stream complete",
+                        worker_id, rid,
+                    )
+                    return
+                if not streamed:
+                    log.warning(
+                        "worker %s unreachable (%s); evicting and "
+                        "re-routing %s", worker_id, e, rid,
+                    )
+                    self.reroutes += 1
+                    RESILIENCE.inc("dynamo_resilience_reroute_total")
+                    self.remove_worker(worker_id)
+                    if not self.workers:
+                        raise
+                    continue
+                # ---- mid-stream: live migration ----
+                if not self.migration.enabled or migrations_left <= 0:
+                    RESILIENCE.inc("dynamo_migration_failed_total")
+                    raise
+                migrations_left -= 1
+                replay = build_replay_request(request, emitted)
+                if replay is None:
+                    # token budget already delivered: the uninterrupted
+                    # run would finish with LENGTH right here — close the
+                    # stream instead of replaying a zero-token tail
+                    yield LLMEngineOutput(
+                        token_ids=[], finish_reason=FinishReason.LENGTH,
+                    )
+                    return
+                # migrated requests are always traced, even when the
+                # request wasn't sampled (telemetry/trace.py)
+                TRACES.promote(rid)
+                TRACES.add_span(rid, span_now(
+                    "migrate", t_route,
+                    from_worker=str(worker_id),
+                    replayed_tokens=len(emitted), error=str(e)[:200],
+                ))
+                self.migrations += 1
+                RESILIENCE.inc("dynamo_migration_total")
+                RESILIENCE.inc(
+                    "dynamo_migration_replayed_tokens_total", len(emitted)
                 )
-                self.remove_worker(worker_id)
+                log.warning(
+                    "worker %s died mid-stream (%s); migrating %s "
+                    "(%d tokens replayed as prefill)",
+                    worker_id, e, rid, len(emitted),
+                )
+                cur = replay
             finally:
                 self.router.free(rid)
+        if emitted:
+            RESILIENCE.inc("dynamo_migration_failed_total")
         raise ConnectionError(
             f"no reachable worker for request {rid}"
         ) from last_err

@@ -122,6 +122,27 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--system-port", type=int, default=None,
                    help="per-process /metrics + /health server port "
                         "(reference http_server.rs); 0 = ephemeral")
+    # resilience plane (dynamo_tpu/resilience/)
+    p.add_argument("--chaos", default=None, metavar="SPEC",
+                   help="arm fault-injection points on the worker serving "
+                        "path, e.g. 'kill_worker:p=0.1:after=3,delay:t=0.05'"
+                        " (also via DYNAMO_CHAOS; tools/chaos.py arms a "
+                        "running worker over HTTP)")
+    p.add_argument("--trace-sample-rate", type=float, default=1.0,
+                   help="fraction of requests fully traced by the frontend "
+                        "(high-QPS deployments sample; migrated/failed "
+                        "requests are always traced)")
+    p.add_argument("--health-heartbeat-ttl", type=float, default=None,
+                   help="frontend soft-lease TTL in seconds: a worker "
+                        "whose load-metrics heartbeats go silent longer "
+                        "than this stops receiving traffic before its "
+                        "hard store lease expires (engines heartbeat on "
+                        "idle ticks too; set well above ~1s). Default: "
+                        "breaker-only health tracking")
+    p.add_argument("--drain-timeout", type=float, default=60.0,
+                   help="graceful-drain budget: in-flight requests get "
+                        "this long to finish after SIGTERM or POST /drain "
+                        "before the worker exits anyway")
     # multi-host single-engine bootstrap (reference MultiNodeConfig,
     # flags.rs:86-101 + leader_worker_barrier.rs)
     p.add_argument("--num-nodes", type=int, default=1)
@@ -475,7 +496,8 @@ async def _serve_http(args, chain) -> None:
 
     manager = ModelManager()
     manager.register(chain)
-    svc = HttpService(manager, host=args.http_host, port=args.http_port)
+    svc = HttpService(manager, host=args.http_host, port=args.http_port,
+                      trace_sample_rate=args.trace_sample_rate)
     await svc.start()
     print(f"serving {chain.name!r} on http://{args.http_host}:{args.http_port}")
     try:
@@ -721,12 +743,37 @@ async def _serve_worker(args, chain) -> None:
         model_path=args.model_path,
     )
     served = await register_llm(rt, engine, entry)
+
+    # graceful drain (resilience/drain.py): SIGTERM (planner scale-down)
+    # and POST /drain both stop admissions, let in-flight requests finish,
+    # then exit — instead of killing warm KV and live streams
+    import signal
+
+    from dynamo_tpu.resilience.drain import DrainController
+
+    drained_exit = asyncio.Event()
+    drain = DrainController(
+        engine,
+        on_deregister=served.lease.revoke,
+        on_drained=drained_exit.set,
+        timeout_s=args.drain_timeout,
+    )
+    loop = asyncio.get_running_loop()
+    try:
+        loop.add_signal_handler(
+            signal.SIGTERM,
+            lambda: drain.request_drain(reason="SIGTERM"),
+        )
+    except (NotImplementedError, RuntimeError):
+        pass  # platforms/loops without signal support: /drain still works
+
     if args.system_port is not None:
         from dynamo_tpu.runtime.system_server import SystemServer
 
         sysrv = await SystemServer(
             engine, port=args.system_port,
             worker_id=str(served.lease_id),
+            drain=drain,
         ).start()
         disagg_parts.append(sysrv)  # stopped alongside disagg parts
         print(f"system server on :{sysrv.port}")
@@ -736,8 +783,18 @@ async def _serve_worker(args, chain) -> None:
         f"{args.namespace}/{args.component}/{args.endpoint_name}"
     )
     try:
-        await served.lease.lost.wait()  # run until the control plane drops us
-        print("lease lost; shutting down")
+        # run until the control plane drops us OR a drain completes
+        lost = asyncio.ensure_future(served.lease.lost.wait())
+        drained = asyncio.ensure_future(drained_exit.wait())
+        done, pending = await asyncio.wait(
+            {lost, drained}, return_when=asyncio.FIRST_COMPLETED
+        )
+        for t in pending:
+            t.cancel()
+        if drained in done:
+            print("drained; shutting down")
+        else:
+            print("lease lost; shutting down")
     finally:
         for part in disagg_parts:
             await part.stop()
@@ -811,9 +868,11 @@ async def _serve_http_dynamic(args) -> None:
 
         kv_recorder = KvRecorder(args.record_kv_events)
     watcher = await ModelWatcher(
-        rt, manager, namespace=args.namespace, kv_recorder=kv_recorder
+        rt, manager, namespace=args.namespace, kv_recorder=kv_recorder,
+        heartbeat_ttl_s=args.health_heartbeat_ttl,
     ).start()
-    svc = HttpService(manager, host=args.http_host, port=args.http_port)
+    svc = HttpService(manager, host=args.http_host, port=args.http_port,
+                      trace_sample_rate=args.trace_sample_rate)
     await svc.start()
     print(
         f"dynamic frontend on http://{args.http_host}:{args.http_port} "
@@ -862,6 +921,11 @@ def run_cli(argv: list[str]) -> int:
     # intermixed: in=/out= positionals may appear between/after flags
     # (graph files and scripts compose argv in any order)
     args = build_parser().parse_intermixed_args(argv)
+    chaos_spec = args.chaos or os.environ.get("DYNAMO_CHAOS")
+    if chaos_spec:
+        from dynamo_tpu.resilience.chaos import CHAOS
+
+        CHAOS.configure(chaos_spec)
     inp, _ = _parse_io(args.io)
     chain = None
     try:

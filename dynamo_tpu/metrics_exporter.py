@@ -158,7 +158,12 @@ class MetricsExporter:
                 )[2:])
         gauge("dynamo_metrics_workers",
               "workers in the last load-plane snapshot", len(snap.metrics))
-        return "\n".join(lines) + "\n"
+        # resilience plane (dynamo_tpu/resilience/): process-local
+        # migration/breaker/drain/chaos counters, same families on every
+        # scrape surface
+        from dynamo_tpu.resilience.metrics import RESILIENCE
+
+        return "\n".join(lines) + "\n" + RESILIENCE.render()
 
     async def handle_metrics(self, request: web.Request) -> web.Response:
         return web.Response(

@@ -99,6 +99,8 @@ class MockerEngine:
         self._active: list[_MockRequest] = []
         self._task: Optional[asyncio.Task] = None
         self._wake = asyncio.Event()
+        self._draining = False
+        self._last_idle_beat = 0.0
         self.step_count = 0
         self.tokens_generated = 0
         self.preemptions = 0
@@ -126,12 +128,26 @@ class MockerEngine:
     def clear_kv_blocks(self) -> int:
         return self.allocator.clear()
 
+    # ---- graceful drain (resilience/drain.py DrainController contract) --
+
+    def begin_drain(self) -> None:
+        self._draining = True
+
+    def drained(self) -> bool:
+        return self._draining and not self._active and not self._waiting
+
     # ------------------------------------------------------------------
     # AsyncEngine surface
 
     async def generate(
         self, request: PreprocessedRequest
     ) -> AsyncIterator[LLMEngineOutput]:
+        if self._draining:
+            from dynamo_tpu.resilience.drain import WorkerDrainingError
+
+            raise WorkerDrainingError(
+                "worker draining: not admitting new requests"
+            )
         if self._task is None or self._task.done():
             self.start()
         if not request.token_ids:
@@ -179,15 +195,39 @@ class MockerEngine:
     # ------------------------------------------------------------------
     # simulated engine loop
 
+    def _idle_beat(self) -> None:
+        """Heartbeat while idle: the health plane's soft leases
+        (resilience/health.py heartbeat_ttl_s) read metrics-stream
+        silence as wedged, so an idle engine must keep publishing —
+        same contract as TpuEngine's idle heartbeat."""
+        if self.on_metrics is None:
+            return
+        now = time.monotonic()
+        if now - self._last_idle_beat >= 0.5:
+            self._last_idle_beat = now
+            self.on_metrics(self.metrics())
+
     async def _run(self) -> None:
         a = self.args
+        self._last_idle_beat = 0.0
         while True:
             self._sweep_cancelled()
             self._admit()
             if not self._active:
                 self._wake.clear()
+                self._idle_beat()
                 if not self._waiting:
-                    await self._wake.wait()
+                    # bounded park so the idle heartbeat keeps ticking.
+                    # NOT asyncio.wait_for: on 3.10 a stop() cancel that
+                    # races the wake future's completion is SWALLOWED by
+                    # wait_for and the loop becomes uncancellable;
+                    # asyncio.wait propagates outer cancellation always.
+                    waiter = asyncio.ensure_future(self._wake.wait())
+                    try:
+                        await asyncio.wait({waiter}, timeout=0.5)
+                    finally:
+                        if not waiter.done():
+                            waiter.cancel()
                 else:
                     # waiting but unadmittable (page pressure): idle-tick
                     await asyncio.sleep(

@@ -60,21 +60,51 @@ class Connector(Protocol):
 
 class LocalConnector:
     """Worker pool as local subprocesses of the dynamo-tpu CLI (circus-
-    arbiter equivalent). Retirement is newest-first SIGTERM — the worker's
-    lease revocation deregisters it and in-flight requests drain."""
+    arbiter equivalent). Retirement is newest-first GRACEFUL DRAIN:
+    SIGTERM asks the worker to stop admitting, finish its in-flight
+    requests and exit (launch/run.py installs the drain handler) — the
+    warm KV and live streams survive scale-down. SIGKILL only lands
+    after ``drain_grace_s`` as the unresponsive-worker backstop."""
 
-    def __init__(self, worker_cmd: list[str]):
+    def __init__(self, worker_cmd: list[str], drain_grace_s: float = 30.0):
         # e.g. [sys.executable, "-m", "dynamo_tpu.cli", "run",
         #       "in=endpoint", "out=mocker", "--control-plane", addr, ...]
         self.worker_cmd = list(worker_cmd)
+        self.drain_grace_s = drain_grace_s
         self.procs: list[subprocess.Popen] = []
+        self.drains_started = 0
+        # retiring workers: drained out of self.procs but possibly still
+        # finishing requests; reaped by their grace tasks. The procs are
+        # tracked separately so shutdown() can SIGKILL a retiree whose
+        # grace task it cancels (a SIGTERM-ignoring worker must never
+        # outlive the planner as an orphan).
+        self._retiring: list[asyncio.Task] = []
+        self._retiring_procs: list[subprocess.Popen] = []
 
     def current_replicas(self) -> int:
         self.procs = [p for p in self.procs if p.poll() is None]
         return len(self.procs)
 
+    async def _retire(self, proc: subprocess.Popen) -> None:
+        """SIGTERM -> wait out the drain grace -> SIGKILL backstop."""
+        try:
+            proc.terminate()
+            deadline = time.monotonic() + self.drain_grace_s
+            while proc.poll() is None and time.monotonic() < deadline:
+                await asyncio.sleep(0.1)
+            if proc.poll() is None:
+                log.warning(
+                    "planner: worker pid %d ignored drain for %.0fs; "
+                    "killing", proc.pid, self.drain_grace_s,
+                )
+                proc.kill()
+        finally:
+            if proc in self._retiring_procs:
+                self._retiring_procs.remove(proc)
+
     async def set_replicas(self, n: int) -> None:
         self.current_replicas()  # reap exited
+        self._retiring = [t for t in self._retiring if not t.done()]
         while len(self.procs) < n:
             proc = subprocess.Popen(
                 self.worker_cmd,
@@ -85,15 +115,25 @@ class LocalConnector:
             log.info("planner: spawned worker pid %d", proc.pid)
         while len(self.procs) > n:
             proc = self.procs.pop()
-            log.info("planner: retiring worker pid %d", proc.pid)
-            proc.terminate()
+            log.info("planner: draining worker pid %d (grace %.0fs)",
+                     proc.pid, self.drain_grace_s)
+            self.drains_started += 1
+            self._retiring_procs.append(proc)
+            self._retiring.append(
+                asyncio.get_running_loop().create_task(self._retire(proc))
+            )
 
     async def shutdown(self) -> None:
         procs = list(self.procs)  # set_replicas(0) empties self.procs
         await self.set_replicas(0)
-        for p in procs:
+        for t in self._retiring:
+            t.cancel()
+        # cancelled grace tasks lose their SIGKILL backstop: kill every
+        # still-alive proc, INCLUDING mid-retirement ones
+        for p in procs + list(self._retiring_procs):
             if p.poll() is None:
-                p.kill()  # backstop for workers ignoring SIGTERM
+                p.kill()  # shutdown is immediate, not graceful
+        self._retiring_procs.clear()
 
 
 class MultihostLocalConnector:

@@ -1,0 +1,47 @@
+"""Resilience plane: worker failure as a first-class serving event.
+
+The reference Dynamo ships a fault-tolerance suite (tests/fault_tolerance/
+configs/agg_tp_2_dp_4.yaml), lease-based liveness and request migration so
+a dead engine never kills an in-flight stream. This package is the
+TPU-native analogue, spanning every serving layer:
+
+  policy.py     RetryPolicy (jittered exponential backoff) and the
+                CircuitBreaker state machine (CLOSED -> OPEN -> HALF_OPEN)
+  health.py     WorkerHealthTracker: per-worker heartbeat leases fed by
+                the existing load-metrics stream + one breaker per worker
+  migration.py  mid-stream request migration: rebuild a dead worker's
+                stream as prompt + emitted tokens and replay it as a
+                prefill on a healthy worker (Llumnix-style live
+                migration; the paged-KV prefix cache makes the replay
+                mostly a cache hit)
+  drain.py      graceful drain: stop admitting, finish in-flight, exit —
+                the planner's scale-down path (/drain on the system
+                server, SIGTERM on the worker process)
+  chaos.py      fault-injection harness: kill_worker / stall_stream /
+                drop_response / delay hooks armed via env, CLI, or the
+                system server's /chaos control (tools/chaos.py)
+  metrics.py    dynamo_migration_* / dynamo_resilience_* counters
+                rendered on all three scrape surfaces
+"""
+from dynamo_tpu.resilience.chaos import CHAOS, ChaosHooks, ChaosPoint
+from dynamo_tpu.resilience.drain import DrainController, WorkerDrainingError
+from dynamo_tpu.resilience.health import WorkerHealthTracker
+from dynamo_tpu.resilience.metrics import RESILIENCE, ResilienceMetrics
+from dynamo_tpu.resilience.migration import MigrationPolicy, build_replay_request
+from dynamo_tpu.resilience.policy import BreakerState, CircuitBreaker, RetryPolicy
+
+__all__ = [
+    "BreakerState",
+    "CHAOS",
+    "ChaosHooks",
+    "ChaosPoint",
+    "CircuitBreaker",
+    "DrainController",
+    "MigrationPolicy",
+    "RESILIENCE",
+    "ResilienceMetrics",
+    "RetryPolicy",
+    "WorkerDrainingError",
+    "WorkerHealthTracker",
+    "build_replay_request",
+]

@@ -1,0 +1,186 @@
+"""Fault-injection harness (reference tests/fault_tolerance scenarios).
+
+A process-global registry of named injection points, armed via:
+
+  env   DYNAMO_CHAOS="kill_worker:p=0.5:after=3,delay:t=0.05"
+  CLI   dynamo-tpu run ... --chaos "stall_stream:t=30"
+  HTTP  POST /chaos on the worker system server (tools/chaos.py arms a
+        running deployment without restarts)
+
+Points (all injected into the remote-engine serving path, i.e. the worker
+side of the push-RPC plane — exactly where a real worker death manifests):
+
+  kill_worker    after ``after`` outputs, die mid-stream: the connection
+                 drops with no done-frame, the client sees transport loss
+                 (EndpointConnectionError) and the router migrates
+  stall_stream   after ``after`` outputs, hang for ``t`` seconds
+                 (wedged-device shape; no error raised)
+  drop_response  silently swallow one output (lossy-worker shape — for
+                 testing loss DETECTION; migration can't repair in-band
+                 loss)
+  delay          sleep ``t`` seconds before each output (slow worker)
+
+Entry grammar: comma-separated ``name[:key=value]*`` with keys
+``p`` (probability, default 1), ``t`` (seconds), ``after`` (output count).
+"""
+from __future__ import annotations
+
+import asyncio
+import logging
+import random
+from dataclasses import dataclass, field
+from typing import Any, AsyncIterator, Optional
+
+from dynamo_tpu.resilience.metrics import RESILIENCE
+
+log = logging.getLogger(__name__)
+
+POINT_NAMES = ("kill_worker", "stall_stream", "drop_response", "delay")
+
+
+class ChaosInjectedError(ConnectionResetError):
+    """The kill_worker fault: raised inside the worker's stream handler so
+    the endpoint server drops the connection without a done-frame —
+    indistinguishable from a real worker death to the client."""
+
+
+@dataclass
+class ChaosPoint:
+    name: str
+    armed: bool = False
+    probability: float = 1.0
+    delay_s: float = 0.0
+    after_outputs: int = 0
+    # one-shot fuse: disarm after the first injection (deterministic tests)
+    once: bool = False
+    injected_total: int = 0
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "name": self.name, "armed": self.armed,
+            "probability": self.probability, "delay_s": self.delay_s,
+            "after_outputs": self.after_outputs, "once": self.once,
+            "injected_total": self.injected_total,
+        }
+
+
+class ChaosHooks:
+    """The injection-point registry + the stream wrapper applying it."""
+
+    def __init__(self, rng: Optional[random.Random] = None):
+        self.points: dict[str, ChaosPoint] = {
+            name: ChaosPoint(name) for name in POINT_NAMES
+        }
+        self.rng = rng or random.Random()
+
+    # ---- arming ----
+
+    def arm(self, name: str, *, probability: float = 1.0,
+            delay_s: float = 0.0, after_outputs: int = 0,
+            once: bool = False) -> ChaosPoint:
+        p = self.points[name]
+        p.armed = True
+        p.probability = probability
+        p.delay_s = delay_s
+        p.after_outputs = after_outputs
+        p.once = once
+        log.warning("chaos point armed: %s", p.to_dict())
+        return p
+
+    def disarm(self, name: str) -> None:
+        self.points[name].armed = False
+
+    def disarm_all(self) -> None:
+        for p in self.points.values():
+            p.armed = False
+
+    def reset(self) -> None:
+        """Disarm everything and zero the injection counters (tests)."""
+        for name in list(self.points):
+            self.points[name] = ChaosPoint(name)
+
+    def list_points(self) -> list[dict[str, Any]]:
+        return [p.to_dict() for p in self.points.values()]
+
+    def configure(self, spec: str) -> None:
+        """Parse the env/CLI grammar and arm the named points."""
+        for entry in (spec or "").split(","):
+            entry = entry.strip()
+            if not entry:
+                continue
+            fields = entry.split(":")
+            name = fields[0].strip()
+            if name not in self.points:
+                raise ValueError(
+                    f"unknown chaos point {name!r} (have {POINT_NAMES})"
+                )
+            kw: dict[str, Any] = {}
+            for f in fields[1:]:
+                k, _, v = f.partition("=")
+                k = k.strip()
+                if k == "p":
+                    kw["probability"] = float(v)
+                elif k == "t":
+                    kw["delay_s"] = float(v)
+                elif k == "after":
+                    kw["after_outputs"] = int(v)
+                elif k == "once":
+                    kw["once"] = v.strip().lower() in ("1", "true", "yes", "")
+                else:
+                    raise ValueError(f"unknown chaos key {k!r} in {entry!r}")
+            self.arm(name, **kw)
+
+    def any_armed(self) -> bool:
+        return any(p.armed for p in self.points.values())
+
+    # ---- injection ----
+
+    def _record(self, p: ChaosPoint) -> None:
+        """Shared injection bookkeeping: counters, one-shot disarm, log."""
+        p.injected_total += 1
+        RESILIENCE.inc("dynamo_resilience_chaos_injections_total")
+        if p.once:
+            p.armed = False
+        log.warning("chaos injected: %s (#%d)", p.name, p.injected_total)
+
+    def _fire(self, p: ChaosPoint) -> bool:
+        if not p.armed or self.rng.random() >= p.probability:
+            return False
+        self._record(p)
+        return True
+
+    async def wrap_stream(
+        self, stream: AsyncIterator[Any]
+    ) -> AsyncIterator[Any]:
+        """Apply armed points to one response stream (worker side)."""
+        n = 0
+        kill = self.points["kill_worker"]
+        stall = self.points["stall_stream"]
+        drop = self.points["drop_response"]
+        delay = self.points["delay"]
+        # per-stream trigger decisions are made once at stream start so a
+        # p=0.5 kill doesn't re-roll on every output
+        do_kill = kill.armed and self.rng.random() < kill.probability
+        do_stall = stall.armed and self.rng.random() < stall.probability
+        async for item in stream:
+            # re-check armed at injection time: the per-stream trigger is
+            # latched at stream start, but a once-fused point disarmed by
+            # a CONCURRENT stream's injection must not fire again
+            if do_kill and kill.armed and n >= kill.after_outputs:
+                self._record(kill)
+                raise ChaosInjectedError("chaos: worker killed mid-stream")
+            if do_stall and stall.armed and n >= stall.after_outputs:
+                self._record(stall)
+                do_stall = False  # stall once per stream
+                await asyncio.sleep(stall.delay_s)
+            if delay.armed and self._fire(delay):
+                await asyncio.sleep(delay.delay_s)
+            n += 1
+            if drop.armed and self._fire(drop):
+                continue
+            yield item
+
+
+# process-wide hooks: the worker serving path consults this instance; the
+# system server's /chaos control and the env/CLI config mutate it
+CHAOS = ChaosHooks()

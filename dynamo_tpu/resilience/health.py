@@ -1,0 +1,123 @@
+"""Worker health/lease plane: heartbeats from the metrics stream + one
+circuit breaker per worker.
+
+The distributed runtime already has hard liveness (registration keys die
+with the worker's store lease). This tracker adds the SOFT layer routers
+need *between* lease expiries: every ForwardPassMetrics publication is a
+heartbeat (the metrics plane ticks every engine round, far faster than
+the lease TTL), and per-worker breakers trip a worker out of routing
+after consecutive request failures — a worker can be lease-alive yet
+unable to serve (wedged device, chaos-injected stalls), and waiting for
+the lease to expire would feed it traffic the whole time.
+"""
+from __future__ import annotations
+
+import time
+from typing import Callable, Iterable, Optional
+
+from dynamo_tpu.resilience.metrics import RESILIENCE
+from dynamo_tpu.resilience.policy import BreakerState, CircuitBreaker
+
+
+class WorkerHealthTracker:
+    """Per-worker breaker + last-heartbeat table.
+
+    ``heartbeat_ttl_s`` only applies to workers that have heartbeated at
+    least once: a fleet without a wired metrics stream (unit tests,
+    embedded local engines) stays fully routable on breaker state alone.
+    """
+
+    def __init__(
+        self,
+        *,
+        failure_threshold: int = 3,
+        reset_timeout_s: float = 5.0,
+        heartbeat_ttl_s: Optional[float] = None,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.failure_threshold = failure_threshold
+        self.reset_timeout_s = reset_timeout_s
+        self.heartbeat_ttl_s = heartbeat_ttl_s
+        self.clock = clock
+        self._breakers: dict[str, CircuitBreaker] = {}
+        self._last_seen: dict[str, float] = {}
+
+    def breaker(self, worker_id: str) -> CircuitBreaker:
+        b = self._breakers.get(worker_id)
+        if b is None:
+            b = self._breakers[worker_id] = CircuitBreaker(
+                self.failure_threshold, self.reset_timeout_s, self.clock
+            )
+        return b
+
+    # ---- heartbeats (fed by the load-metrics stream) ----
+
+    def heartbeat(self, worker_id: str) -> None:
+        self._last_seen[worker_id] = self.clock()
+
+    def observe_metrics(self, m) -> None:
+        """Feed one ForwardPassMetrics publication (watcher/exporter tap)."""
+        wid = getattr(m, "worker_id", "") or ""
+        if wid:
+            self.heartbeat(wid)
+
+    def stale(self, worker_id: str) -> bool:
+        if self.heartbeat_ttl_s is None:
+            return False
+        seen = self._last_seen.get(worker_id)
+        if seen is None:
+            return False  # never heartbeated: no signal, not stale
+        return self.clock() - seen > self.heartbeat_ttl_s
+
+    # ---- routing decisions ----
+
+    def blocked(self, worker_ids: Iterable[str]) -> set[str]:
+        """Workers that must NOT receive traffic right now. Side-effect
+        free (peek_allow): the half-open probe grant is consumed only by
+        ``on_routed`` for the worker actually dispatched to — consuming
+        it here would starve a recovered worker whenever the scheduler
+        picked someone else for that decision."""
+        out = set()
+        for wid in worker_ids:
+            if self.stale(wid):
+                out.add(wid)
+                continue
+            b = self._breakers.get(wid)
+            if b is not None and not b.peek_allow():
+                out.add(wid)
+        self._export_open_gauge()
+        return out
+
+    def on_routed(self, worker_id: str) -> None:
+        """A request is being dispatched to this worker: if its breaker
+        is not CLOSED, this dispatch IS the half-open probe."""
+        b = self._breakers.get(worker_id)
+        if b is not None and b.state is not BreakerState.CLOSED:
+            b.begin_probe()
+            self._export_open_gauge()
+
+    def record_success(self, worker_id: str) -> None:
+        b = self._breakers.get(worker_id)
+        if b is not None:
+            b.record_success()
+            self._export_open_gauge()
+
+    def record_failure(self, worker_id: str) -> None:
+        self.breaker(worker_id).record_failure()
+        self._export_open_gauge()
+
+    def forget(self, worker_id: str) -> None:
+        """Worker left the fleet: drop its breaker + lease state."""
+        self._breakers.pop(worker_id, None)
+        self._last_seen.pop(worker_id, None)
+        self._export_open_gauge()
+
+    def states(self) -> dict[str, str]:
+        return {w: b.state.value for w, b in self._breakers.items()}
+
+    def _export_open_gauge(self) -> None:
+        RESILIENCE.set(
+            "dynamo_resilience_breaker_open",
+            sum(1 for b in self._breakers.values()
+                if b.state is not BreakerState.CLOSED),
+        )

@@ -1,0 +1,88 @@
+"""Resilience counters: one process-wide registry, three scrape surfaces.
+
+Migration, breaker, drain, retry and chaos events increment counters here;
+the frontend ``/metrics``, the per-worker system server and the
+aggregating exporter all append ``render()``'s Prometheus text to their
+output, so the series exist on every surface (zero-valued where the event
+class can't occur in that process). Every family carries HELP/TYPE and is
+documented in README's Observability section — the metrics-contract test
+enforces both.
+"""
+from __future__ import annotations
+
+import threading
+
+# (name, type, help) — the fixed family set. Counters follow the
+# Prometheus naming contract (`*_total`); gauges are plain names.
+FAMILIES: tuple[tuple[str, str, str], ...] = (
+    ("dynamo_migration_total", "counter",
+     "mid-stream request migrations completed (stream resumed on a new worker)"),
+    ("dynamo_migration_failed_total", "counter",
+     "mid-stream migrations that found no healthy worker or failed replay"),
+    ("dynamo_migration_replayed_tokens_total", "counter",
+     "emitted tokens replayed as prefill context during migrations"),
+    ("dynamo_resilience_reroute_total", "counter",
+     "pre-first-token re-routes after an unreachable worker"),
+    ("dynamo_resilience_breaker_trips_total", "counter",
+     "circuit breakers tripped open (consecutive-failure threshold hit)"),
+    ("dynamo_resilience_breaker_open", "gauge",
+     "workers currently tripped out of routing (breaker OPEN or HALF_OPEN)"),
+    ("dynamo_resilience_retries_total", "counter",
+     "retry attempts made under a RetryPolicy (backoff sleeps taken)"),
+    ("dynamo_resilience_chaos_injections_total", "counter",
+     "chaos faults injected by armed injection points"),
+    ("dynamo_resilience_draining", "gauge",
+     "1 while this process is draining (stop admitting, finish in-flight)"),
+    ("dynamo_resilience_drains_total", "counter",
+     "graceful drains completed by this process"),
+)
+
+_KNOWN = {name for name, _, _ in FAMILIES}
+
+
+class ResilienceMetrics:
+    """Thread-safe counter/gauge registry (engine thread increments,
+    asyncio handlers render)."""
+
+    def __init__(self) -> None:
+        self._values: dict[str, float] = {name: 0.0 for name in _KNOWN}
+        self._lock = threading.Lock()
+
+    def inc(self, name: str, n: float = 1.0) -> None:
+        assert name in _KNOWN, f"unknown resilience series {name!r}"
+        with self._lock:
+            self._values[name] += n
+
+    def set(self, name: str, v: float) -> None:
+        assert name in _KNOWN, f"unknown resilience series {name!r}"
+        with self._lock:
+            self._values[name] = float(v)
+
+    def get(self, name: str) -> float:
+        with self._lock:
+            return self._values[name]
+
+    def reset(self) -> None:
+        with self._lock:
+            for name in self._values:
+                self._values[name] = 0.0
+
+    def snapshot(self) -> dict[str, float]:
+        with self._lock:
+            return dict(self._values)
+
+    def render(self) -> str:
+        """Prometheus text for every family (trailing newline included)."""
+        snap = self.snapshot()
+        lines: list[str] = []
+        for name, typ, help_ in FAMILIES:
+            lines.append(f"# HELP {name} {help_}")
+            lines.append(f"# TYPE {name} {typ}")
+            v = snap[name]
+            lines.append(f"{name} {int(v) if v == int(v) else v}")
+        return "\n".join(lines) + "\n"
+
+
+# process-wide registry: router, frontend, drain controller, chaos hooks
+# and retry policies in one process share it (parity with telemetry.TRACES)
+RESILIENCE = ResilienceMetrics()

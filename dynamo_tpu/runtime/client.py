@@ -136,9 +136,21 @@ class KvClient:
         self._rx_task: Optional[asyncio.Task] = None
         self.closed = asyncio.Event()
 
-    async def connect(self, retries: int = 40, delay_s: float = 0.25) -> "KvClient":
+    async def connect(self, retries: int = 40, delay_s: float = 0.25,
+                      retry_policy: Optional[Any] = None) -> "KvClient":
+        # jittered backoff (resilience/policy.py): a fleet of workers
+        # reconnecting after a control-plane restart must not stampede it
+        # on a synchronized retry tick. The legacy (retries, delay_s)
+        # default maps onto a CONSTANT-delay jittered policy
+        # (max_delay == base) so the total time-to-fail stays the legacy
+        # retries * delay_s budget; pass retry_policy for exponential.
+        from dynamo_tpu.resilience.policy import RetryPolicy
+
+        policy = retry_policy or RetryPolicy(
+            max_attempts=retries, base_delay_s=delay_s, max_delay_s=delay_s,
+        )
         last: Optional[Exception] = None
-        for _ in range(retries):
+        for attempt in range(policy.max_attempts):
             try:
                 self._reader, self._writer = await asyncio.open_connection(
                     self.host, self.port
@@ -146,8 +158,10 @@ class KvClient:
                 break
             except OSError as e:
                 last = e
-                await asyncio.sleep(delay_s)
-        else:
+                if attempt == policy.max_attempts - 1:
+                    break
+                await policy.sleep(attempt)
+        if self._writer is None:
             raise ConnectionError(
                 f"cannot reach control plane at {self.host}:{self.port}: {last}"
             )

@@ -68,7 +68,14 @@ class EndpointServer:
         except Exception as e:  # noqa: BLE001 — surface handler errors in-band
             log.exception("endpoint handler failed")
             try:
-                writer.write(encode_frame({"error": str(e), "done": True}))
+                # a ConnectionError from the handler (draining worker, dead
+                # downstream) is RETRIABLE: the client should re-route, not
+                # fail the request — mark the frame so call_endpoint raises
+                # the retriable error class
+                frame = {"error": str(e), "done": True}
+                if isinstance(e, ConnectionError):
+                    frame["retriable"] = True
+                writer.write(encode_frame(frame))
                 await writer.drain()
             except (ConnectionResetError, BrokenPipeError, OSError):
                 pass
@@ -106,6 +113,8 @@ async def call_endpoint(
             if "data" in msg:
                 yield msg["data"]
             if msg.get("error"):
+                if msg.get("retriable"):
+                    raise EndpointConnectionError(msg["error"])
                 raise EndpointStreamError(msg["error"])
             if msg.get("done"):
                 return

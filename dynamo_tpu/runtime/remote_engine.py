@@ -33,7 +33,13 @@ def engine_handler(engine: Any):
     Beyond generate, the handler services control verbs sent as
     ``{"__op__": ...}`` payloads — currently ``clear_kv``, the worker side
     of the frontend's /clear_kv_blocks fan-out (reference
-    http/service/clear_kv_blocks.rs posts to every instance)."""
+    http/service/clear_kv_blocks.rs posts to every instance).
+
+    Armed chaos injection points (resilience/chaos.py) wrap the response
+    stream here — the remote-engine path is exactly where a real worker
+    death manifests, so faults injected here exercise the same failover
+    machinery (transport loss -> EndpointConnectionError -> re-route or
+    migration at the router)."""
 
     async def handler(payload: dict[str, Any]) -> AsyncIterator[dict[str, Any]]:
         if payload.get("__op__") == "clear_kv":
@@ -42,8 +48,23 @@ def engine_handler(engine: Any):
             yield {"cleared": n}
             return
         req = PreprocessedRequest.from_dict(payload)
-        async for out in engine.generate(req):
-            yield out.to_dict()
+
+        async def stream():
+            async for out in engine.generate(req):
+                yield out.to_dict()
+
+        from dynamo_tpu.resilience.chaos import CHAOS
+
+        src = stream()
+        if CHAOS.any_armed():
+            src = CHAOS.wrap_stream(src)
+        try:
+            async for item in src:
+                yield item
+        finally:
+            close = getattr(src, "aclose", None)
+            if close is not None:
+                await close()
 
     return handler
 

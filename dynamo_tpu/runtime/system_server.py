@@ -11,6 +11,12 @@ the debug plane:
   /debug/flight               recent engine-round events (flight ring)
   /debug/trace/{request_id}   this worker's span tree for a request
   /debug/trace                recent completed trace ids
+
+Resilience controls (dynamo_tpu/resilience/):
+  GET/POST /drain             graceful drain state / trigger (stop
+                              admitting, finish in-flight, exit)
+  GET/POST/DELETE /chaos      list / arm / disarm fault-injection points
+                              (tools/chaos.py drives this)
 """
 from __future__ import annotations
 
@@ -20,6 +26,8 @@ from typing import Any, Optional
 
 from aiohttp import web
 
+from dynamo_tpu.resilience.chaos import CHAOS
+from dynamo_tpu.resilience.metrics import RESILIENCE
 from dynamo_tpu.telemetry import TRACES
 from dynamo_tpu.telemetry.metrics import render_histogram
 
@@ -30,7 +38,8 @@ class SystemServer:
     """Tiny per-process observability server. `engine` is optional: when
     it exposes `metrics()` (ForwardPassMetrics), those gauges — and any
     histogram snapshots it carries — are rendered alongside uptime; when
-    it exposes `flight`, the ring serves at /debug/flight."""
+    it exposes `flight`, the ring serves at /debug/flight. ``drain`` is
+    an optional DrainController enabling the /drain control."""
 
     def __init__(
         self,
@@ -39,11 +48,13 @@ class SystemServer:
         host: str = "0.0.0.0",
         port: int = 0,
         worker_id: str = "",
+        drain: Any = None,
     ):
         self.engine = engine
         self.host = host
         self.port = port
         self.worker_id = worker_id
+        self.drain = drain
         self._started = time.monotonic()
         self._runner: Optional[web.AppRunner] = None
         self.app = web.Application()
@@ -54,6 +65,11 @@ class SystemServer:
             web.get("/debug/flight", self.handle_flight),
             web.get("/debug/trace", self.handle_trace_index),
             web.get("/debug/trace/{request_id}", self.handle_trace),
+            web.get("/drain", self.handle_drain_status),
+            web.post("/drain", self.handle_drain),
+            web.get("/chaos", self.handle_chaos_list),
+            web.post("/chaos", self.handle_chaos_arm),
+            web.delete("/chaos", self.handle_chaos_disarm),
         ])
 
     async def start(self) -> "SystemServer":
@@ -126,7 +142,8 @@ class SystemServer:
                         name, snap.get("help", name), snap,
                         label=f'worker="{w}"',
                     ))
-        return "\n".join(lines) + "\n"
+        # resilience plane: drain/chaos/migration counters of THIS process
+        return "\n".join(lines) + "\n" + RESILIENCE.render()
 
     async def handle_metrics(self, request: web.Request) -> web.Response:
         return web.Response(text=self.render(), content_type="text/plain")
@@ -149,6 +166,70 @@ class SystemServer:
             "recorded_total": flight.recorded_total,
             "events": flight.snapshot(),
         })
+
+    # ---- resilience controls ----
+
+    async def handle_drain_status(self, request: web.Request) -> web.Response:
+        if self.drain is None:
+            return web.json_response(
+                {"error": "no drain controller wired"}, status=404
+            )
+        return web.json_response(self.drain.status())
+
+    async def handle_drain(self, request: web.Request) -> web.Response:
+        """POST /drain: stop admitting, finish in-flight, then exit —
+        the operator/planner-facing scale-down control."""
+        if self.drain is None:
+            return web.json_response(
+                {"error": "no drain controller wired"}, status=404
+            )
+        self.drain.request_drain(reason="http /drain")
+        return web.json_response(self.drain.status())
+
+    async def handle_chaos_list(self, request: web.Request) -> web.Response:
+        return web.json_response({
+            "worker_id": self.worker_id,
+            "points": CHAOS.list_points(),
+        })
+
+    async def handle_chaos_arm(self, request: web.Request) -> web.Response:
+        """POST /chaos {"point": name, "probability": p, "delay_s": t,
+        "after_outputs": n, "once": bool} — arm one injection point."""
+        try:
+            body = await request.json()
+        except Exception:  # noqa: BLE001
+            return web.json_response({"error": "invalid JSON"}, status=400)
+        name = body.get("point")
+        if name not in CHAOS.points:
+            return web.json_response(
+                {"error": f"unknown chaos point {name!r}"}, status=400
+            )
+        try:
+            p = CHAOS.arm(
+                name,
+                probability=float(body.get("probability", 1.0)),
+                delay_s=float(body.get("delay_s", 0.0)),
+                after_outputs=int(body.get("after_outputs", 0)),
+                once=bool(body.get("once", False)),
+            )
+        except (TypeError, ValueError) as e:
+            return web.json_response(
+                {"error": f"invalid chaos parameters: {e}"}, status=400
+            )
+        return web.json_response(p.to_dict())
+
+    async def handle_chaos_disarm(self, request: web.Request) -> web.Response:
+        """DELETE /chaos[?point=name] — disarm one point or all."""
+        name = request.query.get("point")
+        if name:
+            if name not in CHAOS.points:
+                return web.json_response(
+                    {"error": f"unknown chaos point {name!r}"}, status=400
+                )
+            CHAOS.disarm(name)
+        else:
+            CHAOS.disarm_all()
+        return web.json_response({"points": CHAOS.list_points()})
 
     async def handle_trace_index(self, request: web.Request) -> web.Response:
         return web.json_response({"recent": TRACES.recent_ids()})

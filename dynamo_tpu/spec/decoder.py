@@ -175,19 +175,20 @@ class SpecDecoder:
     # ------------------------------------------------------------------
 
     def eligible(self, req: Any) -> bool:
-        """Penalties need the counts histogram advanced per token and
-        logprobs need the lp variant of the step — both stay on the
-        fused decode round."""
+        """Logprobs need the lp variant of the step and stay on the fused
+        decode round. Penalized requests SPECULATE: the verifier's scan
+        variant advances the counts histogram inside the accept loop
+        (accept_tokens_penalized), so frequency/presence/repetition
+        penalties are applied per accepted token exactly like the fused
+        sampler."""
+        return req.output_options.logprobs is None
+
+    @staticmethod
+    def penalized(req: Any) -> bool:
         so = req.sampling_options
-        if req.output_options.logprobs is not None:
-            return False
-        if (so.frequency_penalty or 0.0) != 0.0:
-            return False
-        if (so.presence_penalty or 0.0) != 0.0:
-            return False
-        if (so.repetition_penalty or 1.0) != 1.0:
-            return False
-        return True
+        return ((so.frequency_penalty or 0.0) != 0.0
+                or (so.presence_penalty or 0.0) != 0.0
+                or (so.repetition_penalty or 1.0) != 1.0)
 
     # ------------------------------------------------------------------
     # adaptive K
@@ -242,14 +243,21 @@ class SpecDecoder:
         temps: np.ndarray,
         top_ks: np.ndarray,
         top_ps: np.ndarray,
+        penalties=None,
     ):
+        """``penalties`` is None (no slot in the round carries penalties —
+        the common case, no counts upload) or a tuple of (counts [B, V],
+        freq [B], pres [B], rep [B]) host arrays."""
         self.verify_dispatch_total += 1
+        if penalties is not None:
+            penalties = tuple(jnp.asarray(a) for a in penalties)
         return spec_verify(
             self.config, params, ctx_kv, tokens, draft,
             jnp.asarray(slots), jnp.asarray(q_starts),
             jnp.asarray(seq_lens), jnp.asarray(keys),
             jnp.asarray(temps), jnp.asarray(top_ks), jnp.asarray(top_ps),
             self.ecfg.max_top_k, self.ecfg.max_context,
+            penalties,
         )
 
     # ------------------------------------------------------------------

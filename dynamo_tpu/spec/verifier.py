@@ -10,11 +10,11 @@ Logits never leave HBM (the same discipline as engine sampling).
 Acceptance semantics (toks[0] is the pending token, toks[1:] the
 proposals; logits row t scores the token following toks[t]):
 
-  greedy (temp<=0)   longest-prefix match against the raw-logit argmax;
-                     the bonus token is the argmax of the first
-                     mismatching row — exactly what non-speculative
-                     greedy decoding would have produced, so output is
-                     token-identical by construction.
+  greedy (temp<=0)   longest-prefix match against the argmax of the
+                     (penalty-adjusted) logits; the bonus token is the
+                     argmax of the first mismatching row — exactly what
+                     non-speculative greedy decoding would have produced,
+                     so output is token-identical by construction.
   sampled (temp>0)   rejection sampling against the TARGET distribution
                      (same temperature/top-k/top-p masking as
                      sampling.sample_step_impl). Proposals are treated
@@ -26,10 +26,15 @@ proposals; logits row t scores the token following toks[t]):
                      Draws consume the slot's SamplerState PRNG key
                      stream, so seeded requests stay reproducible.
 
-Slots with frequency/presence/repetition penalties are gated OFF
-speculation by the scheduler (the counts histogram would have to advance
-token-by-token inside the accept loop); they decode on the normal fused
-round instead.
+Penalties (frequency/presence/repetition) speculate too: when any slot
+in the round carries them, a scan variant advances the slot's
+output-token COUNTS HISTOGRAM inside the accept loop — row t's logits
+are penalized with the counts as of the accepted prefix up to row t,
+exactly mirroring the per-token advance the fused decode round performs.
+The scan consumes the SAME PRNG key stream as the vectorized path, so a
+zero-count/identity-penalty slot produces bit-identical draws on either
+variant. Rounds with no penalized slot keep the vectorized no-histogram
+path (and skip the [B, V] counts upload entirely).
 """
 from __future__ import annotations
 
@@ -112,6 +117,99 @@ def accept_tokens(
     return out, a + 1, jax.random.key_data(new_key)
 
 
+def accept_tokens_penalized(
+    logits: jnp.ndarray,   # [K+1, V] f32 raw target logits
+    toks: jnp.ndarray,     # [K+1] i32 — pending token, then K proposals
+    key: jnp.ndarray,      # [2] uint32
+    temp: jnp.ndarray,     # scalar f32
+    top_k: jnp.ndarray,    # scalar i32
+    top_p: jnp.ndarray,    # scalar f32
+    counts: jnp.ndarray,   # [V] i32 output-token histogram (emitted so far)
+    freq: jnp.ndarray,     # scalar f32 frequency penalty
+    pres: jnp.ndarray,     # scalar f32 presence penalty
+    rep: jnp.ndarray,      # scalar f32 repetition penalty (1.0 disables)
+    *,
+    max_top_k: int,
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Penalty-aware acceptance: the counts histogram advances INSIDE the
+    accept loop. Row t's logits are penalized with counts as of the
+    accepted chain through row t-1 (a lax.scan carries the histogram, and
+    only rows on the still-accepted prefix advance it), which reproduces
+    the fused decode round's per-token counts advance exactly — greedy
+    output under penalties is token-identical to the non-speculative
+    path. PRNG key consumption matches accept_tokens lane for lane."""
+    T = logits.shape[0]
+    K = T - 1
+    proposed = toks[1:]
+    prop_pad = jnp.concatenate([proposed, jnp.full((1,), -1, jnp.int32)])
+
+    temps = jnp.maximum(temp, 1e-6)
+    pos = jnp.arange(max_top_k)
+    k_eff = jnp.where(top_k <= 0, max_top_k, top_k)
+    mask_k = pos < jnp.minimum(k_eff, max_top_k)
+
+    base = jax.random.wrap_key_data(key, impl="threefry2x32")
+    new_key, sub = jax.random.split(base)
+    subs = jax.random.split(sub, K + 1)
+    bonus_key = subs[K]
+
+    def body(carry, x):
+        counts_t, still = carry
+        logit_row, prop_t, sub_t = x
+        # penalties at THIS position (sampling.apply_penalties, one row)
+        seen = counts_t > 0
+        lr = logit_row - freq * counts_t.astype(jnp.float32)
+        lr = lr - pres * seen.astype(jnp.float32)
+        pen = jnp.where(lr > 0, lr / rep, lr * rep)
+        lr = jnp.where(seen, pen, lr)
+
+        greedy_t = jnp.argmax(lr).astype(jnp.int32)
+        vals, idxs = jax.lax.top_k(lr, max_top_k)
+        scaled = vals / temps
+        probs = jax.nn.softmax(jnp.where(mask_k, scaled, NEG_INF))
+        cum = jnp.cumsum(probs)
+        mask_p = (cum - probs) < top_p
+        final_mask = mask_k & mask_p
+        p = jax.nn.softmax(jnp.where(final_mask, scaled, NEG_INF))
+
+        lane_hit = (idxs == prop_t) & final_mask
+        p_prop = jnp.sum(jnp.where(lane_hit, p, 0.0))
+        u = jax.random.uniform(sub_t)
+        match_t = jnp.where(temp <= 0.0, prop_t == greedy_t, u < p_prop)
+        accept_t = still & match_t
+
+        # bonus candidate for this row (consumed only when this row turns
+        # out to be the first mismatch): leftover-distribution resample
+        # with the rejected proposal masked; prop -1 (row K) masks no lane
+        row_final = jnp.where(
+            idxs == prop_t, NEG_INF, jnp.where(final_mask, scaled, NEG_INF)
+        )
+        choice = jax.random.categorical(bonus_key, row_final)
+        bonus_t = jnp.where(
+            temp <= 0.0, greedy_t, idxs[choice].astype(jnp.int32)
+        )
+
+        # advance the histogram only along the still-accepted chain (and
+        # never for row K's -1 sentinel)
+        delta = jnp.where(accept_t & (prop_t >= 0), 1, 0).astype(jnp.int32)
+        counts_t = counts_t.at[jnp.maximum(prop_t, 0)].add(delta)
+        return (counts_t, accept_t), (accept_t, bonus_t)
+
+    (_, _), (accepts, bonuses) = jax.lax.scan(
+        body, (counts, jnp.bool_(True)), (logits, prop_pad, subs)
+    )
+    a = jnp.sum(accepts[:K].astype(jnp.int32))                   # 0..K
+    bonus = jnp.take(bonuses, a)
+
+    idx = jnp.arange(T)
+    out = jnp.where(
+        idx < a,
+        jnp.concatenate([proposed, jnp.zeros((1,), jnp.int32)]),
+        jnp.where(idx == a, bonus, 0),
+    ).astype(jnp.int32)
+    return out, a + 1, jax.random.key_data(new_key)
+
+
 @functools.partial(jax.jit, static_argnums=(0, 12, 13),
                    donate_argnums=(2,))
 def spec_verify(
@@ -132,6 +230,7 @@ def spec_verify(
     top_ps: jnp.ndarray,    # [B] f32
     max_top_k: int,         # static
     ctx_span: int,          # static — full region window (q_starts > 0)
+    penalties=None,         # None, or (counts [B,V] i32, freq/pres/rep [B])
 ):
     """Score + accept for every speculating slot in one program.
 
@@ -140,6 +239,11 @@ def spec_verify(
     region at [q_start, q_start+K+1); the host commits only the first
     n_out-1 proposals + pending (rollback = pointer truncation, see
     llama.batch_score_impl).
+
+    ``penalties`` switches acceptance to the histogram-advancing scan
+    variant (None compiles the no-penalty path with no counts upload —
+    the pytree structure difference retraces, so each mode keeps its own
+    compiled program).
 
     Adaptive-K contract: K here is the ROUND width — the bucketed max
     of the participating slots' effective K, so the program (and its
@@ -155,7 +259,14 @@ def spec_verify(
     ctx_kv, logits = llama.batch_score_impl(
         config, params, ctx_kv, tokens, slots, q_starts, seq_lens, ctx_span
     )
-    out, n_out, new_keys = jax.vmap(
-        functools.partial(accept_tokens, max_top_k=max_top_k)
-    )(logits, tokens, keys, temps, top_ks, top_ps)
+    if penalties is None:
+        out, n_out, new_keys = jax.vmap(
+            functools.partial(accept_tokens, max_top_k=max_top_k)
+        )(logits, tokens, keys, temps, top_ks, top_ps)
+    else:
+        counts, freqs, press, reps = penalties
+        out, n_out, new_keys = jax.vmap(
+            functools.partial(accept_tokens_penalized, max_top_k=max_top_k)
+        )(logits, tokens, keys, temps, top_ks, top_ps,
+          counts, freqs, press, reps)
     return ctx_kv, out, n_out, new_keys

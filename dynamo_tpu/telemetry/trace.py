@@ -67,15 +67,24 @@ def span_now(name: str, t0_monotonic: float, **attrs: Any) -> Span:
 
 @dataclass
 class Trace:
-    """One request's span tree (flat span list; stage order by start)."""
+    """One request's span tree (flat span list; stage order by start).
+
+    ``sampled=False`` traces are shells: span recording no-ops and the
+    trace is dropped at finish instead of parking in the completed ring —
+    the high-QPS sampling mode (--trace-sample-rate) pays one dict entry
+    per request, not span assembly. A shell can be PROMOTED mid-request
+    (migration/failure paths always trace) and collects spans from then
+    on."""
 
     trace_id: str
     created_s: float = field(default_factory=time.time)
     spans: list[Span] = field(default_factory=list)
     finished: bool = False
+    sampled: bool = True
 
     def add(self, span: Span) -> None:
-        self.spans.append(span)
+        if self.sampled:
+            self.spans.append(span)
 
     def merge_dicts(self, span_dicts: list[dict[str, Any]]) -> None:
         """Fold worker-side spans (annotation payload) into the tree."""
@@ -114,8 +123,8 @@ class TraceStore:
         self._completed: OrderedDict[str, Trace] = OrderedDict()
         self._lock = threading.Lock()
 
-    def start(self, trace_id: str) -> Trace:
-        tr = Trace(trace_id=trace_id)
+    def start(self, trace_id: str, sampled: bool = True) -> Trace:
+        tr = Trace(trace_id=trace_id, sampled=sampled)
         with self._lock:
             # leak bound: a caller that never finishes its traces (crashed
             # stream, test teardown) must not grow the store unboundedly
@@ -146,15 +155,26 @@ class TraceStore:
         through to the annotation path."""
         with self._lock:
             tr = self._resolve(trace_id)
-            if tr is None:
+            if tr is None or not tr.sampled:
                 return False
             tr.add(span)
+            return True
+
+    def promote(self, trace_id: str) -> bool:
+        """Turn an unsampled shell into a full trace mid-request —
+        migrated/failed requests are always traced regardless of the
+        sample rate. True if an active trace exists."""
+        with self._lock:
+            tr = self._resolve(trace_id)
+            if tr is None:
+                return False
+            tr.sampled = True
             return True
 
     def merge(self, trace_id: str, span_dicts: list[dict[str, Any]]) -> None:
         with self._lock:
             tr = self._resolve(trace_id)
-        if tr is not None:
+        if tr is not None and tr.sampled:
             tr.merge_dicts(span_dicts)
 
     def finish(self, trace_id: str) -> Optional[Trace]:
@@ -166,6 +186,8 @@ class TraceStore:
                 a: p for a, p in self._aliases.items() if p != trace_id
             }
             tr.finished = True
+            if not tr.sampled:
+                return tr  # shell: dropped, never parked in the ring
             self._completed[trace_id] = tr
             while len(self._completed) > self.max_completed:
                 self._completed.popitem(last=False)

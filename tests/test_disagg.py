@@ -159,6 +159,39 @@ async def test_disagg_remote_prefill_e2e(setup):
     server.close()
 
 
+async def test_disagg_remote_prefill_spans_ride_finishing_output(setup):
+    """Telemetry satellite: the remote path annotates the finishing
+    output with the decode-side disagg_kv_transfer span AND the prefill
+    worker's own remote_prefill span (shipped back on the done queue) —
+    the remote hop is visible end-to-end in the request's trace tree."""
+    prompt = list(range(1, 50))
+    server, store, rt, port = await start_rt()
+    decode, srv, conf, pworker, pre_eng = await setup_disagg_pair(setup, rt)
+    try:
+        finishing = None
+        async for out in decode.generate(req_for(prompt)):
+            if out.finish_reason is not None:
+                finishing = out
+        assert decode.remote_prefills == 1
+        spans = (finishing.annotations.get("trace") or {}).get("spans", [])
+        names = [s.get("name") for s in spans]
+        assert "disagg_kv_transfer" in names
+        assert "remote_prefill" in names
+        rp = next(s for s in spans if s["name"] == "remote_prefill")
+        assert rp["attrs"]["tokens"] == len(prompt)
+        assert rp["attrs"]["blocks"] >= 3
+        # the engine's own queue/prefill spans are still there
+        assert "prefill" in names
+    finally:
+        await pworker.stop()
+        await srv.stop()
+        await conf.stop()
+        await decode.stop()
+        await pre_eng.stop()
+        await rt.close()
+        server.close()
+
+
 async def test_disagg_fallback_and_stale_job_write_rejected(setup):
     """No prefill worker at first: decode falls back locally after the
     timeout. When a worker later pops the STALE job, its write must be

@@ -287,9 +287,108 @@ async def test_spec_seeded_temperature_reproducible():
     assert len(a[0][0]) == 24
 
 
+def test_accept_penalized_zero_counts_matches_plain():
+    """With a zero histogram and identity penalties, the scan variant is
+    draw-for-draw identical to the vectorized path (same PRNG key
+    consumption) — penalty-free slots co-resident in a penalized round
+    produce the same tokens either way."""
+    from dynamo_tpu.spec.verifier import accept_tokens_penalized
+
+    rng = np.random.RandomState(5)
+    logits = jnp.asarray(rng.randn(5, 16).astype(np.float32))
+    toks = jnp.asarray([1, 3, 4, 7, 9], jnp.int32)
+    key = jnp.asarray([7, 11], jnp.uint32)
+    for temp in (0.0, 0.9):
+        a = accept_tokens(
+            logits, toks, key, jnp.float32(temp), jnp.int32(0),
+            jnp.float32(1.0), max_top_k=8,
+        )
+        b = accept_tokens_penalized(
+            logits, toks, key, jnp.float32(temp), jnp.int32(0),
+            jnp.float32(1.0), jnp.zeros(16, jnp.int32),
+            jnp.float32(0.0), jnp.float32(0.0), jnp.float32(1.0),
+            max_top_k=8,
+        )
+        for x, y in zip(a, b):
+            assert np.asarray(x).tolist() == np.asarray(y).tolist(), temp
+
+
+async def test_spec_penalized_greedy_differential():
+    """Satellite (ROADMAP open item): penalized requests SPECULATE — the
+    counts histogram advances inside the accept loop, and greedy output
+    under frequency/presence/repetition penalties is token-identical to
+    the non-speculative engine."""
+    cfg = ModelConfig.tiny(dtype="float32")
+    setup = (cfg, llama.init_params(cfg, 0))
+    prompts = _prompts()
+    so = SamplingOptions(
+        repetition_penalty=1.3, frequency_penalty=0.4,
+        presence_penalty=0.2,
+    )
+    ref, _, ref_hashes = await run_engine(setup, prompts, so=so)
+    for mode, draft in (("ngram", False), ("draft", True)):
+        spec, st, hashes = await run_engine(
+            setup, prompts, so=so, draft=draft,
+            speculative=mode, num_speculative_tokens=4,
+        )
+        for (rt, _), (stk, _) in zip(ref, spec):
+            assert rt == stk, f"{mode}: penalized speculation diverged"
+        # the penalized slots really speculated (old behavior parked
+        # them on the fused round and verify never ran)
+        assert st["spec_verify_steps"] > 0
+        assert hashes == ref_hashes
+
+
+async def test_spec_penalized_seeded_temperature_reproducible():
+    """Seeded temperature>0 sampling with penalties reproduces across
+    speculative runs (the penalized accept path consumes the same
+    per-slot PRNG stream)."""
+    cfg = ModelConfig.tiny(dtype="float32")
+    setup = (cfg, llama.init_params(cfg, 0))
+    prompts = _prompts()[:1]
+    so = SamplingOptions(temperature=0.9, seed=11, presence_penalty=0.5)
+    a, sa, _ = await run_engine(
+        setup, prompts, so=so, speculative="ngram",
+        num_speculative_tokens=4,
+    )
+    b, _, _ = await run_engine(
+        setup, prompts, so=so, speculative="ngram",
+        num_speculative_tokens=4,
+    )
+    assert a[0][0] == b[0][0]
+    assert len(a[0][0]) == 24
+    assert sa["spec_verify_steps"] > 0
+
+
+async def test_spec_penalized_despec_restores_counts():
+    """Despeculation hands the penalty HISTOGRAM back to the fused
+    sampler: the tail after a context-limit despec stays token-identical
+    under penalties (a reset histogram would change the penalty terms
+    and fork the stream)."""
+    cfg = ModelConfig.tiny(dtype="float32")
+    setup = (cfg, llama.init_params(cfg, 0))
+    rng = np.random.RandomState(1)
+    prompts = [rng.randint(1, 256, 20).tolist()]
+    so = SamplingOptions(repetition_penalty=1.4, frequency_penalty=0.3)
+    ref, _, _ = await run_engine(
+        setup, prompts, max_tokens=100, max_pages_per_seq=4, so=so,
+    )
+    spec, st, _ = await run_engine(
+        setup, prompts, max_tokens=100, max_pages_per_seq=4, so=so,
+        speculative="ngram", num_speculative_tokens=4,
+    )
+    assert ref[0][0] == spec[0][0], "penalized despec tail diverged"
+    assert len(spec[0][0]) == 44
+    assert st["spec_despec_total"] >= 1
+
+
 async def test_spec_ineligible_requests_take_fused_round():
-    """A penalized request decodes on the normal path while an eligible
-    one speculates — mixed rounds coexist in one engine."""
+    """A logprobs request decodes on the normal path (it needs the lp
+    step variant) while an eligible one speculates — mixed rounds
+    coexist in one engine. Penalized requests are NOT ineligible anymore:
+    the verifier's histogram-advancing accept path carries them."""
+    from dynamo_tpu.protocols.common import OutputOptions
+
     cfg = ModelConfig.tiny(dtype="float32")
     setup = (cfg, llama.init_params(cfg, 0))
     eng = make_engine(setup, speculative="ngram", num_speculative_tokens=4)
@@ -297,17 +396,15 @@ async def test_spec_ineligible_requests_take_fused_round():
     try:
         rng = np.random.RandomState(3)
         reqs = []
-        for pen in (1.3, None):
+        for lp in (2, None):
             req = PreprocessedRequest(
                 token_ids=rng.randint(1, 256, 12).tolist(),
                 stop_conditions=StopConditions(
                     max_tokens=16, ignore_eos=True
                 ),
             )
-            if pen is not None:
-                req.sampling_options = SamplingOptions(
-                    repetition_penalty=pen
-                )
+            if lp is not None:
+                req.output_options = OutputOptions(logprobs=lp)
             reqs.append(req)
 
         async def one(req):
@@ -317,7 +414,7 @@ async def test_spec_ineligible_requests_take_fused_round():
             return toks
         got = await asyncio.gather(*[one(r) for r in reqs])
         assert all(len(t) == 16 for t in got)
-        # the eligible request speculated; the penalized one did not
+        # the eligible request speculated; the logprobs one did not
         assert eng.spec.verify_steps > 0
         assert eng.step_count > 0  # fused rounds ran for the other slot
     finally:

@@ -1,0 +1,132 @@
+#!/usr/bin/env python
+"""Arm/disarm fault-injection points on a running deployment.
+
+Drives the worker system server's /chaos control (resilience/chaos.py):
+
+  # what can be injected, and current arm state + injection counters
+  python tools/chaos.py --target 127.0.0.1:9345 list
+
+  # kill the worker's streams after 3 outputs, 20% of requests
+  python tools/chaos.py --target 127.0.0.1:9345 arm kill_worker \
+      --probability 0.2 --after 3
+
+  # one-shot stall (disarms itself after firing once)
+  python tools/chaos.py --target 127.0.0.1:9345 arm stall_stream \
+      --delay 30 --once
+
+  # stand down (one point, or everything)
+  python tools/chaos.py --target 127.0.0.1:9345 disarm kill_worker
+  python tools/chaos.py --target 127.0.0.1:9345 disarm
+
+Pair with `watch` on the same server's /metrics: the injections show as
+dynamo_resilience_chaos_injections_total, and the frontend's
+dynamo_migration_total / dynamo_resilience_reroute_total show the
+recovery machinery absorbing them.
+"""
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import sys
+
+
+async def _req(method: str, url: str, body=None):
+    import aiohttp
+
+    try:
+        async with aiohttp.ClientSession() as s:
+            async with s.request(method, url, json=body) as r:
+                text = await r.text()
+                try:
+                    payload = json.loads(text)
+                except ValueError:
+                    payload = {"raw": text}
+                return r.status, payload
+    except (aiohttp.ClientError, OSError, ValueError) as e:
+        print(f"cannot reach {url}: {e}", file=sys.stderr)
+        raise SystemExit(1)
+
+
+def _fmt_point(p: dict) -> str:
+    state = "ARMED" if p.get("armed") else "idle "
+    extra = []
+    if p.get("probability", 1.0) != 1.0:
+        extra.append(f"p={p['probability']}")
+    if p.get("delay_s"):
+        extra.append(f"t={p['delay_s']}s")
+    if p.get("after_outputs"):
+        extra.append(f"after={p['after_outputs']}")
+    if p.get("once"):
+        extra.append("once")
+    return (f"  {p['name']:<14} [{state}] injected={p['injected_total']}"
+            + (("  " + " ".join(extra)) if extra else ""))
+
+
+async def main_async(args) -> int:
+    base = f"http://{args.target}"
+    if args.action == "list":
+        status, out = await _req("GET", f"{base}/chaos")
+        if status != 200:
+            print(f"error {status}: {out}", file=sys.stderr)
+            return 1
+        print(f"chaos points on {args.target} "
+              f"(worker {out.get('worker_id', '?')}):")
+        for p in out.get("points", []):
+            print(_fmt_point(p))
+        return 0
+    if args.action == "arm":
+        body = {
+            "point": args.point,
+            "probability": args.probability,
+            "delay_s": args.delay,
+            "after_outputs": args.after,
+            "once": args.once,
+        }
+        status, out = await _req("POST", f"{base}/chaos", body)
+        if status != 200:
+            print(f"error {status}: {out}", file=sys.stderr)
+            return 1
+        print("armed:")
+        print(_fmt_point(out))
+        return 0
+    # disarm
+    url = f"{base}/chaos"
+    if args.point:
+        url += f"?point={args.point}"
+    status, out = await _req("DELETE", url)
+    if status != 200:
+        print(f"error {status}: {out}", file=sys.stderr)
+        return 1
+    print("disarmed; current state:")
+    for p in out.get("points", []):
+        print(_fmt_point(p))
+    return 0
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(
+        description="list/arm chaos injection points on a running worker"
+    )
+    p.add_argument("--target", required=True, metavar="HOST:PORT",
+                   help="a worker's system server (--system-port)")
+    sub = p.add_subparsers(dest="action", required=True)
+    sub.add_parser("list", help="show points, arm state and counters")
+    parm = sub.add_parser("arm", help="arm one injection point")
+    parm.add_argument("point", choices=(
+        "kill_worker", "stall_stream", "drop_response", "delay"))
+    parm.add_argument("--probability", type=float, default=1.0)
+    parm.add_argument("--delay", type=float, default=0.0,
+                      help="seconds (stall_stream / delay points)")
+    parm.add_argument("--after", type=int, default=0,
+                      help="trigger after N outputs (kill/stall)")
+    parm.add_argument("--once", action="store_true",
+                      help="disarm after the first injection")
+    pdis = sub.add_parser("disarm", help="disarm one point (or all)")
+    pdis.add_argument("point", nargs="?", default=None)
+    args = p.parse_args()
+    return asyncio.run(main_async(args))
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
