@@ -268,10 +268,12 @@ async def run_bench() -> dict:
 
 def _routing_mode_fields() -> dict:
     """BASELINE config-3 tracking (KV-aware routing TTFT, the reference's
-    3x headline) plus the resilience fault phase (mid-stream worker-death
-    recovery latency, tokens lost, migration counts): run the CPU mocker
-    experiments in a subprocess so they never touch the TPU run.
-    Best-effort."""
+    3x headline) plus the resilience fault phase and the disagg
+    chunk-pipeline phase (transfer_overlap_ratio, chunked-vs-monolithic
+    remote-prefill TTFT): run the CPU mocker/tiny-engine experiments in a
+    subprocess so they never touch the TPU run. Best-effort — a failure
+    surfaces as routing_error + a failed_phases entry, never a lost
+    bench line."""
     import subprocess
     import sys
 
@@ -282,11 +284,11 @@ def _routing_mode_fields() -> dict:
         env.pop("PYTHONWARNINGS", None)
         out = subprocess.run(
             [sys.executable, "-m", "dynamo_tpu.bench_modes"],
-            capture_output=True, text=True, timeout=240, env=env,
+            capture_output=True, text=True, timeout=420, env=env,
         )
         return json.loads(out.stdout.strip().splitlines()[-1])
-    except Exception:  # noqa: BLE001 — secondary metric only
-        return {}
+    except Exception as e:  # noqa: BLE001 — secondary metric only
+        return {"routing_error": str(e)[:200]}
 
 
 def _run_8b_int8_phase() -> dict:
@@ -550,8 +552,12 @@ async def _run_spec_phase() -> dict:
 
 
 def _extra_phase(fields_prefix: str, fn, out: dict,
-                 budget_left_s: float) -> float:
-    """Run one optional bench phase unless the wall budget is spent."""
+                 budget_left_s: float,
+                 failed_phases: list = None) -> float:
+    """Run one optional bench phase unless the wall budget is spent. A
+    crash records {prefix}_error AND a failed_phases entry — the final
+    JSON line always emits (a bench run that can't be parsed is silent
+    data loss)."""
     import gc
 
     if budget_left_s <= 0:
@@ -566,6 +572,8 @@ def _extra_phase(fields_prefix: str, fn, out: dict,
         out.update(fn())
     except Exception as e:  # noqa: BLE001 — secondary metrics only
         out[f"{fields_prefix}_error"] = str(e)[:200]
+        if failed_phases is not None:
+            failed_phases.append(fields_prefix)
     return time.monotonic() - t0
 
 
@@ -598,54 +606,99 @@ def _run_isl3000_phase() -> dict:
 
 
 def main():
-    stats = run_bench()
-    if asyncio.iscoroutine(stats):
-        stats = asyncio.run(stats)
-    stats.update(_routing_mode_fields())
+    """Run every phase and ALWAYS emit the single-line JSON summary —
+    a phase crash lands in ``failed_phases`` (plus a per-phase _error
+    field) instead of killing the process before the print. BENCH_r05
+    showed rc=0 with no parseable line after an engine crash: that is
+    silent data loss for the perf trajectory, never again."""
+    failed_phases: list = []
+    stats: dict = {}
+    try:
+        stats = run_bench()
+        if asyncio.iscoroutine(stats):
+            stats = asyncio.run(stats)
+    except BaseException as e:  # noqa: BLE001 — the JSON line must emit
+        failed_phases.append("core")
+        stats = {"core_error": str(e)[:300]}
+    rm = _routing_mode_fields()
+    # phases that crash INSIDE the bench_modes subprocess (it exits 0
+    # with a {phase}_error field) must land in failed_phases too, not
+    # only a whole-subprocess failure
+    for k in sorted(rm):
+        if k.endswith("_error"):
+            failed_phases.append(k[: -len("_error")])
+    stats.update(rm)
     model = os.environ.get("DYNAMO_BENCH_MODEL", "llama3_1b")
     metric = {
         "llama3_1b": "decode_throughput_llama3.2-1b_bf16_agg",
     }.get(model, f"decode_throughput_{model}_agg")
+    decode_tok_s = stats.get("decode_tok_s")
+    # `is not None`, not truthiness: a measured 0.0 must emit as 0.0 —
+    # value=null is reserved for "the phase did not produce a number"
     out = {
         "metric": metric,
-        "value": round(stats["decode_tok_s"], 2),
+        "value": round(decode_tok_s, 2) if decode_tok_s is not None else None,
         "unit": "tok/s/chip",
-        "vs_baseline": round(stats["decode_tok_s"] / BASELINE_DECODE_TOK_S, 3),
+        "vs_baseline": (round(decode_tok_s / BASELINE_DECODE_TOK_S, 3)
+                        if decode_tok_s is not None else None),
     }
     for k in ("prefill_tok_s", "prefill_mfu", "ttft_p50_s", "ttft_p95_s",
               "ttft_p99_s", "itl_p50_s", "itl_p95_s", "itl_p99_s",
               "ttft_isolated_s", "decode_ms_per_step",
               "device_ms_per_step", "mfu",
               "roofline_frac", "chip", "params_m", "batch",
+              "core_error", "routing_error",
               "routing_kv_ttft_ms", "routing_random_ttft_ms",
               "routing_ttft_speedup",
               # fault phase (bench_modes.fault_experiment): mid-stream
               # worker-death recovery latency + exactly-once accounting
               "fault_requests", "fault_kills", "fault_migrations",
               "fault_tokens_lost", "fault_recovery_p50_ms",
-              "fault_recovery_p95_ms"):
+              "fault_recovery_p95_ms",
+              # disagg chunk-pipeline phase (bench_modes.
+              # disagg_experiment): how much transfer the overlap hides
+              "disagg_chunked_ttft_ms", "disagg_mono_ttft_ms",
+              "disagg_ttft_speedup", "transfer_overlap_ratio",
+              "disagg_chunks_streamed", "disagg_token_equal",
+              "disagg_error"):
         v = stats.get(k)
+        if v is None and k.endswith("_error"):
+            continue
         out[k] = round(v, 4) if isinstance(v, float) else v
     if (os.environ.get("DYNAMO_BENCH_EXTRA", "1") != "0"
             and os.environ.get("DYNAMO_BENCH_TINY") != "1"
-            and model == "llama3_1b"):
+            and model == "llama3_1b" and "core" not in failed_phases):
         # extra measured phases, most important first, under a wall
         # budget so a slow run still emits the JSON line
         budget = float(os.environ.get("DYNAMO_BENCH_BUDGET_S", 900))
-        budget -= _extra_phase("int8_8b", _run_8b_int8_phase, out, budget)
+        budget -= _extra_phase("int8_8b", _run_8b_int8_phase, out, budget,
+                               failed_phases)
         budget -= _extra_phase(
-            "spec", lambda: asyncio.run(_run_spec_phase()), out, budget)
+            "spec", lambda: asyncio.run(_run_spec_phase()), out, budget,
+            failed_phases)
         budget -= _extra_phase(
-            "reuse", lambda: asyncio.run(_run_reuse_phase()), out, budget)
-        budget -= _extra_phase("isl3000", _run_isl3000_phase, out, budget)
+            "reuse", lambda: asyncio.run(_run_reuse_phase()), out, budget,
+            failed_phases)
+        budget -= _extra_phase("isl3000", _run_isl3000_phase, out, budget,
+                               failed_phases)
     elif (os.environ.get("DYNAMO_BENCH_EXTRA", "1") != "0"
-            and os.environ.get("DYNAMO_BENCH_TINY") == "1"):
+            and os.environ.get("DYNAMO_BENCH_TINY") == "1"
+            and "core" not in failed_phases):
         # the spec phase has a tiny mode: keep it observable in CI runs
         _extra_phase(
             "spec", lambda: asyncio.run(_run_spec_phase()), out,
-            float(os.environ.get("DYNAMO_BENCH_BUDGET_S", 900)))
-    print(json.dumps(out))
+            float(os.environ.get("DYNAMO_BENCH_BUDGET_S", 900)),
+            failed_phases)
+    out["failed_phases"] = failed_phases
+    print(json.dumps(out, default=str))
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    except BaseException as e:  # noqa: BLE001 — last-ditch JSON line
+        print(json.dumps({
+            "metric": "decode_throughput", "value": None,
+            "unit": "tok/s/chip", "vs_baseline": None,
+            "failed_phases": ["bench"], "error": str(e)[:300],
+        }))

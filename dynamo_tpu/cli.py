@@ -10,7 +10,10 @@ Subcommands:
          --spec-min-k for acceptance-adaptive speculative decoding;
          resilience: --chaos SPEC arms fault injection, --drain-timeout
          bounds graceful drain (SIGTERM / POST /drain), frontends take
-         --trace-sample-rate for high-QPS trace sampling)
+         --trace-sample-rate for high-QPS trace sampling;
+         KV-transfer plane: --kv-transfer-chunk-pages /
+         --kv-transfer-inflight-chunks tune the chunk pipeline
+         (0 pages = monolithic), --xfer-op-timeout bounds page ops)
   cp    run the control-plane store (native dcp-server if built, else the
         wire-compatible Python fallback): cp --port 7111
   serve    launch a whole serving graph (store+workers+frontend) from a

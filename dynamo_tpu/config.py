@@ -51,6 +51,13 @@ class RuntimeConfig:
     # acceptance-adaptive K (per-slot effective K in [spec_min_k, K])
     spec_adaptive: bool = True
     spec_min_k: int = 1
+    # chunk-pipelined KV-transfer plane (kv_transfer.py): pages per
+    # streamed chunk (0 = monolithic single-blob transfers), chunk
+    # gathers/D2H copies in flight per export stream, and the deadline
+    # for one queued page export/import op
+    kv_transfer_chunk_pages: int = 8
+    kv_transfer_inflight_chunks: int = 2
+    xfer_op_timeout_s: float = 120.0
 
     @property
     def store_host_port(self) -> tuple[str, int]:

@@ -33,9 +33,11 @@ from dataclasses import asdict, dataclass, field
 from typing import Any, AsyncIterator, Optional
 
 from dynamo_tpu.kv_transfer import (
+    PageStreamWriter,
     get_descriptor,
     write_remote_pages,
 )
+from dynamo_tpu.kv_transfer_metrics import KV_TRANSFER
 from dynamo_tpu.protocols.common import LLMEngineOutput, PreprocessedRequest
 from dynamo_tpu.runtime.client import KvClient
 from dynamo_tpu.runtime.component import DistributedRuntime
@@ -147,8 +149,18 @@ class RemotePrefillRequest:
 
 
 class PrefillWorker:
-    """Consumes the prefill queue: prefill locally, push KV pages into the
-    decode worker's pool, notify (prefill_worker.py:157-211)."""
+    """Consumes the prefill queue: prefill locally, STREAM KV pages into
+    the decode worker's pool chunk by chunk while the prefill forward is
+    still computing, notify on the final frame (prefill_worker.py:157-211
+    + the DistServe/Mooncake chunk-pipelined KV movement).
+
+    The engine commits complete prefix blocks incrementally per prefill
+    chunk (TpuEngine._seal_prefilled); this worker polls the committed
+    prefix length and exports+ships each new run as its own stream frame
+    — so remote-prefill TTFT approaches max(prefill, transfer) instead
+    of prefill + transfer, and host staging is O(chunk). With
+    ``kv_transfer_chunk_pages == 0`` on the engine config, the legacy
+    monolithic gather -> one-blob write path is used instead."""
 
     def __init__(
         self,
@@ -156,18 +168,33 @@ class PrefillWorker:
         engine: Any,                 # TpuEngine (needs allocator+export_pages)
         namespace: str = "dynamo",
         poll_timeout_s: float = 1.0,
+        stream_poll_s: float = 0.002,
     ):
         self.rt = rt
         self.engine = engine
         self.namespace = namespace
         self.poll_timeout_s = poll_timeout_s
+        # cadence of the committed-prefix poll while prefill runs
+        self.stream_poll_s = stream_poll_s
         self.jobs_handled = 0
         self.jobs_failed = 0
         self.jobs_expired = 0
+        # chunk-pipeline stats (bench disagg phase + tests read these):
+        # transfer seconds spent while the prefill forward was STILL
+        # computing count as hidden — overlap_ratio = hidden / total
+        self.chunks_streamed = 0
+        self.transfer_seconds_total = 0.0
+        self.transfer_seconds_hidden = 0.0
         # cross-host clock-skew grace before declaring a job expired
         self.expiry_skew_s = 5.0
         self._task: Optional[asyncio.Task] = None
         self._stopping = False
+
+    @property
+    def transfer_overlap_ratio(self) -> Optional[float]:
+        if self.transfer_seconds_total <= 0:
+            return None
+        return self.transfer_seconds_hidden / self.transfer_seconds_total
 
     async def start(self) -> "PrefillWorker":
         start = getattr(self.engine, "start", None)
@@ -220,24 +247,83 @@ class PrefillWorker:
         t0 = time.monotonic()
         ps = self.engine.ecfg.page_size
         n_blocks = job.first_block + len(job.dst_pages)
+        seq = TokenBlockSequence.from_tokens(job.token_ids, ps, salt=job.salt)
+        hashes = seq.block_hashes()[:n_blocks]
+        chunk_pages = int(getattr(
+            self.engine.ecfg, "kv_transfer_chunk_pages", 0
+        ))
 
-        # run the prefill forward pass through the engine (one sampled token,
-        # discarded — the decode side samples its own first token after its
-        # tail prefill); this commits the prompt's complete blocks into this
-        # worker's prefix cache
+        # the prefill forward pass through the engine (one sampled token,
+        # discarded — the decode side samples its own first token after
+        # its tail prefill); the engine commits each chunk's complete
+        # blocks into this worker's prefix cache AS PREFILL ADVANCES
         req = PreprocessedRequest(
             token_ids=list(job.token_ids),
             model=job.salt,
         )
         req.stop_conditions.max_tokens = 1
         req.stop_conditions.ignore_eos = True
-        async for _ in self.engine.generate(req):
-            pass
 
-        seq = TokenBlockSequence.from_tokens(job.token_ids, ps, salt=job.salt)
-        src_pages = self.engine.allocator.match_prefix(
-            seq.block_hashes()[:n_blocks]
+        async def run_prefill() -> None:
+            async for _ in self.engine.generate(req):
+                pass
+
+        # descriptor BEFORE prefill: the stream starts mid-compute
+        desc = await get_descriptor(self.rt.kv, self.namespace,
+                                    job.dst_worker_id)
+        if desc is None:
+            raise RuntimeError(
+                f"no blockset descriptor for {job.dst_worker_id}"
+            )
+
+        chunk_spans: list[dict] = []
+        overlap: Optional[float] = None
+        if chunk_pages <= 0:
+            n_send = await self._push_monolithic(job, hashes, run_prefill,
+                                                 desc)
+        else:
+            n_send, chunk_spans, overlap = await self._push_stream(
+                job, hashes, run_prefill, desc, chunk_pages
+            )
+        from dynamo_tpu.telemetry.trace import span_now
+
+        # the prefill worker's own span (per-chunk children for the
+        # streamed path), folded into the decode side's trace payload
+        # (DisaggDecodeEngine.generate)
+        span = span_now(
+            "remote_prefill", t0,
+            tokens=len(job.token_ids), blocks=n_send,
+            chunks=max(len(chunk_spans), 1),
+        ).to_dict()
+        if chunk_spans:
+            span["children"] = chunk_spans
+        msg = {
+            "ok": True,
+            "blocks": n_send,
+            "chunks": max(len(chunk_spans), 1),
+            "prefill_ms": (time.monotonic() - t0) * 1e3,
+            "span": span,
+        }
+        if overlap is not None:
+            msg["overlap_ratio"] = round(overlap, 4)
+        await self.rt.kv.qpush(job.done_queue, json.dumps(msg))
+        log.info(
+            "remote prefill %s: %d tokens, %d blocks (%d chunks) -> %s "
+            "in %.1f ms (overlap %s)",
+            job.request_id, len(job.token_ids), n_send,
+            max(len(chunk_spans), 1), job.dst_worker_id,
+            (time.monotonic() - t0) * 1e3,
+            f"{overlap:.2f}" if overlap is not None else "n/a",
         )
+
+    async def _push_monolithic(
+        self, job: RemotePrefillRequest, hashes: list[int],
+        run_prefill, desc,
+    ) -> int:
+        """Legacy path (kv_transfer_chunk_pages == 0): full prefill, one
+        gather, one blob on the wire."""
+        await run_prefill()
+        src_pages = self.engine.allocator.match_prefix(hashes)
         try:
             # under cache pressure some blocks may already be evicted; send
             # the contiguous run we still have from first_block on
@@ -250,35 +336,147 @@ class PrefillWorker:
             )
         finally:
             self.engine.allocator.free(src_pages)
-
-        desc = await get_descriptor(self.rt.kv, self.namespace,
-                                    job.dst_worker_id)
-        if desc is None:
-            raise RuntimeError(
-                f"no blockset descriptor for {job.dst_worker_id}"
-            )
         await write_remote_pages(
             desc.host, desc.port, job.dst_pages[:n_send], data,
             job_id=job.request_id,
         )
+        return n_send
+
+    async def _push_stream(
+        self, job: RemotePrefillRequest, hashes: list[int],
+        run_prefill, desc, chunk_pages: int,
+    ) -> tuple[int, list[dict], Optional[float]]:
+        """Chunk-pipelined push: poll the committed prefix while the
+        prefill forward runs; export+ship every newly complete run of
+        ``chunk_pages`` blocks as one stream frame (sub-chunk remainders
+        flush once prefill finishes). The decode side scatters each frame
+        on arrival and its admission fires on the eof ack — transfer
+        rides BEHIND compute instead of after it."""
+        from dynamo_tpu.resilience.chaos import CHAOS
         from dynamo_tpu.telemetry.trace import span_now
 
-        await self.rt.kv.qpush(job.done_queue, json.dumps({
-            "ok": True,
-            "blocks": n_send,
-            "prefill_ms": (time.monotonic() - t0) * 1e3,
-            # the prefill worker's own span, folded into the decode
-            # side's trace payload (DisaggDecodeEngine.generate)
-            "span": span_now(
-                "remote_prefill", t0,
-                tokens=len(job.token_ids), blocks=n_send,
-            ).to_dict(),
-        }))
-        log.info(
-            "remote prefill %s: %d tokens, %d blocks -> %s in %.1f ms",
-            job.request_id, len(job.token_ids), n_send, job.dst_worker_id,
-            (time.monotonic() - t0) * 1e3,
-        )
+        first = job.first_block
+        n_blocks = len(hashes)
+        alloc = self.engine.allocator
+        gen_task = asyncio.get_running_loop().create_task(run_prefill())
+        writer = PageStreamWriter(desc.host, desc.port,
+                                  job_id=job.request_id)
+        sent = first                   # blocks written to the wire
+        chunk_spans: list[dict] = []
+        xfer_total = 0.0
+        xfer_hidden = 0.0
+        evicted = False
+        # sender-side double buffer: one export dispatched beyond the
+        # chunk being written, so the gather/D2H of run i+1 overlaps run
+        # i's wire drain instead of queueing behind it — without it the
+        # stream falls one export+drain behind prefill per chunk and the
+        # tail ships after compute ends. (lo, hi, t_start, task)
+        pending: Optional[tuple] = None
+        t_pf_end: Optional[float] = None  # first observation of done
+        try:
+            while True:
+                prefill_done = gen_task.done()
+                if prefill_done:
+                    if t_pf_end is None:
+                        t_pf_end = time.monotonic()
+                    await gen_task  # surface prefill failures
+                avail = min(alloc.cached_prefix_len(hashes), n_blocks)
+                exported_to = pending[1] if pending is not None else sent
+                if (pending is None and not evicted
+                        and (avail - exported_to >= chunk_pages
+                             or (prefill_done and avail > exported_to))):
+                    hi = min(exported_to + chunk_pages, avail)
+                    pending = (exported_to, hi, time.monotonic(),
+                               asyncio.ensure_future(self._export_run(
+                                   hashes, exported_to, hi)))
+                    continue
+                if pending is not None and pending[3].done():
+                    lo, hi, tc, task = pending
+                    pending = None
+                    data = await task
+                    if data is None:
+                        evicted = True  # pressure-evicted mid-stream
+                        continue
+                    # dispatch the NEXT export before awaiting this
+                    # chunk's socket drain — that order is the double
+                    # buffer (gather/D2H of run i+1 under run i's wire
+                    # time); dispatching after the drain would serialize
+                    # export and wire again
+                    avail = min(alloc.cached_prefix_len(hashes), n_blocks)
+                    if (avail - hi >= chunk_pages
+                            or (gen_task.done() and avail > hi)):
+                        hi2 = min(hi + chunk_pages, avail)
+                        pending = (hi, hi2, time.monotonic(),
+                                   asyncio.ensure_future(self._export_run(
+                                       hashes, hi, hi2)))
+                    await writer.write_chunk(
+                        job.dst_pages[lo - first: hi - first], data
+                    )
+                    now = time.monotonic()
+                    dur = now - tc
+                    xfer_total += dur
+                    if t_pf_end is None:
+                        # the whole hop ran behind prefill compute
+                        xfer_hidden += dur
+                    else:
+                        # straddling hop: credit the portion that ran
+                        # while prefill was still computing
+                        xfer_hidden += min(dur, max(0.0, t_pf_end - tc))
+                    chunk_spans.append(span_now(
+                        "kv_chunk", tc, blocks=hi - lo, first_block=lo,
+                    ).to_dict())
+                    sent = hi
+                    # mid-stream chaos (stall_stream): wedged-link shape —
+                    # the decode side's timeout must fire and fall back
+                    await CHAOS.maybe_stall(
+                        "stall_stream", writer.chunks_sent)
+                    continue
+                if pending is None and (evicted
+                                        or (prefill_done and avail <= sent)):
+                    break
+                await asyncio.sleep(self.stream_poll_s)
+            if sent <= first:
+                raise RuntimeError("prefilled blocks evicted before export")
+            await writer.commit()
+        finally:
+            if pending is not None:
+                pending[3].cancel()
+            await writer.close()
+            if not gen_task.done():
+                gen_task.cancel()
+            elif not gen_task.cancelled():
+                gen_task.exception()  # retrieve, never leave it unread
+        self.chunks_streamed += len(chunk_spans)
+        self.transfer_seconds_total += xfer_total
+        self.transfer_seconds_hidden += xfer_hidden
+        overlap = xfer_hidden / xfer_total if xfer_total > 0 else None
+        return sent - first, chunk_spans, overlap
+
+    async def _export_run(
+        self, hashes: list[int], lo: int, hi: int
+    ):
+        """Pin + gather blocks [lo, hi) of the chained run; None when the
+        run is no longer fully committed (evicted under pressure).
+
+        The gather goes through export_pages_stream, not export_pages:
+        the engine loop dispatches the gather with an ASYNC D2H copy and
+        keeps running prefill rounds while the copy completes (this
+        worker thread blocks on the chunk queue, which is fine) — a
+        synchronous export would stall the forward pass once per chunk
+        and eat the very overlap the stream exists to create."""
+
+        def pin_and_export():
+            pages = self.engine.allocator.match_prefix(hashes[:hi])
+            try:
+                if len(pages) < hi:
+                    return None
+                return next(iter(self.engine.export_pages_stream(
+                    pages[lo:hi], chunk_pages=hi - lo,
+                )))
+            finally:
+                self.engine.allocator.free(pages)
+
+        return await asyncio.to_thread(pin_and_export)
 
 
 # ---------------------------------------------------------------------------
@@ -321,10 +519,13 @@ class DisaggDecodeEngine:
         self._pending_jobs: set[str] = set()
         self._in_write: set[str] = set()
         self._deferred_free: dict[str, list[int]] = {}
-        # counters (exposed via metrics/tests)
+        # counters (exposed via metrics/tests); fallbacks also feed the
+        # dynamo_disagg_fallback_total series (kv_transfer_metrics)
         self.remote_prefills = 0
         self.local_prefills = 0
         self.remote_fallbacks = 0
+        self.last_transfer_chunks = 0
+        self.last_overlap_ratio: Optional[float] = None
         # prefill-worker spans shipped back on the done queue, keyed by
         # request id until generate() folds them into the trace payload
         self._remote_spans: dict[str, dict] = {}
@@ -495,6 +696,8 @@ class DisaggDecodeEngine:
                     (resp or {}).get("error", "remote prefill timed out")
                 )
             n_got = int(resp.get("blocks", 0))
+            self.last_transfer_chunks = int(resp.get("chunks", 1))
+            self.last_overlap_ratio = resp.get("overlap_ratio")
             if resp.get("span"):
                 self._remote_spans[rid] = resp["span"]
             with self._jobs_lock:
@@ -510,6 +713,8 @@ class DisaggDecodeEngine:
             return bool(committed)
         except Exception:  # noqa: BLE001 — disagg is best-effort
             self.remote_fallbacks += 1
+            # scraped as dynamo_disagg_fallback_total on every surface
+            KV_TRANSFER.inc("dynamo_disagg_fallback_total")
             log.exception("remote prefill failed for %s; local fallback", rid)
             return False
         finally:

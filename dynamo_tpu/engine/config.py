@@ -124,6 +124,21 @@ class EngineConfig:
     # gather size; pow2-bucketed for compile-cache reuse)
     offload_batch: int = 8
 
+    # chunk-pipelined KV-transfer plane (kv_transfer.py / disagg.py):
+    # bulk KV moves (remote-prefill pushes, G4 peer fetches, G2/G3
+    # onboard scatters) run as a pipeline of this many pages per chunk
+    # instead of one monolithic blob — transfer overlaps compute and
+    # peak host staging drops from O(transfer) to O(chunk). 0 restores
+    # the monolithic path.
+    kv_transfer_chunk_pages: int = 8
+    # chunk gathers/D2H copies allowed in flight per export stream (the
+    # double-buffer depth: chunk i's D2H overlaps chunk i+1's gather)
+    kv_transfer_inflight_chunks: int = 2
+    # deadline for one queued page export/import op (engine._xfer_op).
+    # A multi-GiB chunked import on a slow host link can legitimately
+    # exceed the old hard-coded 120 s.
+    xfer_op_timeout_s: float = 120.0
+
     # flight recorder (telemetry/flight.py): ring capacity of recent
     # engine-round events served at /debug/flight and dumped to the log
     # when an engine round fails

@@ -113,6 +113,11 @@ class _Request:
     # never mutates the caller's request object
     tokens: list[int] = field(default_factory=list)
     matched_blocks: int = 0
+    # prompt blocks already copy-committed into the prefix cache; chunked
+    # prefill seals complete blocks INCREMENTALLY (each chunk's full pages
+    # become prefix-hittable while later chunks still compute — what lets
+    # the disagg prefill worker stream them mid-prefill)
+    sealed_prefix: int = 0
     # chunked-prefill progress: tokens already in cache (-1 = not started).
     # Prefill runs ONE chunk per scheduling round so decode rounds
     # interleave with long prompts instead of stalling behind them.
@@ -196,6 +201,36 @@ class _Entry:
     aux: Any = None
     # telemetry: dispatch time, for dynamo_engine_round_seconds
     t_dispatch: float = 0.0
+
+
+# sentinel closing an export stream's chunk queue (engine loop -> consumer)
+_STREAM_EOS = object()
+
+
+@dataclass
+class _ExportStream:
+    """One in-flight chunked page export: the engine loop advances it a
+    little every round (dispatch up to ``inflight`` padded gathers with
+    copy_to_host_async, convert ready heads, feed the consumer queue) —
+    the loop never blocks on the consumer, and the D2H of chunk i
+    overlaps the gather/compute behind chunk i+1."""
+
+    ids: list[int]
+    chunk_pages: int
+    inflight: int
+    out_q: queue_mod.Queue
+    pos: int = 0                      # next page index to gather
+    # (n_real_pages, device handle) per dispatched, unconsumed chunk
+    pending: deque = field(default_factory=deque)
+    # hash-addressed exports pin their matched refs until every gather
+    # is dispatched (device order then protects the reads)
+    free_pages: Optional[list[int]] = None
+    # last time this stream moved (dispatch/convert), seeded with the
+    # registration time: a stream whose consumer vanished mid-pull (or
+    # before pulling anything) parks with a full queue forever, which
+    # would leak its pinned pages — the loop reclaims it after the
+    # transfer deadline of inactivity
+    last_progress: float = field(default_factory=time.monotonic)
 
 
 class TpuEngine:
@@ -369,6 +404,9 @@ class TpuEngine:
 
         self._intake: queue_mod.Queue = queue_mod.Queue()
         self._xfer: queue_mod.Queue = queue_mod.Queue()  # page export/import
+        # chunked page exports in flight (kv_transfer chunk pipeline):
+        # advanced a little every round, never blocking the loop
+        self._xfer_streams: list[_ExportStream] = []
         # G4 remote tier: pages fetched from peer pools land here (from
         # the serving asyncio thread) and drain into the G2 host tier on
         # the engine loop before admission (kv_transfer.RemoteKvFetcher)
@@ -684,6 +722,156 @@ class TpuEngine:
         export_pages)."""
         return self._xfer_op("export_hash", [int(h) for h in hashes], None)
 
+    # ---- chunked export streams (kv_transfer chunk pipeline) ----
+
+    def export_pages_stream(
+        self, page_ids: list[int], chunk_pages: int = 0, inflight: int = 0,
+    ):
+        """Chunked thread-safe export: an iterator of host arrays
+        [2, L, kvh, <=chunk_pages, ps, hd] covering ``page_ids`` in
+        order. The engine loop double-buffers the per-chunk gathers
+        (``kv_transfer_inflight_chunks`` D2H copies in flight) and keeps
+        serving between chunks — peak host staging is O(chunk), and a
+        consumer streaming chunks over TCP overlaps the wire time with
+        the next chunk's gather."""
+        out_q = self._start_stream("export_stream", list(page_ids),
+                                   chunk_pages, inflight)
+        return self._consume_stream(out_q)
+
+    def export_hash_stream(
+        self, hashes: list[int], chunk_pages: int = 0, inflight: int = 0,
+    ) -> tuple[int, Any]:
+        """G4 serving side, chunked: resolve the longest committed run of
+        the chained-hash prefix and export it as (found, chunk iterator)
+        — the streaming analogue of export_pages_by_hash, without ever
+        staging the whole run on host."""
+        out_q = self._start_stream(
+            "export_hash_stream", [int(h) for h in hashes],
+            chunk_pages, inflight,
+        )
+        first = self._next_stream_item(out_q)  # ("found", k) | Exception
+        if isinstance(first, Exception):
+            raise first
+        found = int(first[1])
+        return found, self._consume_stream(out_q)
+
+    def _start_stream(
+        self, kind: str, ids: list[int], chunk_pages: int, inflight: int,
+    ) -> queue_mod.Queue:
+        if self.on_dispatch is not None:
+            raise RuntimeError(
+                "multihost engine: the page transfer plane is single-host"
+            )
+        if self._stop.is_set():
+            raise RuntimeError("engine stopped")
+        if not self._started:
+            self.start()
+        e = self.ecfg
+        chunk_pages = int(chunk_pages or e.kv_transfer_chunk_pages
+                          or max(len(ids), 1))
+        inflight = max(1, int(inflight or e.kv_transfer_inflight_chunks))
+        out_q: queue_mod.Queue = queue_mod.Queue()
+        self._xfer.put((kind, ids, (chunk_pages, inflight, out_q),
+                        threading.Event(), {}))
+        return out_q
+
+    def _next_stream_item(self, out_q: queue_mod.Queue) -> Any:
+        """One queue item with the same stop/deadline discipline as
+        _xfer_op (the engine may stop or wedge mid-stream)."""
+        deadline = time.monotonic() + self.ecfg.xfer_op_timeout_s
+        stop_grace: Optional[float] = None
+        while True:
+            try:
+                return out_q.get(timeout=1.0)
+            except queue_mod.Empty:
+                now = time.monotonic()
+                if self._stop.is_set():
+                    if stop_grace is None:
+                        stop_grace = now + 10.0
+                    elif now > stop_grace:
+                        raise RuntimeError(
+                            "engine stopped during page export stream"
+                        )
+                elif now > deadline:
+                    raise TimeoutError("page export stream timed out")
+
+    def _consume_stream(self, out_q: queue_mod.Queue):
+        while True:
+            item = self._next_stream_item(out_q)
+            if item is _STREAM_EOS:
+                return
+            if isinstance(item, Exception):
+                raise item
+            yield item
+
+    def _service_export_streams(self) -> bool:
+        """Advance every in-flight chunked export a little (called once
+        per round): convert ready head chunks for the consumer, dispatch
+        new gathers up to the double-buffer depth. Returns True if any
+        stream made progress (keeps the loop cycling while exports
+        drain)."""
+        if not self._xfer_streams:
+            return False
+        now = time.monotonic()
+        keep: list[_ExportStream] = []
+        progressed = False
+        for st in self._xfer_streams:
+            try:
+                moved = self._advance_stream(st)
+            except Exception as e:  # noqa: BLE001 — surface to the consumer
+                if st.free_pages is not None:
+                    self.allocator.free(st.free_pages)
+                    st.free_pages = None
+                st.out_q.put(e)
+                st.out_q.put(_STREAM_EOS)
+                progressed = True
+                continue
+            if moved:
+                st.last_progress = now
+                progressed = True
+            if st.pos >= len(st.ids) and not st.pending:
+                st.out_q.put(_STREAM_EOS)
+                progressed = True
+            elif (not moved and now - st.last_progress
+                    > self.ecfg.xfer_op_timeout_s):
+                # consumer vanished mid-stream (dead peer connection):
+                # reclaim the pins instead of leaking them forever
+                if st.free_pages is not None:
+                    self.allocator.free(st.free_pages)
+                    st.free_pages = None
+                st.out_q.put(RuntimeError("export stream abandoned"))
+                st.out_q.put(_STREAM_EOS)
+                progressed = True
+            else:
+                keep.append(st)
+        self._xfer_streams = keep
+        return progressed
+
+    def _advance_stream(self, st: _ExportStream) -> bool:
+        progressed = False
+        # convert ready heads — bounded by consumer pull so a stalled
+        # peer can't grow unbounded host staging
+        while (st.pending and st.pending[0][1].is_ready()
+               and st.out_q.qsize() < st.inflight):
+            n, handle = st.pending.popleft()
+            st.out_q.put(np.asarray(handle)[:, :, :, :n])
+            progressed = True
+        # dispatch the next gathers (async D2H behind compute)
+        while (st.pos < len(st.ids) and len(st.pending) < st.inflight
+               and st.out_q.qsize() < st.inflight):
+            chunk = st.ids[st.pos: st.pos + st.chunk_pages]
+            out = self._gather_padded(chunk)
+            out.copy_to_host_async()
+            st.pending.append((len(chunk), out))
+            st.pos += len(chunk)
+            progressed = True
+        if st.pos >= len(st.ids) and st.free_pages is not None:
+            # every gather is dispatched: device order protects the
+            # reads, drop the pins now (same contract as export_hash)
+            self.allocator.free(st.free_pages)
+            st.free_pages = None
+        return progressed
+
     def _xfer_op(self, kind: str, page_ids: list[int], data) -> Any:
         if self.on_dispatch is not None and kind in (
             "export", "import", "export_hash",
@@ -702,7 +890,7 @@ class TpuEngine:
         # drain) errors still-queued items; an in-flight op completes and
         # reports its real result — we only bound the wait, never clobber
         # the box ourselves (that would misreport a completed transfer).
-        deadline = time.monotonic() + 120.0
+        deadline = time.monotonic() + self.ecfg.xfer_op_timeout_s
         stop_grace: Optional[float] = None
         while not done.wait(timeout=1.0):
             now = time.monotonic()
@@ -727,6 +915,26 @@ class TpuEngine:
                 if kind == "export":
                     out = self._gather_padded(ids)
                     box["result"] = np.asarray(out)[:, :, :, : len(ids)]
+                elif kind == "export_stream":
+                    chunk_pages, inflight, out_q = data
+                    self._xfer_streams.append(_ExportStream(
+                        ids=ids, chunk_pages=chunk_pages,
+                        inflight=inflight, out_q=out_q,
+                    ))
+                elif kind == "export_hash_stream":
+                    # resolve + pin on the engine loop; the stream frees
+                    # the pins once every gather is dispatched
+                    chunk_pages, inflight, out_q = data
+                    pages = self.allocator.match_prefix(ids)
+                    out_q.put(("found", len(pages)))
+                    if not pages:
+                        out_q.put(_STREAM_EOS)
+                    else:
+                        self._xfer_streams.append(_ExportStream(
+                            ids=pages, chunk_pages=chunk_pages,
+                            inflight=inflight, out_q=out_q,
+                            free_pages=pages,
+                        ))
                 elif kind == "export_hash":
                     # G4 peer-serving side: ids are chained block hashes;
                     # resolve the longest committed run, export it, drop
@@ -757,6 +965,10 @@ class TpuEngine:
                     box["result"] = None
             except Exception as e:  # noqa: BLE001 — surface to the caller
                 box["error"] = e
+                if kind in ("export_stream", "export_hash_stream"):
+                    # stream consumers wait on the chunk queue, not the box
+                    data[2].put(e)
+                    data[2].put(_STREAM_EOS)
             finally:
                 done.set()
 
@@ -915,16 +1127,24 @@ class TpuEngine:
         self._drain_xfer_queue()
 
     def _drain_xfer_queue(self) -> None:
-        """Abandon queued transfer ops with an error, not a 120s stall.
+        """Abandon queued transfer ops with an error, not a long stall.
         Only touches items still IN the queue — an in-flight op finishes
         normally and reports its real result."""
         while True:
             try:
-                *_ignored, done, box = self._xfer.get_nowait()
+                kind, _ids, data, done, box = self._xfer.get_nowait()
             except queue_mod.Empty:
                 break
             box["error"] = RuntimeError("engine stopped")
+            if kind in ("export_stream", "export_hash_stream"):
+                data[2].put(box["error"])
+                data[2].put(_STREAM_EOS)
             done.set()
+        # in-flight chunk streams: close their consumer queues too
+        for st in self._xfer_streams:
+            st.out_q.put(RuntimeError("engine stopped"))
+            st.out_q.put(_STREAM_EOS)
+        self._xfer_streams = []
 
     def _round(self) -> bool:
         """One scheduling round: process ready results, flush seal copies,
@@ -936,6 +1156,7 @@ class TpuEngine:
         self._flush_seals()
         self._apply_releases()
         self._process_transfers()
+        stream_work = self._service_export_streams()
         self._dispatch_offloads()
         self._drain_host_ingest()  # G4 pages land before admission
         self._admit()
@@ -949,7 +1170,7 @@ class TpuEngine:
             i for i, s in enumerate(self._slots)
             if s is not None and not s.finished and not s.spec
         ]
-        did_work = bool(self._entries)
+        did_work = bool(self._entries) or stream_work
         rounds_in_flight = sum(1 for en in self._entries if en.kind == "round")
         dispatched = False
         if active and rounds_in_flight <= e.max_inflight_rounds:
@@ -1393,6 +1614,23 @@ class TpuEngine:
         # offload-candidate) once the seal copy below is dispatched
         self.allocator.free([page])
 
+    def _seal_prefilled(self, r: _Request, limit: Optional[int] = None) -> None:
+        """Copy-commit the prompt blocks fully covered by prefill so far
+        (beyond what was prefix-matched). Called after EVERY prefill
+        chunk, not only at prompt completion: complete prefix blocks
+        become prefix-hittable while later chunks still compute — local
+        concurrent duplicates hit them, and the disagg prefill worker
+        streams them to the decode pool mid-prefill (the chunk-pipelined
+        transfer plane's unit of overlap)."""
+        ps = self.ecfg.page_size
+        done_blocks = min(
+            r.prefill_pos // ps if limit is None else limit,
+            len(r.seq.blocks),
+        )
+        for blk in r.seq.blocks[r.sealed_prefix:done_blocks]:
+            self._queue_seal(r, blk.position, blk.block_hash, blk.parent_hash)
+        r.sealed_prefix = max(r.sealed_prefix, done_blocks)
+
     def _flush_seals(self) -> None:
         """Dispatch the batched ctx->pool seal copy (pow2-padded; padding
         rows target scratch page 0). Device order makes this safe: the
@@ -1472,7 +1710,15 @@ class TpuEngine:
         pages = self.allocator.allocate(len(run))
         if pages is None:
             return matched_pages
-        self._scatter_padded(pages, self.offload.gather([h for h, _ in run]))
+        # chunked H2D: gather+scatter kv_transfer_chunk_pages at a time —
+        # peak host staging is O(chunk) instead of O(run), and the
+        # uniform chunk width reuses one compiled scatter shape
+        cp = self.ecfg.kv_transfer_chunk_pages or len(pages)
+        for i in range(0, len(pages), cp):
+            sub = run[i:i + cp]
+            self._scatter_padded(
+                pages[i:i + cp], self.offload.gather([h for h, _ in sub])
+            )
         for pg, (h, parent) in zip(pages, run):
             self.allocator.commit(pg, h, parent)
         log.debug("onboarded %d blocks from host tier", len(pages))
@@ -1507,27 +1753,49 @@ class TpuEngine:
         if not missing:
             return
         t_fetch = time.monotonic()
+        chunk_spans: list[dict] = []
+        t_prev = t_fetch
+
+        def land(offset: int, arr: np.ndarray) -> None:
+            # one streamed chunk: into the host-ingest queue immediately
+            # (the G2 tier fills while later chunks are still on the
+            # wire) + a child span under g4_fetch
+            nonlocal t_prev
+            n = int(arr.shape[3])
+            sub = missing[offset:offset + n]
+            self._host_ingest.put((
+                [b.block_hash for b in sub],
+                [b.parent_hash for b in sub],
+                np.asarray(arr, dtype=off.dtype),
+            ))
+            chunk_spans.append(_span_dict(
+                "g4_chunk", t_prev, blocks=n, offset=offset,
+            ))
+            t_prev = time.monotonic()
+
         try:
-            found, data = await self.remote_kv.fetch(
-                [b.block_hash for b in missing]
+            # every fetch path (chunk-streamed, probe full reply, legacy
+            # monolithic race) delivers pages through `land` — data is
+            # always None here
+            found, _ = await self.remote_kv.fetch(
+                [b.block_hash for b in missing], on_chunk=land,
             )
         except Exception:  # noqa: BLE001 — G4 is best-effort
             log.exception("G4 remote fetch failed")
             return
-        if not found or data is None:
+        if not found:
             return
-        # trace the peer-pool fetch: rides the request's worker-side span
-        # list so migration replays / disagg flows show the G4 hop
-        # end-to-end in /debug/trace/{request_id}
-        r.trace_spans.append(_span_dict(
+        # trace the peer-pool fetch (with its chunk children): rides the
+        # request's worker-side span list so migration replays / disagg
+        # flows show the G4 hop end-to-end in /debug/trace/{request_id}
+        sp = _span_dict(
             "g4_fetch", t_fetch,
             blocks=int(found), requested=len(missing),
-        ))
-        self._host_ingest.put((
-            [b.block_hash for b in missing[:found]],
-            [b.parent_hash for b in missing[:found]],
-            np.asarray(data, dtype=off.dtype),
-        ))
+            chunks=max(len(chunk_spans), 1),
+        )
+        if chunk_spans:
+            sp["children"] = chunk_spans
+        r.trace_spans.append(sp)
 
     def _drain_host_ingest(self) -> None:
         while True:
@@ -1686,6 +1954,7 @@ class TpuEngine:
         for i, r in enumerate(group):
             r.prefill_pos = int(q_starts[i]) + chunk_lens[i]
             if r.prefill_pos < len(r.tokens):
+                self._seal_prefilled(r)  # mid-prompt blocks seal per chunk
                 continue  # multi-chunk: next chunk in a later round
             if self._finish_prefill(r, logits[i], index=i) == "done":
                 done.append(r)
@@ -1770,6 +2039,7 @@ class TpuEngine:
             # refs now (all matched refs, including dropped overflow)
             self.allocator.free(matched_pages)
         r.prefill_pos = len(usable_pages) * ps
+        r.sealed_prefix = len(usable_pages)  # matched blocks: already cached
 
     def _prefill_step(self, r: _Request) -> str:
         """Advance one prefill chunk; on the final chunk, sample the first
@@ -1848,6 +2118,9 @@ class TpuEngine:
         )
         r.prefill_pos = start + len(chunk)
         if r.prefill_pos < len(prompt):
+            # commit the chunk's complete blocks now (prefix-hittable /
+            # streamable while the next chunks compute)
+            self._seal_prefilled(r)
             return "progress"  # decode rounds run before the next chunk
 
         return self._finish_prefill(r, logits)
@@ -1906,10 +2179,9 @@ class TpuEngine:
                 prompt_tokens=len(prompt), matched_blocks=r.matched_blocks,
                 slot=r.slot,
             ))
-        # copy-commit complete prompt blocks beyond the match into the
-        # prefix cache
-        for blk in r.seq.blocks[r.matched_blocks:]:
-            self._queue_seal(r, blk.position, blk.block_hash, blk.parent_hash)
+        # copy-commit the remaining complete prompt blocks into the
+        # prefix cache (earlier chunks sealed theirs incrementally)
+        self._seal_prefilled(r, limit=len(r.seq.blocks))
 
         so = r.req.sampling_options
         if so.seed is not None:

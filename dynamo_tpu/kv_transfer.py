@@ -9,30 +9,49 @@ worker-to-worker with async one-sided reads/writes.
 
 TPU redesign: there is no peer RDMA between separate engine processes, so
 the data plane is **host-staged**: pages are gathered on device ([2, L,
-kvh, n, ps, hd] in one fused jit), DMA'd to host, streamed over TCP as one
-two-part frame (JSON header + raw bytes), and scattered back into the
-receiving pool in one donated jit. Within a process/mesh the same
+kvh, n, ps, hd] in one fused jit), DMA'd to host, streamed over TCP as
+two-part frames (JSON header + raw bytes), and scattered back into the
+receiving pool in donated jits. Within a process/mesh the same
 gather/scatter jits move pages over ICI without touching the host. The
 wire protocol and descriptor flow are transport-independent, so a future
 DCN/ICI fast path slots in behind the same API.
 
+Bulk moves are **chunk-pipelined** (DistServe/Mooncake-style): instead of
+one monolithic blob, a move is a multi-frame sequence of page chunks over
+the same two-part codec — the sender exports+ships chunk i while chunk
+i+1 is still being gathered (or, for disagg remote prefill, while the
+prefill forward is still computing later chunks), and the receiver
+scatters each chunk on arrival. Peak host staging per hop drops from
+O(transfer) to O(chunk); the receiver acks once, at eof.
+
 Ops:
   {"op": "write_pages", "pages": [...], "shape": [...], "dtype": "..."} + payload
       -> {"ok": true}
+  {"op": "write_pages", ..., "stream": true, "seq": i} + payload
+      -> (no reply per chunk; the stream is acked at eof)
+  {"op": "write_pages_eof", "chunks": n}
+      -> {"ok": true, "chunks": n} | {"ok": false, "error": "..."}
   {"op": "read_pages", "pages": [...]}
       -> {"ok": true, "shape": [...], "dtype": "..."} + payload
+  {"op": "read_hashes", "hashes": [...], "probe": true}
+      -> {"ok": true, "found": k}                       (no payload)
+  {"op": "read_hashes", "hashes": [...], "chunk_pages": c}
+      -> {"ok": true, "found": k, "stream": true} then k pages of
+         {"seq": i, "shape": [...], "dtype": "...", "eof": bool} + payload
 """
 from __future__ import annotations
 
 import asyncio
 import json
 import logging
+import time
 from dataclasses import asdict, dataclass, field
-from typing import Any, Callable, Optional
+from typing import Any, Callable, Iterable, Optional
 
 import ml_dtypes  # noqa: F401 — registers bfloat16 with np.dtype
 import numpy as np
 
+from dynamo_tpu.kv_transfer_metrics import KV_TRANSFER
 from dynamo_tpu.runtime.client import KvClient
 from dynamo_tpu.runtime.protocol import (
     encode_frame2,
@@ -141,12 +160,22 @@ class BlockTransferServer:
         read_hashes_fn: Optional[
             Callable[[list[int]], tuple[int, Optional[np.ndarray]]]
         ] = None,
+        # chunk-pipelined serving hooks (both optional; peers fall back
+        # to the monolithic ops when absent):
+        # count_hashes_fn(hashes) -> int — cheap committed-prefix length
+        # (no gather) for the G4 probe round
+        count_hashes_fn: Optional[Callable[[list[int]], int]] = None,
+        # read_hashes_stream_fn(hashes, chunk_pages) -> (found, iterator
+        # of host chunks) — the engine's export_hash_stream
+        read_hashes_stream_fn: Optional[Callable[..., tuple[int, Any]]] = None,
     ):
         self.read_fn = read_fn
         self.write_fn = write_fn
         self.host = host
         self.port = port
         self.read_hashes_fn = read_hashes_fn
+        self.count_hashes_fn = count_hashes_fn
+        self.read_hashes_stream_fn = read_hashes_stream_fn
         self._server: Optional[asyncio.AbstractServer] = None
 
     async def start(self) -> tuple[str, int]:
@@ -166,6 +195,12 @@ class BlockTransferServer:
         self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
     ) -> None:
         loop = asyncio.get_running_loop()
+        # chunk-stream state for THIS connection: scatter failures inside
+        # a stream are remembered (later frames skipped) and reported once
+        # in the eof ack — the sender pipelines frames without per-chunk
+        # acks, so in-band per-frame errors would desync the protocol
+        stream_chunks = 0
+        stream_err: Optional[str] = None
         try:
             while True:
                 header, payload = await read_frame2(reader)
@@ -181,10 +216,61 @@ class BlockTransferServer:
                         args = (pages, data)
                         if header.get("job") is not None:
                             args = (pages, data, header["job"])
+                        if header.get("stream"):
+                            # one chunk of a pipelined stream: guarded
+                            # scatter on arrival, ack deferred to eof
+                            stream_chunks += 1
+                            if stream_err is None:
+                                t0 = time.monotonic()
+                                try:
+                                    await loop.run_in_executor(
+                                        None, self.write_fn, *args
+                                    )
+                                except Exception as e:  # noqa: BLE001
+                                    stream_err = str(e)
+                                    KV_TRANSFER.inc(
+                                        "dynamo_kv_transfer_errors_total"
+                                    )
+                                    log.warning(
+                                        "chunk scatter failed mid-stream "
+                                        "(job=%s seq=%s): %s",
+                                        header.get("job"),
+                                        header.get("seq"), e,
+                                    )
+                                else:
+                                    KV_TRANSFER.inc(
+                                        "dynamo_kv_transfer_rx_chunks_total"
+                                    )
+                                    KV_TRANSFER.inc(
+                                        "dynamo_kv_transfer_rx_bytes_total",
+                                        len(payload),
+                                    )
+                                    KV_TRANSFER.observe(
+                                        "dynamo_kv_transfer_chunk_seconds",
+                                        time.monotonic() - t0,
+                                    )
+                            continue  # no per-chunk reply
                         await loop.run_in_executor(
                             None, self.write_fn, *args
                         )
+                        KV_TRANSFER.inc("dynamo_kv_transfer_rx_chunks_total")
+                        KV_TRANSFER.inc(
+                            "dynamo_kv_transfer_rx_bytes_total", len(payload)
+                        )
                         writer.write(encode_frame2({"ok": True}, b""))
+                    elif op == "write_pages_eof":
+                        # close one pipelined stream: single ack carrying
+                        # any deferred mid-stream failure
+                        if stream_err is not None:
+                            writer.write(encode_frame2(
+                                {"ok": False, "error": stream_err,
+                                 "chunks": stream_chunks}, b"",
+                            ))
+                        else:
+                            writer.write(encode_frame2(
+                                {"ok": True, "chunks": stream_chunks}, b"",
+                            ))
+                        stream_chunks, stream_err = 0, None
                     elif op == "read_pages":
                         if self.read_fn is None:
                             raise RuntimeError("reads not accepted")
@@ -203,9 +289,28 @@ class BlockTransferServer:
                         # against this worker's sealed pool and export the
                         # longest present prefix (reference
                         # block_manager.rs:69-82 remote CacheLevel)
+                        hs = [int(h) for h in header["hashes"]]
+                        if header.get("probe") and self.count_hashes_fn:
+                            # cheap probe round: committed-prefix length
+                            # only, no gather — losers of the peer race
+                            # no longer export bytes nobody will use
+                            found = await loop.run_in_executor(
+                                None, self.count_hashes_fn, hs
+                            )
+                            writer.write(encode_frame2(
+                                {"ok": True, "found": int(found)}, b""
+                            ))
+                            await writer.drain()
+                            continue
+                        cp = int(header.get("chunk_pages") or 0)
+                        if cp > 0 and self.read_hashes_stream_fn:
+                            await self._serve_hash_stream(
+                                writer, loop, hs, cp
+                            )
+                            await writer.drain()
+                            continue
                         if self.read_hashes_fn is None:
                             raise RuntimeError("hash reads not accepted")
-                        hs = [int(h) for h in header["hashes"]]
                         found, data = await loop.run_in_executor(
                             None, self.read_hashes_fn, hs
                         )
@@ -220,6 +325,12 @@ class BlockTransferServer:
                                  "shape": list(data.shape),
                                  "dtype": data.dtype.name},
                                 data,
+                            )
+                            KV_TRANSFER.inc(
+                                "dynamo_kv_transfer_tx_chunks_total")
+                            KV_TRANSFER.inc(
+                                "dynamo_kv_transfer_tx_bytes_total",
+                                data.nbytes,
                             )
                     else:
                         raise RuntimeError(f"unknown op {op!r}")
@@ -237,6 +348,56 @@ class BlockTransferServer:
             log.warning("malformed block-transfer frame; closing connection")
         finally:
             writer.close()
+
+    async def _serve_hash_stream(
+        self, writer: asyncio.StreamWriter, loop, hashes: list[int],
+        chunk_pages: int,
+    ) -> None:
+        """Serve one chunk-pipelined hash read: lead frame with the found
+        count, then one frame per chunk as the engine's export stream
+        yields it — the gather/D2H of chunk i+1 runs while chunk i is on
+        the wire, and the serving side never stages the whole run."""
+        found, chunks = await loop.run_in_executor(
+            None, self.read_hashes_stream_fn, hashes, chunk_pages
+        )
+        writer.write(encode_frame2(
+            {"ok": True, "found": int(found), "stream": True}, b""
+        ))
+        if not found:
+            return
+        await writer.drain()
+        sent_pages = 0
+        seq = 0
+        it = iter(chunks)
+        # sentinel instead of catching StopIteration: a StopIteration
+        # raised inside run_in_executor cannot be set on an asyncio
+        # Future (the await would hang forever), so exhaustion must be
+        # signalled in-band
+        _done = object()
+        while sent_pages < found:
+            try:
+                data = await loop.run_in_executor(None, next, it, _done)
+            except Exception as e:  # noqa: BLE001 — report in-band
+                log.exception("hash-stream export failed mid-stream")
+                KV_TRANSFER.inc("dynamo_kv_transfer_errors_total")
+                writer.write(encode_frame2(
+                    {"ok": False, "error": str(e)}, b""
+                ))
+                return
+            if data is _done:
+                break
+            sent_pages += int(data.shape[3])
+            _write_array_frame(
+                writer,
+                {"ok": True, "seq": seq, "shape": list(data.shape),
+                 "dtype": data.dtype.name, "eof": sent_pages >= found},
+                data,
+            )
+            await writer.drain()
+            KV_TRANSFER.inc("dynamo_kv_transfer_tx_chunks_total")
+            KV_TRANSFER.inc("dynamo_kv_transfer_tx_bytes_total", data.nbytes)
+            seq += 1
+        KV_TRANSFER.inc("dynamo_kv_transfer_streams_total")
 
 
 # ---------------------------------------------------------------------------
@@ -262,11 +423,114 @@ async def write_remote_pages(
             header["job"] = job_id
         _write_array_frame(writer, header, data)
         await writer.drain()
+        KV_TRANSFER.inc("dynamo_kv_transfer_tx_chunks_total")
+        KV_TRANSFER.inc("dynamo_kv_transfer_tx_bytes_total", data.nbytes)
         header, _ = await read_frame2(reader)
         if not header.get("ok"):
+            KV_TRANSFER.inc("dynamo_kv_transfer_errors_total")
             raise BlockTransferError(header.get("error", "write failed"))
     finally:
         writer.close()
+
+
+class PageStreamWriter:
+    """One chunk-pipelined page push into a peer's pool.
+
+    The sender writes `write_pages` frames tagged ``stream``/``seq`` as
+    chunks become available (for disagg remote prefill: as the prefill
+    forward commits each run of complete prefix blocks), with no
+    per-chunk ack — chunk i rides the wire while chunk i+1 is still
+    being computed/gathered. ``commit()`` sends the eof frame and waits
+    for the single ack, which carries any deferred mid-stream scatter
+    failure. Use ``abort()``/``close()`` on error paths so a dead stream
+    never half-writes silently."""
+
+    def __init__(self, host: str, port: int,
+                 job_id: Optional[str] = None):
+        self.host = host
+        self.port = port
+        self.job_id = job_id
+        self.chunks_sent = 0
+        self.bytes_sent = 0
+        self._reader: Optional[asyncio.StreamReader] = None
+        self._writer: Optional[asyncio.StreamWriter] = None
+        self._t_open: Optional[float] = None
+
+    async def _ensure_conn(self) -> None:
+        if self._writer is None:
+            self._reader, self._writer = await asyncio.open_connection(
+                self.host, self.port
+            )
+            self._t_open = time.monotonic()
+
+    async def write_chunk(self, pages: list[int], data: np.ndarray) -> None:
+        """Ship one chunk (pages aligned with data's page axis)."""
+        await self._ensure_conn()
+        header = {
+            "op": "write_pages", "pages": [int(p) for p in pages],
+            "shape": list(data.shape), "dtype": data.dtype.name,
+            "stream": True, "seq": self.chunks_sent,
+        }
+        if self.job_id is not None:
+            header["job"] = self.job_id
+        t0 = time.monotonic()
+        _write_array_frame(self._writer, header, data)
+        await self._writer.drain()
+        self.chunks_sent += 1
+        self.bytes_sent += data.nbytes
+        KV_TRANSFER.inc("dynamo_kv_transfer_tx_chunks_total")
+        KV_TRANSFER.inc("dynamo_kv_transfer_tx_bytes_total", data.nbytes)
+        KV_TRANSFER.observe(
+            "dynamo_kv_transfer_chunk_seconds", time.monotonic() - t0
+        )
+
+    async def commit(self) -> int:
+        """Eof frame + single ack; returns the receiver's chunk count.
+        Raises BlockTransferError if any chunk's scatter failed."""
+        await self._ensure_conn()
+        self._writer.write(encode_frame2(
+            {"op": "write_pages_eof", "chunks": self.chunks_sent,
+             **({"job": self.job_id} if self.job_id else {})}, b"",
+        ))
+        await self._writer.drain()
+        header, _ = await read_frame2(self._reader)
+        if not header.get("ok"):
+            KV_TRANSFER.inc("dynamo_kv_transfer_errors_total")
+            raise BlockTransferError(
+                header.get("error", "chunk stream failed")
+            )
+        KV_TRANSFER.inc("dynamo_kv_transfer_streams_total")
+        if self._t_open is not None:
+            KV_TRANSFER.observe(
+                "dynamo_kv_transfer_seconds",
+                time.monotonic() - self._t_open,
+            )
+        return int(header.get("chunks", self.chunks_sent))
+
+    async def close(self) -> None:
+        if self._writer is not None:
+            self._writer.close()
+            self._writer = None
+            self._reader = None
+
+
+async def write_pages_stream(
+    host: str, port: int,
+    chunks: Iterable[tuple[list[int], np.ndarray]],
+    job_id: Optional[str] = None,
+) -> int:
+    """Push an iterable of (pages, data) chunks as one pipelined stream;
+    returns the number of chunks acked. Convenience over PageStreamWriter
+    for callers whose chunks are already materialized (tests, onboarding
+    batches); the disagg prefill worker drives the writer directly so it
+    can interleave sends with prefill progress."""
+    w = PageStreamWriter(host, port, job_id=job_id)
+    try:
+        for pages, data in chunks:
+            await w.write_chunk(pages, data)
+        return await w.commit()
+    finally:
+        await w.close()
 
 
 async def read_remote_pages(
@@ -282,6 +546,8 @@ async def read_remote_pages(
         header, payload = await read_frame2(reader)
         if not header.get("ok"):
             raise BlockTransferError(header.get("error", "read failed"))
+        KV_TRANSFER.inc("dynamo_kv_transfer_rx_chunks_total")
+        KV_TRANSFER.inc("dynamo_kv_transfer_rx_bytes_total", len(payload))
         return np.frombuffer(
             payload, dtype=np.dtype(header["dtype"])
         ).reshape(header["shape"]).copy()
@@ -289,17 +555,60 @@ async def read_remote_pages(
         writer.close()
 
 
-async def read_remote_hashes(
+async def probe_remote_hashes(
     host: str, port: int, hashes: list[int]
 ) -> tuple[int, Optional[np.ndarray]]:
-    """One-sided hash-addressed read: ask a peer for the longest prefix of
-    the chained-hash run its pool holds (G4 path). Returns (found, pages
-    [2, L, kvh, found, ps, hd]) — (0, None) on full miss."""
+    """Cheap G4 probe: how many leading blocks of the chained-hash run
+    the peer's pool holds — no page export. A peer without probe support
+    answers with the FULL read instead; those bytes already cost a
+    gather and a wire trip, so they are decoded and returned (second
+    tuple slot) rather than discarded — the caller uses them directly
+    instead of asking the peer to export everything again. Raises
+    BlockTransferError only when the peer errors outright."""
     reader, writer = await asyncio.open_connection(host, port)
     try:
         writer.write(encode_frame2(
-            {"op": "read_hashes", "hashes": [int(h) for h in hashes]}, b""
+            {"op": "read_hashes", "hashes": [int(h) for h in hashes],
+             "probe": True}, b""
         ))
+        await writer.drain()
+        header, payload = await read_frame2(reader)
+        if not header.get("ok"):
+            raise BlockTransferError(header.get("error", "probe failed"))
+        found = int(header.get("found", 0))
+        if payload and found:
+            return found, np.frombuffer(
+                payload, dtype=np.dtype(header["dtype"])
+            ).reshape(header["shape"]).copy()
+        return found, None
+    finally:
+        writer.close()
+
+
+async def read_remote_hashes(
+    host: str, port: int, hashes: list[int],
+    chunk_pages: int = 0,
+    on_chunk: Optional[Callable[[int, np.ndarray], None]] = None,
+) -> tuple[int, Optional[np.ndarray]]:
+    """One-sided hash-addressed read: ask a peer for the longest prefix of
+    the chained-hash run its pool holds (G4 path). Returns (found, pages
+    [2, L, kvh, found, ps, hd]) — (0, None) on full miss.
+
+    With ``chunk_pages`` > 0 the read is chunk-pipelined: the peer
+    streams the run as multi-frame chunks (its gather of chunk i+1
+    overlaps chunk i's wire time) and each chunk is delivered to
+    ``on_chunk(page_offset, array)`` as it arrives — the caller lands it
+    (e.g. host-tier put_batch) without ever staging the whole run; the
+    returned array is then None. Without ``on_chunk`` the chunks are
+    reassembled and returned whole. Peers that don't stream fall back to
+    the monolithic reply transparently."""
+    reader, writer = await asyncio.open_connection(host, port)
+    t0 = time.monotonic()
+    try:
+        req = {"op": "read_hashes", "hashes": [int(h) for h in hashes]}
+        if chunk_pages > 0:
+            req["chunk_pages"] = int(chunk_pages)
+        writer.write(encode_frame2(req, b""))
         await writer.drain()
         header, payload = await read_frame2(reader)
         if not header.get("ok"):
@@ -307,9 +616,46 @@ async def read_remote_hashes(
         found = int(header.get("found", 0))
         if not found:
             return 0, None
-        return found, np.frombuffer(
-            payload, dtype=np.dtype(header["dtype"])
-        ).reshape(header["shape"]).copy()
+        if not header.get("stream"):
+            # monolithic reply (legacy peer or chunking off)
+            KV_TRANSFER.inc("dynamo_kv_transfer_rx_chunks_total")
+            KV_TRANSFER.inc("dynamo_kv_transfer_rx_bytes_total",
+                            len(payload))
+            data = np.frombuffer(
+                payload, dtype=np.dtype(header["dtype"])
+            ).reshape(header["shape"]).copy()
+            if on_chunk is not None:
+                on_chunk(0, data)
+                return found, None
+            return found, data
+        parts: list[np.ndarray] = []
+        offset = 0
+        while offset < found:
+            h, payload = await read_frame2(reader)
+            if not h.get("ok"):
+                raise BlockTransferError(
+                    h.get("error", "chunk stream failed")
+                )
+            arr = np.frombuffer(
+                payload, dtype=np.dtype(h["dtype"])
+            ).reshape(h["shape"]).copy()
+            KV_TRANSFER.inc("dynamo_kv_transfer_rx_chunks_total")
+            KV_TRANSFER.inc("dynamo_kv_transfer_rx_bytes_total",
+                            len(payload))
+            if on_chunk is not None:
+                on_chunk(offset, arr)
+            else:
+                parts.append(arr)
+            offset += int(arr.shape[3])
+            if h.get("eof"):
+                break
+        KV_TRANSFER.observe(
+            "dynamo_kv_transfer_seconds", time.monotonic() - t0
+        )
+        found = min(found, offset)
+        if on_chunk is not None:
+            return found, None
+        return found, np.concatenate(parts, axis=3)
     finally:
         writer.close()
 
@@ -323,26 +669,26 @@ class RemoteKvFetcher:
     over the existing transfer plane. A prefix that misses G1/G2/G3
     locally is fetched from whichever peer holds it (scaled-up workers
     warm themselves from the fleet instead of recomputing), landing in
-    the G2 host tier so the normal onboard path takes over."""
+    the G2 host tier so the normal onboard path takes over.
+
+    With ``chunk_pages`` > 0 the fetch is chunk-pipelined: peers answer a
+    CHEAP probe (committed-prefix length, no page export — losers of the
+    race no longer gather and ship bytes that get discarded), then the
+    winner streams its run chunk by chunk and each chunk lands via
+    ``on_chunk`` while later chunks are still on the wire."""
 
     def __init__(self, kv: KvClient, namespace: str, self_worker_id: str,
-                 timeout_s: float = 3.0):
+                 timeout_s: float = 3.0, chunk_pages: int = 0):
         self.kv = kv
         self.namespace = namespace
         self.self_id = self_worker_id
         self.timeout_s = timeout_s
+        self.chunk_pages = chunk_pages
         self.fetches = 0
         self.hits = 0
+        self.chunked_fetches = 0
 
-    async def fetch(
-        self, hashes: list[int]
-    ) -> tuple[int, Optional[np.ndarray]]:
-        """Probe every peer CONCURRENTLY; the longest returned prefix
-        wins. (0, None) if no peer holds anything. timeout_s bounds the
-        WHOLE probe round, not each peer — this runs on the
-        request-submit path, so dead peers must cost one timeout total,
-        never one timeout each."""
-        self.fetches += 1
+    async def _peers(self) -> list[BlocksetDescriptor]:
         rows = await self.kv.get_prefix(
             f"dynamo://{self.namespace}/{KV_META_PREFIX}"
         )
@@ -354,8 +700,29 @@ class RemoteKvFetcher:
                 continue
             if desc.worker_id != self.self_id:
                 peers.append(desc)
+        return peers
+
+    async def fetch(
+        self, hashes: list[int],
+        on_chunk: Optional[Callable[[int, np.ndarray], None]] = None,
+    ) -> tuple[int, Optional[np.ndarray]]:
+        """Probe every peer CONCURRENTLY; the longest returned prefix
+        wins. (0, None) if no peer holds anything. timeout_s bounds the
+        WHOLE probe round, not each peer — this runs on the
+        request-submit path, so dead peers must cost one timeout total,
+        never one timeout each. With ``on_chunk`` the winning run is
+        delivered incrementally as (page_offset, array) and the returned
+        data is None."""
+        self.fetches += 1
+        peers = await self._peers()
         if not peers:
             return 0, None
+        if self.chunk_pages > 0 and on_chunk is not None:
+            got = await self._fetch_chunked(peers, hashes, on_chunk)
+            if got is not None:
+                if got:
+                    self.hits += 1
+                return got, None
 
         async def probe(desc):
             try:
@@ -376,7 +743,91 @@ class RemoteKvFetcher:
                 best = res
         if best[0]:
             self.hits += 1
+        if best[0] and on_chunk is not None:
+            # legacy monolithic reply: deliver through the same callback
+            on_chunk(0, best[1])
+            return best[0], None
         return best
+
+    async def _fetch_chunked(
+        self, peers: list[BlocksetDescriptor], hashes: list[int],
+        on_chunk: Callable[[int, np.ndarray], None],
+    ) -> Optional[int]:
+        """Probe round + streamed fetch from the winner. None = the
+        chunked path couldn't run (probe unsupported everywhere) — the
+        caller falls back to the legacy full-read race."""
+
+        async def probe(desc):
+            try:
+                found, data = await probe_remote_hashes(
+                    desc.host, desc.port, hashes
+                )
+                return found, data, desc
+            except (OSError, BlockTransferError):
+                return -1, None, desc
+
+        results = await asyncio.gather(
+            *[asyncio.wait_for(probe(d), timeout=self.timeout_s)
+              for d in peers],
+            return_exceptions=True,
+        )
+        holders: list[tuple[int, BlocksetDescriptor]] = []
+        best_full: tuple[int, Optional[np.ndarray]] = (0, None)
+        any_answered = False
+        for res in results:
+            if isinstance(res, BaseException):
+                continue
+            found, data, desc = res
+            if found >= 0:
+                any_answered = True
+            if found > 0:
+                holders.append((found, desc))
+                if data is not None and found > best_full[0]:
+                    # probe-less peer: it answered with the full export
+                    best_full = (found, data)
+        if not any_answered:
+            # every peer errored/timed out on the probe round; let the
+            # caller's legacy full-read race have the last word
+            return None
+        if not holders:
+            return 0
+        if best_full[0] >= max(fd[0] for fd in holders):
+            # the best run already arrived whole on the probe round (a
+            # peer without probe support exports eagerly) — landing it
+            # beats asking any peer to gather and ship it all again
+            on_chunk(0, best_full[1])
+            return best_full[0]
+        self.chunked_fetches += 1
+        # stream from the longest-prefix holder; a dead/stalled winner
+        # must not zero the fetch while a runner-up still holds the run
+        # (the legacy full-read race had that redundancy), so walk the
+        # holders best-first. Chunks a failed attempt already landed are
+        # hash-addressed cache entries — re-delivery is idempotent. ONE
+        # stream deadline bounds the whole walk — this runs on the
+        # request-submit path, and the pre-chunking contract was a
+        # single bounded wait before local-prefill fallback, not one
+        # deadline per peer. (The deadline is still far looser than the
+        # probe round's: the stream moves real bytes, and a slow host
+        # link is not a dead peer.)
+        holders.sort(key=lambda fd: fd[0], reverse=True)
+        deadline = time.monotonic() + max(self.timeout_s * 20, 60.0)
+        for _found, desc in holders:
+            budget = deadline - time.monotonic()
+            if budget <= 0:
+                break
+            try:
+                found, _ = await asyncio.wait_for(
+                    read_remote_hashes(
+                        desc.host, desc.port, hashes,
+                        chunk_pages=self.chunk_pages, on_chunk=on_chunk,
+                    ),
+                    timeout=budget,
+                )
+                return found
+            except (OSError, BlockTransferError, asyncio.TimeoutError):
+                log.exception("chunked G4 fetch from %s failed",
+                              desc.worker_id)
+        return 0  # every holder failed or the stream deadline passed
 
 
 class ArrayFrameServer:

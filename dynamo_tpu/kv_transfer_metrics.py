@@ -1,0 +1,50 @@
+"""KV-transfer data-plane metrics: one process-wide registry, three
+scrape surfaces.
+
+Every bulk KV move — chunk-streamed disagg prefill pushes, monolithic
+page writes/reads, G4 hash-addressed peer fetches — increments counters
+and observes histograms here; the frontend ``/metrics``, the per-worker
+system server and the aggregating exporter all append ``render()``'s
+Prometheus text to their output (the same pattern as
+resilience/metrics.py), so the series exist on every surface. Every
+family carries HELP/TYPE and is documented in README's Observability
+section — the metrics-contract test enforces both.
+
+tx_* families count the SENDING side of a move (frames written to a
+peer), rx_* the RECEIVING side (frames scattered into the local pool);
+a loopback test increments both in one process.
+"""
+from __future__ import annotations
+
+from dynamo_tpu.telemetry.metrics import CounterRegistry
+
+# (name, type, help) — the fixed counter/gauge family set.
+FAMILIES: tuple[tuple[str, str, str], ...] = (
+    ("dynamo_kv_transfer_tx_chunks_total", "counter",
+     "KV page chunks sent to a peer (streamed frames + monolithic writes)"),
+    ("dynamo_kv_transfer_rx_chunks_total", "counter",
+     "KV page chunks received and scattered into the local pool"),
+    ("dynamo_kv_transfer_tx_bytes_total", "counter",
+     "KV payload bytes sent to peers over the transfer plane"),
+    ("dynamo_kv_transfer_rx_bytes_total", "counter",
+     "KV payload bytes received over the transfer plane"),
+    ("dynamo_kv_transfer_streams_total", "counter",
+     "multi-frame chunk streams completed (eof acknowledged)"),
+    ("dynamo_kv_transfer_errors_total", "counter",
+     "transfer-plane operations that failed (send or scatter side)"),
+    ("dynamo_disagg_fallback_total", "counter",
+     "remote-prefill attempts that fell back to local prefill"),
+)
+
+# per-chunk wire/scatter wall + whole-move wall. Chunk times sit in the
+# sub-ms..s range; whole moves up to minutes on slow host links.
+_HISTOGRAMS: tuple[tuple[str, str], ...] = (
+    ("dynamo_kv_transfer_chunk_seconds",
+     "wall time of one chunk hop (export+send on tx, scatter on rx)"),
+    ("dynamo_kv_transfer_seconds",
+     "wall time of one whole bulk KV move (all chunks of a stream)"),
+)
+
+# process-wide registry: the transfer client/server, disagg wrapper and
+# G4 fetcher in one process share it (parity with resilience.RESILIENCE)
+KV_TRANSFER = CounterRegistry(FAMILIES, _HISTOGRAMS, label="kv-transfer")

@@ -76,6 +76,21 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--disk-offload-path", default=cfg.disk_offload_path,
                    help="backing file for the G3 pool "
                         "(default: fresh tempfile)")
+    # chunk-pipelined KV transfer plane (kv_transfer.py)
+    p.add_argument("--kv-transfer-chunk-pages", type=int,
+                   default=cfg.kv_transfer_chunk_pages,
+                   help="pages per streamed KV-transfer chunk (disagg "
+                        "remote prefill, G4 peer fetch, G2/G3 onboard); "
+                        "0 = monolithic single-blob transfers")
+    p.add_argument("--kv-transfer-inflight-chunks", type=int,
+                   default=cfg.kv_transfer_inflight_chunks,
+                   help="chunk gathers/D2H copies in flight per export "
+                        "stream (double-buffer depth)")
+    p.add_argument("--xfer-op-timeout", type=float,
+                   default=cfg.xfer_op_timeout_s,
+                   help="deadline in seconds for one queued page "
+                        "export/import op (raise for multi-GiB chunked "
+                        "imports on slow host links)")
     # speculative decoding (dynamo_tpu/spec/)
     p.add_argument("--speculative", default=cfg.speculative,
                    choices=["off", "ngram", "draft"],
@@ -451,6 +466,9 @@ def build_chain(args) -> "Any":
             num_speculative_tokens=args.num_speculative_tokens,
             spec_adaptive=args.spec_adaptive == "on",
             spec_min_k=args.spec_min_k,
+            kv_transfer_chunk_pages=args.kv_transfer_chunk_pages,
+            kv_transfer_inflight_chunks=args.kv_transfer_inflight_chunks,
+            xfer_op_timeout_s=args.xfer_op_timeout,
         )
         draft_cfg = None
         if args.speculative == "draft":
@@ -731,6 +749,7 @@ async def _serve_worker(args, chain) -> None:
         if getattr(inner, "offload", None) is not None:
             inner.remote_kv = RemoteKvFetcher(
                 rt.kv, args.namespace, getattr(engine, "worker_id", ""),
+                chunk_pages=args.kv_transfer_chunk_pages,
             )
 
     entry = ModelEntry(
@@ -818,6 +837,11 @@ async def _attach_data_plane(args, rt, engine, worker_id: str):
     srv = BlockTransferServer(
         read_fn=inner.export_pages, write_fn=write_fn,
         read_hashes_fn=getattr(inner, "export_pages_by_hash", None),
+        # chunk-pipelined G4 serving: cheap probes + streamed hash reads
+        count_hashes_fn=getattr(
+            getattr(inner, "allocator", None), "cached_prefix_len", None
+        ),
+        read_hashes_stream_fn=getattr(inner, "export_hash_stream", None),
     )
     host, port = await srv.start()
     cfg, ecfg = inner.config, inner.ecfg

@@ -158,12 +158,13 @@ class MetricsExporter:
                 )[2:])
         gauge("dynamo_metrics_workers",
               "workers in the last load-plane snapshot", len(snap.metrics))
-        # resilience plane (dynamo_tpu/resilience/): process-local
-        # migration/breaker/drain/chaos counters, same families on every
-        # scrape surface
+        # resilience + KV-transfer planes: process-local counters, same
+        # families on every scrape surface
+        from dynamo_tpu.kv_transfer_metrics import KV_TRANSFER
         from dynamo_tpu.resilience.metrics import RESILIENCE
 
-        return "\n".join(lines) + "\n" + RESILIENCE.render()
+        return ("\n".join(lines) + "\n" + RESILIENCE.render()
+                + KV_TRANSFER.render())
 
     async def handle_metrics(self, request: web.Request) -> web.Response:
         return web.Response(

@@ -149,6 +149,19 @@ class ChaosHooks:
         self._record(p)
         return True
 
+    async def maybe_stall(self, name: str, n_outputs: int) -> bool:
+        """Public injection hook for non-stream data paths (the disagg
+        chunk push): fire `name` once its after_outputs threshold is
+        reached and the probability roll passes, sleeping the point's
+        delay_s. Returns True when an injection fired."""
+        p = self.points.get(name)
+        if p is None or not p.armed or n_outputs < p.after_outputs:
+            return False
+        if not self._fire(p):
+            return False
+        await asyncio.sleep(p.delay_s)
+        return True
+
     async def wrap_stream(
         self, stream: AsyncIterator[Any]
     ) -> AsyncIterator[Any]:

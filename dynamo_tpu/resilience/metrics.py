@@ -10,7 +10,7 @@ enforces both.
 """
 from __future__ import annotations
 
-import threading
+from dynamo_tpu.telemetry.metrics import CounterRegistry
 
 # (name, type, help) — the fixed family set. Counters follow the
 # Prometheus naming contract (`*_total`); gauges are plain names.
@@ -37,52 +37,9 @@ FAMILIES: tuple[tuple[str, str, str], ...] = (
      "graceful drains completed by this process"),
 )
 
-_KNOWN = {name for name, _, _ in FAMILIES}
-
-
-class ResilienceMetrics:
-    """Thread-safe counter/gauge registry (engine thread increments,
-    asyncio handlers render)."""
-
-    def __init__(self) -> None:
-        self._values: dict[str, float] = {name: 0.0 for name in _KNOWN}
-        self._lock = threading.Lock()
-
-    def inc(self, name: str, n: float = 1.0) -> None:
-        assert name in _KNOWN, f"unknown resilience series {name!r}"
-        with self._lock:
-            self._values[name] += n
-
-    def set(self, name: str, v: float) -> None:
-        assert name in _KNOWN, f"unknown resilience series {name!r}"
-        with self._lock:
-            self._values[name] = float(v)
-
-    def get(self, name: str) -> float:
-        with self._lock:
-            return self._values[name]
-
-    def reset(self) -> None:
-        with self._lock:
-            for name in self._values:
-                self._values[name] = 0.0
-
-    def snapshot(self) -> dict[str, float]:
-        with self._lock:
-            return dict(self._values)
-
-    def render(self) -> str:
-        """Prometheus text for every family (trailing newline included)."""
-        snap = self.snapshot()
-        lines: list[str] = []
-        for name, typ, help_ in FAMILIES:
-            lines.append(f"# HELP {name} {help_}")
-            lines.append(f"# TYPE {name} {typ}")
-            v = snap[name]
-            lines.append(f"{name} {int(v) if v == int(v) else v}")
-        return "\n".join(lines) + "\n"
-
+# kept as a name for importers; the machinery lives in CounterRegistry
+ResilienceMetrics = CounterRegistry
 
 # process-wide registry: router, frontend, drain controller, chaos hooks
 # and retry policies in one process share it (parity with telemetry.TRACES)
-RESILIENCE = ResilienceMetrics()
+RESILIENCE = CounterRegistry(FAMILIES, label="resilience")

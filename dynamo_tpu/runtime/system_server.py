@@ -142,8 +142,11 @@ class SystemServer:
                         name, snap.get("help", name), snap,
                         label=f'worker="{w}"',
                     ))
-        # resilience plane: drain/chaos/migration counters of THIS process
-        return "\n".join(lines) + "\n" + RESILIENCE.render()
+        # resilience + KV-transfer planes: counters of THIS process
+        from dynamo_tpu.kv_transfer_metrics import KV_TRANSFER
+
+        return ("\n".join(lines) + "\n" + RESILIENCE.render()
+                + KV_TRANSFER.render())
 
     async def handle_metrics(self, request: web.Request) -> web.Response:
         return web.Response(text=self.render(), content_type="text/plain")

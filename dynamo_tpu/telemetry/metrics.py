@@ -100,6 +100,78 @@ class Histogram:
         return render_histogram(self.name, self.help, self.snapshot(), label)
 
 
+class CounterRegistry:
+    """Thread-safe fixed-family counter/gauge registry with optional
+    explicit-bucket histograms, rendered as one Prometheus text block.
+
+    Subsystem metric planes (resilience, kv-transfer) instantiate this
+    with their family set so the locking, the unknown-series assert and
+    the HELP/TYPE rendering live in one place instead of one copy per
+    plane. Families are ``(name, type, help)`` tuples; histograms are
+    ``(name, help)`` tuples using the default time buckets."""
+
+    def __init__(
+        self,
+        families: tuple[tuple[str, str, str], ...],
+        histograms: tuple[tuple[str, str], ...] = (),
+        label: str = "registry",
+    ):
+        self._families = tuple(families)
+        self._known = {name for name, _, _ in self._families}
+        self._label = label
+        self._values: dict[str, float] = {n: 0.0 for n in self._known}
+        self._lock = threading.Lock()
+        self._hists: dict[str, Histogram] = {
+            name: Histogram(name, help_) for name, help_ in histograms
+        }
+
+    def inc(self, name: str, n: float = 1.0) -> None:
+        assert name in self._known, \
+            f"unknown {self._label} series {name!r}"
+        with self._lock:
+            self._values[name] += n
+
+    def set(self, name: str, v: float) -> None:
+        assert name in self._known, \
+            f"unknown {self._label} series {name!r}"
+        with self._lock:
+            self._values[name] = float(v)
+
+    def get(self, name: str) -> float:
+        with self._lock:
+            return self._values[name]
+
+    def observe(self, name: str, value: float, n: int = 1) -> None:
+        self._hists[name].observe(value, n)
+
+    def histogram(self, name: str) -> Histogram:
+        return self._hists[name]
+
+    def reset(self) -> None:
+        with self._lock:
+            for name in self._values:
+                self._values[name] = 0.0
+        for h in self._hists.values():
+            h.reset()
+
+    def snapshot(self) -> dict[str, float]:
+        with self._lock:
+            return dict(self._values)
+
+    def render(self) -> str:
+        """Prometheus text for every family (trailing newline included)."""
+        snap = self.snapshot()
+        lines: list[str] = []
+        for name, typ, help_ in self._families:
+            lines.append(f"# HELP {name} {help_}")
+            lines.append(f"# TYPE {name} {typ}")
+            v = snap[name]
+            lines.append(f"{name} {int(v) if v == int(v) else v}")
+        for h in self._hists.values():
+            lines.extend(h.render())
+        return "\n".join(lines) + "\n"
+
+
 def percentile_from_snapshot(
     snap: dict[str, Any], q: float
 ) -> Optional[float]:

@@ -49,10 +49,10 @@ def setup():
     return cfg, ecfg, params
 
 
-def mk_engine(setup, wid):
+def mk_engine(setup, wid, **over):
     cfg, ecfg, params = setup
     return TpuEngine(
-        cfg, replace(ecfg, worker_id=wid), params=params,
+        cfg, replace(ecfg, worker_id=wid, **over), params=params,
         mesh_config=MeshConfig(tp=1),
     )
 
@@ -95,9 +95,13 @@ async def test_disagg_config_watch():
 
 
 async def setup_disagg_pair(setup, rt, namespace="dynamo",
-                            prefill_timeout_s=30.0):
-    """decode engine + data plane + descriptor + prefill worker."""
-    decode_inner = mk_engine(setup, "dec")
+                            prefill_timeout_s=30.0,
+                            prefill_chunk_pages=None,
+                            wid="dec", pwid="pre"):
+    """decode engine + data plane + descriptor + prefill worker.
+    ``prefill_chunk_pages`` overrides the prefill engine's
+    kv_transfer_chunk_pages (0 = monolithic legacy path)."""
+    decode_inner = mk_engine(setup, wid)
     cfg, ecfg, _ = setup
     conf = DisaggConfigWatcher(
         rt.kv, namespace,
@@ -106,7 +110,7 @@ async def setup_disagg_pair(setup, rt, namespace="dynamo",
     )
     await conf.start()
     decode = DisaggDecodeEngine(
-        decode_inner, rt, namespace=namespace, worker_id="dec",
+        decode_inner, rt, namespace=namespace, worker_id=wid,
         conf=conf, prefill_timeout_s=prefill_timeout_s,
     )
     srv = BlockTransferServer(
@@ -114,11 +118,14 @@ async def setup_disagg_pair(setup, rt, namespace="dynamo",
     )
     host, port = await srv.start()
     await publish_descriptor(rt.kv, namespace, BlocksetDescriptor(
-        worker_id="dec", host=host, port=port,
+        worker_id=wid, host=host, port=port,
         layout=KvCacheLayout(cfg.num_layers, cfg.num_kv_heads, PS,
                              cfg.head_dim, "float32"),
     ))
-    prefill_engine = mk_engine(setup, "pre")
+    over = ({}
+            if prefill_chunk_pages is None
+            else {"kv_transfer_chunk_pages": prefill_chunk_pages})
+    prefill_engine = mk_engine(setup, pwid, **over)
     pworker = await PrefillWorker(
         rt, prefill_engine, namespace=namespace, poll_timeout_s=0.2
     ).start()
@@ -355,3 +362,171 @@ async def test_disagg_through_distributed_stack(setup):
     await rt2.close()
     await rt.close()
     server.close()
+
+
+async def test_disagg_chunked_stream_greedy_differential(setup):
+    """Tier-1 keystone for the chunk pipeline: chunk-streamed remote
+    prefill is greedy byte-identical to the monolithic transfer (same
+    113-token prompt through both data planes — the transport change
+    must be invisible) AND to pure-local prefill (49-token prompt, the
+    shape the e2e tests pin local equality at; longer prompts flip
+    near-tie argmaxes on the tiny random model because a prefix-hit
+    tail prefill computes its boundary KV in a different padded shape —
+    a pre-existing float quirk, not a transfer property). Also: the
+    stream really was multi-frame, and the remote_prefill span carries
+    per-chunk children."""
+    prompt = list(range(1, 114))       # 7 complete blocks + tail
+    p49 = list(range(200, 249))        # 3 complete blocks + tail
+
+    ref_eng = mk_engine(setup, "refc")
+    ref49 = await collect(ref_eng, req_for(p49))
+    await ref_eng.stop()
+
+    server, store, rt, port = await start_rt()
+    from dynamo_tpu.kv_transfer_metrics import KV_TRANSFER
+
+    streams0 = KV_TRANSFER.get("dynamo_kv_transfer_streams_total")
+
+    # chunk-streamed pair (2 pages per chunk -> >= 3 frames)
+    decode_c, srv_c, conf_c, pw_c, pre_c = await setup_disagg_pair(
+        setup, rt, namespace="chunked", prefill_chunk_pages=2,
+        wid="dec_c", pwid="pre_c",
+    )
+    # monolithic pair (legacy single-blob path)
+    decode_m, srv_m, conf_m, pw_m, pre_m = await setup_disagg_pair(
+        setup, rt, namespace="mono", prefill_chunk_pages=0,
+        wid="dec_m", pwid="pre_m",
+    )
+    try:
+        finishing = None
+        out_c = []
+        async for out in decode_c.generate(req_for(prompt)):
+            out_c.extend(out.token_ids)
+            if out.finish_reason is not None:
+                finishing = out
+        chunks_113 = decode_c.last_transfer_chunks
+        out_m = await collect(decode_m, req_for(prompt))
+
+        # chunked vs monolithic: same bytes, same decode -> identical
+        assert out_c == out_m
+        # chunked remote vs pure-local prefill: identical
+        out_c49 = await collect(decode_c, req_for(p49))
+        assert out_c49 == ref49
+        assert decode_c.remote_prefills == 2
+        assert decode_c.remote_fallbacks == 0
+        assert decode_m.remote_prefills == 1
+        # the chunked path really streamed multiple frames...
+        assert pw_c.chunks_streamed >= 3
+        assert chunks_113 >= 3
+        assert pw_c.transfer_overlap_ratio is not None
+        assert KV_TRANSFER.get(
+            "dynamo_kv_transfer_streams_total") > streams0
+        # ...and the monolithic one did not
+        assert pw_m.chunks_streamed == 0
+        # per-chunk child spans under remote_prefill
+        spans = (finishing.annotations.get("trace") or {}).get("spans", [])
+        rp = next(s for s in spans if s.get("name") == "remote_prefill")
+        kids = rp.get("children", [])
+        assert len(kids) >= 3
+        assert all(k["name"] == "kv_chunk" for k in kids)
+        assert sum(k["attrs"]["blocks"] for k in kids) == rp["attrs"]["blocks"]
+    finally:
+        for pw, srv, conf, dec, pre in (
+            (pw_c, srv_c, conf_c, decode_c, pre_c),
+            (pw_m, srv_m, conf_m, decode_m, pre_m),
+        ):
+            await pw.stop()
+            await srv.stop()
+            await conf.stop()
+            await dec.stop()
+            await pre.stop()
+        await rt.close()
+        server.close()
+
+
+@pytest.mark.slow
+async def test_disagg_chunked_chaos_stall_falls_back(setup):
+    """Full-stack chunked remote prefill with a mid-stream stall_stream
+    chaos fault: the decode side's timeout fires, it falls back to LOCAL
+    prefill (token-identical output, fallback counted on the metrics
+    plane), and the stale stream's late writes are rejected by the
+    guarded import instead of scribbling on reallocated pages."""
+    from dynamo_tpu.frontend.watcher import ModelEntry, register_llm
+    from dynamo_tpu.kv_transfer_metrics import KV_TRANSFER
+    from dynamo_tpu.resilience.chaos import CHAOS
+    from dynamo_tpu.runtime.remote_engine import RemoteEngine
+
+    prompt = list(range(1, 114))
+    ref_eng = mk_engine(setup, "refs")
+    ref = await collect(ref_eng, req_for(prompt))
+    await ref_eng.stop()
+
+    server, store, rt, port = await start_rt()
+    cfg, ecfg, _ = setup
+    decode_inner = mk_engine(setup, "dec_st")
+    conf = await DisaggConfigWatcher(
+        rt.kv, "stall",
+        default=DisaggConfig(max_local_prefill_length=PS,
+                             max_prefill_queue_size=4),
+    ).start()
+    decode = DisaggDecodeEngine(
+        decode_inner, rt, namespace="stall", conf=conf,
+        prefill_timeout_s=1.0,
+    )
+    entry = ModelEntry(name="m", namespace="stall", component="backend",
+                       block_size=PS, router_mode="kv")
+    served = await register_llm(rt, decode, entry)
+    decode.worker_id = str(served.lease_id)
+    srv = BlockTransferServer(
+        read_fn=decode_inner.export_pages, write_fn=decode.guarded_import
+    )
+    host, xport = await srv.start()
+    await publish_descriptor(rt.kv, "stall", BlocksetDescriptor(
+        worker_id=str(served.lease_id), host=host, port=xport,
+        layout=KvCacheLayout(cfg.num_layers, cfg.num_kv_heads, PS,
+                             cfg.head_dim, "float32"),
+    ))
+    rt2 = await DistributedRuntime.connect(port=port)
+    pre_eng = mk_engine(setup, "pre_st", kv_transfer_chunk_pages=2)
+    pworker = await PrefillWorker(
+        rt2, pre_eng, namespace="stall", poll_timeout_s=0.2
+    ).start()
+    fb0 = KV_TRANSFER.get("dynamo_disagg_fallback_total")
+    # stall the stream for longer than the decode side's 1.0 s timeout,
+    # after the first chunk frame went out (mid-stream, not pre-stream)
+    CHAOS.arm("stall_stream", delay_s=4.0, after_outputs=1, once=True)
+    try:
+        client = await rt.namespace("stall").component("backend").endpoint(
+            "generate"
+        ).client()
+        await client.wait_for_instances(1)
+        remote = RemoteEngine(client)
+        out = await collect(remote, req_for(prompt))
+        assert out == ref  # local fallback is token-identical
+        assert decode.remote_fallbacks == 1
+        assert decode.remote_prefills == 0
+        assert KV_TRANSFER.get("dynamo_disagg_fallback_total") == fb0 + 1
+        assert CHAOS.points["stall_stream"].injected_total == 1
+        # the worker's stalled job must FAIL at commit (late writes for
+        # the cancelled job are rejected by the guarded import)
+        for _ in range(200):
+            if pworker.jobs_failed + pworker.jobs_handled >= 1:
+                break
+            await asyncio.sleep(0.05)
+        assert pworker.jobs_failed == 1
+        assert pworker.jobs_handled == 0
+        # decode keeps serving normally afterwards
+        out2 = await collect(remote, req_for(list(range(300, 320))))
+        assert len(out2) == 10
+        await client.stop()
+    finally:
+        CHAOS.reset()
+        await pworker.stop()
+        await srv.stop()
+        await conf.stop()
+        await served.shutdown()
+        await decode.stop()
+        await pre_eng.stop()
+        await rt2.close()
+        await rt.close()
+        server.close()

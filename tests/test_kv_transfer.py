@@ -182,3 +182,152 @@ async def test_engine_kv_handoff_decode_matches(setup):
     await srv.stop()
     await pre.stop()
     await dec.stop()
+
+
+# ---------------------------------------------------------------------------
+# chunk-pipelined streams (write_pages stream framing + eof ack)
+
+
+async def test_write_pages_stream_roundtrip():
+    """Multi-frame chunk stream scatters per chunk on arrival and acks
+    once at eof; bytes land exactly as one monolithic write would."""
+    from dynamo_tpu.kv_transfer import write_pages_stream
+    from dynamo_tpu.kv_transfer_metrics import KV_TRANSFER
+
+    pool = {"data": np.zeros((2, 2, 1, 16, PS, 4), np.float32)}
+    scattered = []
+
+    def write_fn(pages, data):
+        scattered.append(list(pages))
+        pool["data"][:, :, :, pages] = data
+
+    srv = BlockTransferServer(write_fn=write_fn)
+    host, port = await srv.start()
+    rng = np.random.default_rng(1)
+    payload = rng.standard_normal((2, 2, 1, 6, PS, 4)).astype(np.float32)
+    tx0 = KV_TRANSFER.get("dynamo_kv_transfer_tx_chunks_total")
+    rx0 = KV_TRANSFER.get("dynamo_kv_transfer_rx_chunks_total")
+    st0 = KV_TRANSFER.get("dynamo_kv_transfer_streams_total")
+    dst = [3, 4, 5, 9, 10, 11]
+    n = await write_pages_stream(host, port, [
+        (dst[0:2], payload[:, :, :, 0:2]),
+        (dst[2:4], payload[:, :, :, 2:4]),
+        (dst[4:6], payload[:, :, :, 4:6]),
+    ])
+    assert n == 3
+    assert scattered == [[3, 4], [5, 9], [10, 11]]
+    np.testing.assert_array_equal(pool["data"][:, :, :, dst], payload)
+    assert np.all(pool["data"][:, :, :, 0] == 0)
+    assert KV_TRANSFER.get("dynamo_kv_transfer_tx_chunks_total") == tx0 + 3
+    assert KV_TRANSFER.get("dynamo_kv_transfer_rx_chunks_total") == rx0 + 3
+    assert KV_TRANSFER.get("dynamo_kv_transfer_streams_total") == st0 + 1
+    await srv.stop()
+
+
+async def test_write_pages_stream_error_deferred_to_eof():
+    """A mid-stream scatter failure (e.g. guarded write for a cancelled
+    job) is remembered, later chunks are skipped, and the SINGLE eof ack
+    carries the error — the sender pipelines without per-chunk acks."""
+    from dynamo_tpu.kv_transfer import (
+        BlockTransferError,
+        write_pages_stream,
+    )
+
+    calls = []
+
+    def write_fn(pages, data, job_id=None):
+        calls.append(list(pages))
+        if 7 in pages:
+            raise RuntimeError("job cancelled; write rejected")
+
+    srv = BlockTransferServer(write_fn=write_fn)
+    host, port = await srv.start()
+    data = np.zeros((2, 2, 1, 2, PS, 4), np.float32)
+    with pytest.raises(BlockTransferError, match="cancelled"):
+        await write_pages_stream(host, port, [
+            ([1, 2], data), ([7, 8], data), ([3, 4], data),
+        ], job_id="j1")
+    # chunk 3 was never scattered: the stream was already poisoned
+    assert calls == [[1, 2], [7, 8]]
+    await srv.stop()
+
+
+async def test_probe_and_chunked_hash_read():
+    """The G4 probe answers found WITHOUT exporting bytes; the chunked
+    hash read streams the run frame by frame (on_chunk sees offsets)."""
+    from dynamo_tpu.kv_transfer import (
+        probe_remote_hashes,
+        read_remote_hashes,
+    )
+
+    rng = np.random.default_rng(2)
+    run = rng.standard_normal((2, 2, 1, 5, PS, 4)).astype(np.float32)
+
+    def count_fn(hashes):
+        return min(5, len(hashes))
+
+    def stream_fn(hashes, chunk_pages):
+        found = min(5, len(hashes))
+
+        def gen():
+            for i in range(0, found, chunk_pages):
+                yield run[:, :, :, i:i + chunk_pages]
+
+        return found, gen()
+
+    srv = BlockTransferServer(
+        count_hashes_fn=count_fn, read_hashes_stream_fn=stream_fn,
+    )
+    host, port = await srv.start()
+    assert await probe_remote_hashes(host, port, [11, 12, 13]) == (3, None)
+    assert await probe_remote_hashes(host, port, list(range(9))) == (5, None)
+
+    # assembled whole
+    found, data = await read_remote_hashes(
+        host, port, list(range(8)), chunk_pages=2
+    )
+    assert found == 5
+    np.testing.assert_array_equal(data, run)
+
+    # incremental landing: each chunk delivered with its page offset
+    seen = []
+    found, data = await read_remote_hashes(
+        host, port, list(range(8)), chunk_pages=2,
+        on_chunk=lambda off, arr: seen.append((off, arr.shape[3])),
+    )
+    assert found == 5 and data is None
+    assert seen == [(0, 2), (2, 2), (4, 1)]
+    await srv.stop()
+
+
+async def test_engine_export_stream_matches_monolithic(setup):
+    """export_pages_stream / export_hash_stream reproduce exactly what
+    the monolithic export paths produce — the chunk pipeline must be a
+    pure transport change."""
+    eng = mk_engine(setup, "wstream")
+    prompt = list(range(1, 80))  # 4 complete blocks
+    await collect(eng, PreprocessedRequest(
+        token_ids=prompt,
+        stop_conditions=StopConditions(max_tokens=2, ignore_eos=True),
+    ))
+    seq = TokenBlockSequence.from_tokens(prompt, PS, salt="")
+    hashes = seq.block_hashes()
+    pages = eng.allocator.match_prefix(hashes)
+    assert len(pages) >= 4
+    try:
+        whole = eng.export_pages(pages)
+        parts = list(eng.export_pages_stream(pages, chunk_pages=3))
+        assert len(parts) == (len(pages) + 2) // 3
+        np.testing.assert_array_equal(
+            np.concatenate(parts, axis=3), whole
+        )
+    finally:
+        eng.allocator.free(pages)
+    found, it = eng.export_hash_stream(hashes, chunk_pages=2)
+    got = list(it)
+    assert found == len(pages)
+    np.testing.assert_array_equal(np.concatenate(got, axis=3), whole)
+    # a fully-missing run streams nothing
+    found, it = eng.export_hash_stream([123456789], chunk_pages=2)
+    assert found == 0 and list(it) == []
+    await eng.stop()

@@ -199,3 +199,39 @@ def test_sharded_prefill_matches_unsharded(pair):
     with mesh:
         _, got = llama.prefill(cfg, params_sh, ctx_sh, *args)
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=1e-4, atol=1e-4)
+
+
+def test_load_ctx_pages_pow2_clamp_at_bench_r05_shape():
+    """Regression pin for the BENCH_r05 tail crash: a 46-page matched run
+    pow2-padded to 64 pages (update span 64*64 = 4096 tokens) loaded into
+    a ctx region of S = 3328 (52 pages) must clamp statically to the
+    region — the unclamped dynamic_update_slice was a trace-time
+    TypeError ("update shape must be smaller than operand shape ...
+    (…, 4096, …) for operand (…, 3328, …)") that killed the whole engine
+    round. Geometry is EXACTLY the r05 shape; L/kvh/hd are shrunk (the
+    crash class lives on the page/region axes alone)."""
+    L, kvh, hd = 1, 1, 4
+    ps, S = 64, 3328          # 52-page region (r05 ctx region)
+    n_real, pad_w = 46, 64    # 46 matched pages -> pow2_cover 64
+    rng = np.random.RandomState(0)
+    cache = {
+        name: jnp.asarray(rng.standard_normal(
+            (L, kvh, pad_w + 1, ps, hd)).astype(np.float32))
+        for name in ("k", "v")
+    }
+    want = {name: np.asarray(cache[name][:, :, 1:n_real + 1]).reshape(
+        L, kvh, n_real * ps, hd) for name in ("k", "v")}
+    ctx = {name: jnp.zeros((L, kvh, 2, S, hd), jnp.float32)
+           for name in ("k", "v")}
+    padded = np.zeros(pad_w, np.int32)  # padding -> scratch page 0
+    padded[:n_real] = np.arange(1, n_real + 1)
+    out = llama.load_ctx_pages(
+        ctx, cache, jnp.int32(0), jnp.asarray(padded)
+    )
+    for name in ("k", "v"):
+        assert out[name].shape == (L, kvh, 2, S, hd)
+        # every real matched page landed at its region position; only
+        # the padding overflow (pages 53..64) was dropped
+        np.testing.assert_array_equal(
+            np.asarray(out[name][:, :, 0, : n_real * ps]), want[name]
+        )

@@ -144,6 +144,16 @@ def test_readme_documents_canonical_series():
         "dynamo_request_e2e_seconds", "dynamo_request_queue_seconds",
         "dynamo_engine_round_seconds", "dynamo_spec_acceptance_rate",
         "dynamo_spec_effective_k", "dynamo_metrics_workers",
+        # KV-transfer data plane (chunk pipeline) + disagg fallback
+        "dynamo_kv_transfer_tx_chunks_total",
+        "dynamo_kv_transfer_rx_chunks_total",
+        "dynamo_kv_transfer_tx_bytes_total",
+        "dynamo_kv_transfer_rx_bytes_total",
+        "dynamo_kv_transfer_streams_total",
+        "dynamo_kv_transfer_errors_total",
+        "dynamo_kv_transfer_chunk_seconds",
+        "dynamo_kv_transfer_seconds",
+        "dynamo_disagg_fallback_total",
     ):
         assert name in readme, f"{name} missing from README"
     for endpoint in ("/debug/trace", "/debug/flight"):
