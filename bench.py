@@ -629,6 +629,8 @@ def main():
             failed_phases.append(k[: -len("_error")])
     stats.update(rm)
     model = os.environ.get("DYNAMO_BENCH_MODEL", "llama3_1b")
+    if os.environ.get("DYNAMO_BENCH_TINY") == "1":
+        model = "tiny_cpu"   # the metric name must not claim a 1B run
     metric = {
         "llama3_1b": "decode_throughput_llama3.2-1b_bf16_agg",
     }.get(model, f"decode_throughput_{model}_agg")
@@ -655,6 +657,15 @@ def main():
               "fault_requests", "fault_kills", "fault_migrations",
               "fault_tokens_lost", "fault_recovery_p50_ms",
               "fault_recovery_p95_ms",
+              # overload phase (bench_modes.overload_experiment):
+              # bounded admission A/B under a bursty storm — admitted
+              # TTFT p99 shed-on vs shed-off, counted sheds, honored
+              # Retry-After retries, token-identity of admitted streams
+              "overload_on_ttft_p99_ms", "overload_off_ttft_p99_ms",
+              "overload_sheds", "overload_retries_ok",
+              "overload_gave_up", "overload_admitted_on",
+              "overload_admitted_off", "overload_token_equal",
+              "overload_error",
               # disagg chunk-pipeline phase (bench_modes.
               # disagg_experiment): how much transfer the overlap hides
               "disagg_chunked_ttft_ms", "disagg_mono_ttft_ms",

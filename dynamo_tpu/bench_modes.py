@@ -14,6 +14,19 @@ latency p50/p95 (last-token-before-death to first-token-after, i.e. the
 re-route + replay-prefill cost the client observes), tokens lost (0 with
 migration's exactly-once replay), and migration counts.
 
+``overload`` — the overload-protection experiment (dynamo_tpu/
+overload/): a bursty arrival storm against a deliberately small fleet,
+A/B'ing bounded admission (shedding ON: overflow bounces with the
+retriable ``EngineOverloadedError``, clients honor ``Retry-After`` and
+retry) against the unbounded legacy behavior (shedding OFF: every
+request queues). Reports admitted-request TTFT p99 for both arms —
+bounded admission keeps it flat while the unbounded arm's grows with
+queue depth — the shed/bounce counts (counted, never silent), the
+number of Retry-After-honoring retries that later succeeded (the
+retriable-end-to-end story), and whether every admitted stream's
+tokens match an unloaded run of the same prompt (exactly-once: no
+duplicate or lost tokens through bounce/retry).
+
 ``disagg`` — the chunk-pipelined KV-transfer experiment (DistServe /
 Mooncake overlap claim): real tiny TpuEngines on CPU, remote prefill
 through the durable queue + block-transfer plane, with the data plane
@@ -218,6 +231,144 @@ async def fault_experiment(
         "fault_tokens_lost": expected - received,
         "fault_recovery_p50_ms": pct(0.50),
         "fault_recovery_p95_ms": pct(0.95),
+    }
+
+
+async def overload_experiment(
+    n_workers: int = 2,
+    n_requests: int = 36,
+    prompt_tokens: int = 96,
+    out_tokens: int = 16,
+    max_waiting: int = 3,
+    block_size: int = 16,
+    max_client_retries: int = 6,
+) -> dict:
+    """Bursty storm: admitted-TTFT p99 with bounded admission (shedding
+    ON, overflow bounces retriable + clients retry after Retry-After)
+    vs unbounded queueing (shedding OFF)."""
+    from dynamo_tpu.kv_router.router import KvPushRouter, KvRouter
+    from dynamo_tpu.kv_router.scheduler import KvRouterConfig
+    from dynamo_tpu.mocker import MockerArgs, MockerEngine
+    from dynamo_tpu.overload import EngineOverloadedError
+    from dynamo_tpu.protocols.common import (
+        PreprocessedRequest,
+        StopConditions,
+    )
+
+    rng = np.random.RandomState(13)
+    prompts = [rng.randint(1, 10_000, size=prompt_tokens).tolist()
+               for _ in range(n_requests)]
+
+    def req_for(prompt):
+        return PreprocessedRequest(
+            token_ids=list(prompt),
+            stop_conditions=StopConditions(max_tokens=out_tokens,
+                                           ignore_eos=True),
+        )
+
+    def make_args(wid: str, bounded: bool) -> "MockerArgs":
+        # slow-ish prefill + few slots: the storm actually queues
+        return MockerArgs(
+            num_pages=1024, page_size=block_size, max_decode_slots=2,
+            worker_id=wid,
+            prefill_time_per_token_s=0.0004,
+            decode_time_per_step_s=0.001,
+            max_waiting_requests=max_waiting if bounded else 0,
+        )
+
+    # unloaded reference: each prompt alone on a fresh engine — the
+    # token-identity oracle for every admitted stream
+    refs = []
+    ref_eng = MockerEngine(make_args("ref", bounded=False))
+    for p in prompts:
+        toks = []
+        async for out in ref_eng.generate(req_for(p)):
+            toks.extend(out.token_ids)
+        refs.append(toks)
+    await ref_eng.stop()
+
+    async def run(bounded: bool) -> dict:
+        router = KvRouter(block_size,
+                          KvRouterConfig(router_temperature=0.0))
+        push = KvPushRouter(router)
+        engines = []
+        for i in range(n_workers):
+            eng = MockerEngine(make_args(f"w{i}", bounded),
+                               on_kv_event=router.indexer.apply_event)
+            engines.append(eng)
+            push.add_worker(f"w{i}", eng)
+        ttfts: list[float] = []
+        outs: dict[int, list[int]] = {}
+        bounces = 0
+        retries_ok = 0
+        gave_up = 0
+
+        async def one(idx: int) -> None:
+            nonlocal bounces, retries_ok, gave_up
+            bounced = False
+            for _attempt in range(max_client_retries + 1):
+                t0 = time.monotonic()
+                toks: list[int] = []
+                first = None
+                try:
+                    async for out in push.generate(req_for(prompts[idx])):
+                        if first is None and out.token_ids:
+                            first = time.monotonic() - t0
+                        toks.extend(out.token_ids)
+                except EngineOverloadedError as e:
+                    # the whole fleet refused admission: honor the
+                    # load-derived Retry-After, then retry — the
+                    # retriable-end-to-end contract
+                    bounces += 1
+                    bounced = True
+                    await asyncio.sleep(min(e.retry_after_s, 2.0))
+                    continue
+                if first is not None:
+                    ttfts.append(first)
+                outs[idx] = toks
+                if bounced:
+                    retries_ok += 1
+                return
+            gave_up += 1
+
+        # three waves with small gaps: a storm, not a steady trickle
+        wave = max(1, n_requests // 3)
+        tasks = []
+        for w in range(0, n_requests, wave):
+            tasks += [asyncio.ensure_future(one(i))
+                      for i in range(w, min(w + wave, n_requests))]
+            await asyncio.sleep(0.03)
+        await asyncio.gather(*tasks)
+        sheds = sum(getattr(e, "sheds", 0) for e in engines)
+        for eng in engines:
+            await eng.stop()
+        ttfts.sort()
+        token_equal = all(outs[i] == refs[i] for i in outs)
+        return {
+            "ttft_p99_ms": (
+                round(ttfts[min(len(ttfts) - 1,
+                                int(0.99 * len(ttfts)))] * 1e3, 2)
+                if ttfts else None
+            ),
+            "admitted": len(outs),
+            "bounces": bounces,
+            "sheds": sheds,
+            "retries_ok": retries_ok,
+            "gave_up": gave_up,
+            "token_equal": token_equal,
+        }
+
+    on = await run(bounded=True)
+    off = await run(bounded=False)
+    return {
+        "overload_on_ttft_p99_ms": on["ttft_p99_ms"],
+        "overload_off_ttft_p99_ms": off["ttft_p99_ms"],
+        "overload_sheds": on["bounces"] + on["sheds"],
+        "overload_retries_ok": on["retries_ok"],
+        "overload_gave_up": on["gave_up"],
+        "overload_admitted_on": on["admitted"],
+        "overload_admitted_off": off["admitted"],
+        "overload_token_equal": on["token_equal"] and off["token_equal"],
     }
 
 
@@ -470,6 +621,10 @@ async def disagg_experiment(
 def main():
     out = asyncio.run(routing_experiment())
     out.update(asyncio.run(fault_experiment()))
+    try:
+        out.update(asyncio.run(overload_experiment()))
+    except Exception as e:  # noqa: BLE001 — best-effort phase
+        out["overload_error"] = str(e)[:200]
     try:
         out.update(asyncio.run(disagg_experiment()))
     except Exception as e:  # noqa: BLE001 — best-effort phase

@@ -58,6 +58,15 @@ class RuntimeConfig:
     kv_transfer_chunk_pages: int = 8
     kv_transfer_inflight_chunks: int = 2
     xfer_op_timeout_s: float = 120.0
+    # idle-timeout reclaiming a chunked export stream whose receiver
+    # stalled (pinned gather handles/page refs freed after this long
+    # without progress)
+    kv_transfer_stream_idle_timeout_s: float = 15.0
+    # overload plane (dynamo_tpu/overload/): bounded admission budgets
+    # (0 = unbounded) + the running-preemption flag
+    max_waiting_requests: int = 0
+    max_waiting_prefill_tokens: int = 0
+    preempt_running: bool = False
 
     @property
     def store_host_port(self) -> tuple[str, int]:

@@ -437,7 +437,23 @@ class PrefillWorker:
                 await asyncio.sleep(self.stream_poll_s)
             if sent <= first:
                 raise RuntimeError("prefilled blocks evicted before export")
+            # wire-time accounting fix: write_chunk's drain() returns
+            # when the KERNEL buffers the bytes, not when the peer has
+            # them — the tail of the stream (several chunks of socket
+            # buffer on a slow link) used to drain after prefill ended
+            # without being counted at all, flattering the overlap
+            # ratio. The eof ack arrives only after the receiver has
+            # read AND scattered every chunk, so the commit wait IS the
+            # unmeasured wire tail; count it (hidden only for whatever
+            # part ran before prefill finished — normally none).
+            t_commit = time.monotonic()
             await writer.commit()
+            tail = time.monotonic() - t_commit
+            xfer_total += tail
+            if t_pf_end is None:
+                xfer_hidden += tail
+            else:
+                xfer_hidden += min(tail, max(0.0, t_pf_end - t_commit))
         finally:
             if pending is not None:
                 pending[3].cancel()

@@ -100,6 +100,24 @@ class EngineConfig:
     # dispatch-overhead measurement in bench/profile_round)
     spec_batch_draft: bool = True
 
+    # overload plane (dynamo_tpu/overload/): bounded admission. Intake
+    # past either budget raises the retriable EngineOverloadedError
+    # (surfacing as HTTP 429 + Retry-After at the frontend) instead of
+    # growing the waiting queue — and every admitted request's TTFT —
+    # without limit. 0 = unbounded (the pre-overload-plane behavior).
+    max_waiting_requests: int = 0
+    # prompt-token budget over the same backlog: ten 10k-token prompts
+    # are a different storm than ten 10-token ones
+    max_waiting_prefill_tokens: int = 0
+    # priority preemption, running half: allow a waiting HIGH-priority
+    # request to force-evict the lowest-priority RUNNING stream when no
+    # lane is free — the victim's stream fails with the retriable
+    # PreemptedError, which the router turns into a live migration
+    # (replay prompt+emitted on a peer, exactly-once, greedy
+    # token-identical). Waiting-entry preemption is always on once
+    # budgets are set; this flag gates only the running case.
+    preempt_running: bool = False
+
     # prefix cache
     enable_prefix_caching: bool = True
 
@@ -138,6 +156,14 @@ class EngineConfig:
     # A multi-GiB chunked import on a slow host link can legitimately
     # exceed the old hard-coded 120 s.
     xfer_op_timeout_s: float = 120.0
+    # idle-timeout on a chunked export STREAM's backpressure: a receiver
+    # that stalls mid-pull (dead peer connection, wedged link) parks the
+    # stream with a full chunk queue; after this long without progress
+    # the engine reclaims its pinned gather handles/page refs and errors
+    # the consumer queue. Separate from xfer_op_timeout_s — a healthy
+    # multi-GiB import may take minutes, but a stream that moved NOTHING
+    # for 15 s is abandoned.
+    kv_transfer_stream_idle_timeout_s: float = 15.0
 
     # flight recorder (telemetry/flight.py): ring capacity of recent
     # engine-round events served at /debug/flight and dumped to the log

@@ -69,6 +69,13 @@ from dynamo_tpu.kv_router.protocols import (
 )
 from dynamo_tpu.models import llama
 from dynamo_tpu.models.config import ModelConfig
+from dynamo_tpu.overload import (
+    OVERLOAD,
+    PRIORITY_HIGH,
+    AdmissionController,
+    EngineOverloadedError,
+    PreemptedError,
+)
 from dynamo_tpu.parallel.mesh import MeshConfig, make_mesh
 from dynamo_tpu.protocols.common import (
     FinishReason,
@@ -127,6 +134,10 @@ class _Request:
     last_token: int = -1          # newest processed token, not yet in seq
     cancelled: bool = False
     finished: bool = False
+    # overload plane: this request's prompt tokens are counted in the
+    # engine's waiting-prefill-token backlog (set at intake, cleared
+    # exactly once when the request gets a lane or leaves the queue)
+    counted: bool = False
     enqueue_time: float = field(default_factory=time.monotonic)
     first_token_time: Optional[float] = None
     # telemetry: worker-side span dicts (queue/prefill/decode rounds —
@@ -414,6 +425,20 @@ class TpuEngine:
         self._host_ingest: queue_mod.Queue = queue_mod.Queue()
         self.remote_onboard_blocks = 0
         self._waiting: list[_Request] = []
+        # overload plane (dynamo_tpu/overload/): bounded admission over
+        # the not-yet-prefilling backlog. The token counter is updated
+        # from BOTH the asyncio intake side and the engine thread, so it
+        # takes the lock; reads for budget checks are advisory.
+        self.admission = AdmissionController(
+            e.max_waiting_requests,
+            e.max_waiting_prefill_tokens,
+            queue_wait_s=lambda: self._h_queue.percentile(0.5),
+        )
+        self._waiting_tokens = 0
+        self._wt_lock = threading.Lock()
+        self.sheds = 0                # deadline-expired waiting requests
+        self.waiting_preemptions = 0  # waiting entries evicted by priority
+        self.preempt_migrations = 0   # running streams force-migrated
         self._entries: list[_Entry] = []
         # sealed blocks awaiting the batched ctx->pool copy:
         # (slot, start_pos, pool_page)
@@ -639,6 +664,35 @@ class TpuEngine:
                 f"prompt length {len(request.token_ids)} exceeds max context "
                 f"{self.ecfg.max_context}"
             )
+        # overload plane: a deadline that expired before intake is shed
+        # immediately — zero tokens, the DEADLINE finish reason, never an
+        # error (the client's budget ran out, nothing failed)
+        if (request.deadline is not None
+                and time.time() > request.deadline):
+            self.sheds += 1
+            OVERLOAD.inc("dynamo_overload_shed_total")
+            yield LLMEngineOutput(
+                token_ids=[], finish_reason=FinishReason.DEADLINE,
+                annotations={"shed": {"reason": "deadline",
+                                      "queued_s": 0.0}},
+            )
+            return
+        # bounded admission: a full waiting queue refuses intake with the
+        # retriable overload error (router spills to a peer, frontend
+        # answers 429 + Retry-After). A HIGH-priority arrival is admitted
+        # anyway — the engine loop restores the budget by preempting the
+        # lowest-priority waiting entry (_enforce_bounds).
+        if self.admission.bounded:
+            waiting = (sum(1 for w in self._waiting if w.slot < 0)
+                       + self._intake.qsize())
+            with self._wt_lock:
+                tokens = self._waiting_tokens
+            try:
+                self.admission.check(waiting, tokens)
+            except EngineOverloadedError:
+                if request.priority < PRIORITY_HIGH:
+                    OVERLOAD.inc("dynamo_overload_rejected_total")
+                    raise
         # multimodal requests salt their block hashes with the image digest:
         # placeholder tokens are identical across different images, and a
         # prefix-cache hit keyed on tokens alone would serve the wrong
@@ -657,6 +711,9 @@ class TpuEngine:
         )
         if self.remote_kv is not None and self.offload is not None:
             await self._remote_prefetch(r)
+        r.counted = True
+        with self._wt_lock:
+            self._waiting_tokens += len(r.tokens)
         self._intake.put(r)
         try:
             while True:
@@ -833,9 +890,13 @@ class TpuEngine:
                 st.out_q.put(_STREAM_EOS)
                 progressed = True
             elif (not moved and now - st.last_progress
-                    > self.ecfg.xfer_op_timeout_s):
-                # consumer vanished mid-stream (dead peer connection):
-                # reclaim the pins instead of leaking them forever
+                    > self.ecfg.kv_transfer_stream_idle_timeout_s):
+                # consumer vanished mid-stream (dead peer connection /
+                # stalled receiver): reclaim the pinned gather handles
+                # and page refs instead of leaking them for the full
+                # xfer-op deadline — an export stream that moved nothing
+                # for the idle window is abandoned, however long a
+                # HEALTHY transfer is allowed to take
                 if st.free_pages is not None:
                     self.allocator.free(st.free_pages)
                     st.free_pages = None
@@ -1031,6 +1092,14 @@ class TpuEngine:
             for i, s in enumerate(self._slots) if s is not None
         )
         ctx_usage = live_tokens / float(self._B * self.ecfg.max_context)
+        e = self.ecfg
+        num_waiting = (sum(1 for r in self._waiting if r.slot < 0)
+                       + self._intake.qsize())
+        with self._wt_lock:
+            waiting_tokens = self._waiting_tokens
+        # process-level overload gauges (all three scrape surfaces)
+        OVERLOAD.set("dynamo_overload_queue_depth", num_waiting)
+        OVERLOAD.set("dynamo_overload_queue_tokens", waiting_tokens)
         return ForwardPassMetrics(
             worker_id=self.ecfg.worker_id,
             worker_stats=WorkerStats(
@@ -1041,10 +1110,12 @@ class TpuEngine:
                 request_total_slots=self._B,
                 # in-prefill requests count as active (they hold a lane),
                 # not waiting
-                num_requests_waiting=(
-                    sum(1 for r in self._waiting if r.slot < 0)
-                    + self._intake.qsize()
-                ),
+                num_requests_waiting=num_waiting,
+                # overload plane: backlog + budgets, so routers spill
+                # away from a saturating worker before its bound sheds
+                num_waiting_prefill_tokens=waiting_tokens,
+                max_waiting_requests=e.max_waiting_requests,
+                max_waiting_prefill_tokens=e.max_waiting_prefill_tokens,
                 spec_proposed_total=(
                     self.spec.proposed_total if self.spec else 0
                 ),
@@ -1151,6 +1222,7 @@ class TpuEngine:
         apply patches (releases, admissions), dispatch a round of steps."""
         e = self.ecfg
         self._drain_intake()
+        self._enforce_bounds()
         rounds_in_flight = sum(1 for en in self._entries if en.kind == "round")
         self._process_entries(block=rounds_in_flight > e.max_inflight_rounds)
         self._flush_seals()
@@ -1196,9 +1268,140 @@ class TpuEngine:
     def _drain_intake(self) -> None:
         while True:
             try:
-                self._waiting.append(self._intake.get_nowait())
+                self._enqueue_waiting(self._intake.get_nowait())
             except queue_mod.Empty:
                 return
+
+    def _enqueue_waiting(self, r: _Request) -> None:
+        """FIFO within a priority class; a high-priority arrival queues
+        ahead of every lower-priority entry that has NOT started prefill
+        (entries holding a lane are active work, never jumped)."""
+        if r.req.priority > 0:
+            for i, w in enumerate(self._waiting):
+                if w.prefill_pos < 0 and w.req.priority < r.req.priority:
+                    self._waiting.insert(i, r)
+                    return
+        self._waiting.append(r)
+
+    # ---- overload plane: budgets, deadline shedding, preemption ----
+
+    def _uncount_waiting(self, r: _Request) -> None:
+        """Drop a request's prompt from the waiting-token backlog
+        (idempotent — first lane acquisition or queue exit wins)."""
+        if not r.counted:
+            return
+        r.counted = False
+        with self._wt_lock:
+            self._waiting_tokens -= len(r.tokens)
+
+    def _shed_waiting(self, r: _Request, reason: str) -> None:
+        """Drop a still-WAITING request from the queue. ``deadline``
+        sheds finish cleanly (zero tokens, DEADLINE reason — the budget
+        ran out, nothing failed); preemption/bound sheds surface the
+        retriable overload error so the router re-routes them."""
+        self._uncount_waiting(r)
+        r.finished = True
+        if reason == "deadline":
+            self.sheds += 1
+            OVERLOAD.inc("dynamo_overload_shed_total")
+            r.emit(LLMEngineOutput(
+                token_ids=[], finish_reason=FinishReason.DEADLINE,
+                annotations={"shed": {
+                    "reason": "deadline",
+                    "queued_s": round(
+                        time.monotonic() - r.enqueue_time, 3),
+                }},
+            ))
+        else:
+            r.emit(EngineOverloadedError(
+                f"request shed while waiting ({reason})",
+                retry_after_s=self.admission.retry_after_s(
+                    sum(1 for w in self._waiting if w.slot < 0)
+                ),
+            ))
+
+    def _enforce_bounds(self) -> None:
+        """Restore the admission budgets after a HIGH-priority arrival
+        was force-admitted past them: evict the lowest-priority, newest
+        waiting entry until the backlog fits. When every candidate has
+        the same priority there is no one to preempt FOR — the newest
+        arrival bounces instead (the budget stays honest either way)."""
+        adm = self.admission
+        if not adm.bounded:
+            return
+        while True:
+            cands = [r for r in self._waiting
+                     if r.prefill_pos < 0 and not r.cancelled
+                     and not r.finished]
+            n = len(cands)
+            with self._wt_lock:
+                tokens = self._waiting_tokens
+            over = ((adm.max_waiting_requests
+                     and n > adm.max_waiting_requests)
+                    or (adm.max_waiting_prefill_tokens
+                        and tokens > adm.max_waiting_prefill_tokens))
+            if not over or not cands:
+                return
+            lo = min(r.req.priority for r in cands)
+            hi = max(r.req.priority for r in cands)
+            victim = max(
+                (r for r in cands if r.req.priority == lo),
+                key=lambda r: r.enqueue_time,
+            )
+            if lo < hi:
+                self.waiting_preemptions += 1
+                OVERLOAD.inc("dynamo_overload_preempted_total")
+                self._shed_waiting(victim, "preempted by priority")
+            else:
+                OVERLOAD.inc("dynamo_overload_rejected_total")
+                self._shed_waiting(victim, "queue budget exceeded")
+            self._waiting.remove(victim)
+
+    def _maybe_preempt_running(self) -> None:
+        """Running half of priority preemption (behind
+        ``preempt_running``): a HIGH-priority request blocked on a lane
+        force-migrates the lowest-priority RUNNING stream — its client
+        stream fails with the retriable PreemptedError, the router
+        replays it on a peer (exactly-once, greedy token-identical, the
+        PR-4 migration plane), and the freed lane admits the
+        high-priority request at the next round. At most one victim per
+        round; lanes mid-prefill are never preempted (their replay
+        would waste the whole prefill for no freed decode capacity
+        yet)."""
+        if not self.ecfg.preempt_running:
+            return
+        hp = next(
+            (r for r in self._waiting
+             if r.prefill_pos < 0 and not r.cancelled
+             and r.req.priority > 0),
+            None,
+        )
+        if hp is None or self._free_slot() is not None:
+            return
+        victims = [
+            s for s in self._slots
+            if s is not None and not s.finished and not s.cancelled
+            and s.req.priority < hp.req.priority
+        ]
+        if not victims:
+            return
+        lo = min(v.req.priority for v in victims)
+        victim = max(
+            (v for v in victims if v.req.priority == lo),
+            key=lambda v: v.enqueue_time,
+        )
+        self.preempt_migrations += 1
+        OVERLOAD.inc("dynamo_overload_preempt_migrations_total")
+        log.warning(
+            "preempting running request %s (priority %d) for "
+            "high-priority arrival %s",
+            victim.req.request_id, victim.req.priority,
+            hp.req.request_id,
+        )
+        victim.emit(PreemptedError(
+            "preempted by a higher-priority request; stream migrates"
+        ))
+        self._finish(victim, None)
 
     # ---- dispatch side ----
 
@@ -1811,13 +2014,22 @@ class TpuEngine:
     # ---- admission / prefill ----
 
     def _admit(self) -> None:
+        now = time.time()
         kept = []
         for r in self._waiting:
             if r.cancelled:
                 self._abort_prefill(r)
+            elif (r.prefill_pos < 0 and r.req.deadline is not None
+                    and now > r.req.deadline):
+                # deadline-aware shedding: a still-WAITING request whose
+                # deadline passed would only prefill dead work. Never a
+                # request that already started (mid-prefill/mid-stream
+                # work is delivered, not discarded).
+                self._shed_waiting(r, "deadline")
             else:
                 kept.append(r)
         self._waiting = kept
+        self._maybe_preempt_running()
         # bounded prefill budget per round: a long prompt advances one
         # chunk at a time with decode rounds in between (ITL isolation,
         # the local form of what disagg provides globally). Concurrent
@@ -1968,6 +2180,7 @@ class TpuEngine:
 
     def _abort_prefill(self, r: _Request) -> None:
         """Release a half-prefilled request's lane reservation."""
+        self._uncount_waiting(r)
         if r.slot >= 0 and self._prefilling.get(r.slot) is r:
             del self._prefilling[r.slot]
         r.slot = -1
@@ -1975,7 +2188,10 @@ class TpuEngine:
 
     def _note_queue_wait(self, r: _Request) -> None:
         """Account the admission queue wait once, when the request first
-        gets a lane (multi-chunk continuations keep the original mark)."""
+        gets a lane (multi-chunk continuations keep the original mark).
+        The request also leaves the waiting-token backlog here — it is
+        active prefill work now, not queued work."""
+        self._uncount_waiting(r)
         if r.t_prefill_start is not None:
             return
         now = time.monotonic()
@@ -2470,7 +2686,7 @@ class TpuEngine:
                 self.spec.release(i)
         for r in self._waiting:
             r.emit(err)
-            self._abort_prefill(r)
+            self._abort_prefill(r)  # also drops its waiting-token count
         self._waiting = []
         self._prefilling = {}
         self._entries = []

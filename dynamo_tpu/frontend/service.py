@@ -19,6 +19,7 @@ import asyncio
 import json
 import logging
 import time
+from collections import deque
 from typing import Any, AsyncIterator, Optional
 
 from aiohttp import web
@@ -33,6 +34,11 @@ from prometheus_client import (
 from pydantic import ValidationError
 
 from dynamo_tpu.frontend.model_manager import ModelManager, ModelNotFound
+from dynamo_tpu.overload import (
+    OVERLOAD,
+    EngineOverloadedError,
+    apply_request_hints,
+)
 from dynamo_tpu.protocols.common import FinishReason, LLMEngineOutput
 from dynamo_tpu.protocols.openai import (
     ChatCompletionRequest,
@@ -84,10 +90,24 @@ class ServiceMetrics:
         return generate_latest(self.registry)
 
 
-def _error(status: int, message: str, err_type: str = "invalid_request_error") -> web.Response:
+def _error(status: int, message: str,
+           err_type: str = "invalid_request_error",
+           headers: Optional[dict] = None) -> web.Response:
     return web.json_response(
         {"error": {"message": message, "type": err_type, "code": status}},
         status=status,
+        headers=headers,
+    )
+
+
+def _overloaded_response(e: EngineOverloadedError) -> web.Response:
+    """HTTP 429 with the load-derived Retry-After (whole seconds,
+    rounded up — RFC 7231 delta-seconds)."""
+    OVERLOAD.inc("dynamo_overload_http_429_total")
+    retry_after = max(1, int(-(-e.retry_after_s // 1)))
+    return _error(
+        429, str(e) or "engine overloaded", "overloaded_error",
+        headers={"Retry-After": str(retry_after)},
     )
 
 
@@ -258,7 +278,8 @@ class HttpService:
 
         body = (self.metrics.render() + self.telemetry.render().encode()
                 + RESILIENCE.render().encode()
-                + KV_TRANSFER.render().encode())
+                + KV_TRANSFER.render().encode()
+                + OVERLOAD.render().encode())
         return web.Response(
             body=body, content_type=CONTENT_TYPE_LATEST.split(";")[0]
         )
@@ -457,6 +478,12 @@ class HttpService:
             except _ApiError as e:
                 status = str(e.status)
                 return _error(e.status, e.message, e.etype)
+            except EngineOverloadedError as e:
+                # overload plane: every worker (or the local engine)
+                # refused admission — retriable by construction, so the
+                # client gets 429 + Retry-After, never a 500
+                status = "429"
+                return _overloaded_response(e)
             status = str(resp.status)
             return resp
         except asyncio.CancelledError:
@@ -590,6 +617,11 @@ class HttpService:
                 "tokenize", t_tok,
                 model=req.model, prompt_tokens=len(pre.token_ids),
             ))
+            # overload plane: header hints land on top of the nvext
+            # fields the preprocessor already applied (headers win;
+            # nvext is NOT re-applied — re-minting its deadline here
+            # would silently extend it by the tokenize latency)
+            apply_request_hints(pre, request.headers, None)
 
             self.metrics.inflight.labels(req.model).inc()
             try:
@@ -729,7 +761,6 @@ class HttpService:
                 "X-Request-Id": pre.request_id,
             },
         )
-        await resp.prepare(request)
         gen = DeltaGenerator(req.model, chat=chat, n=max(1, req.n))
         streams = self._fanout(req, chain, pre)
         completion_tokens = 0
@@ -766,9 +797,51 @@ class HttpService:
 
         tasks = [asyncio.create_task(pump(i)) for i in range(len(streams))]
         live = len(streams)
+
+        async def close_all() -> None:
+            for t in tasks:
+                t.cancel()
+            for s in streams:
+                close = getattr(s, "aclose", None)
+                if close is not None:
+                    try:
+                        await close()
+                    except Exception:  # noqa: BLE001
+                        pass
+
+        # overload plane: probe for ADMISSION before preparing the SSE
+        # stream. If every choice bounces with EngineOverloadedError
+        # before producing anything, the client gets a clean 429 +
+        # Retry-After (a prepared 200 stream carrying an error event is
+        # unretriable by standard clients). The first real item — or a
+        # non-overload error, which keeps its in-band reporting — ends
+        # the probe; stashed overload errors then surface in-band too.
+        pending_head: deque = deque()
+        overload_errs: list = []
         try:
-            while live:
+            while live and not pending_head:
                 i, item = await queue.get()
+                if item is DONE:
+                    live -= 1
+                    continue
+                if isinstance(item, EngineOverloadedError):
+                    overload_errs.append((i, item))
+                    continue
+                pending_head.append((i, item))
+        except asyncio.CancelledError:
+            await close_all()
+            raise
+        if not pending_head and not live and overload_errs:
+            await close_all()
+            raise overload_errs[0][1]  # -> _run_endpoint maps to 429
+        pending_head.extend(overload_errs)
+        await resp.prepare(request)
+        try:
+            while live or pending_head:
+                if pending_head:
+                    i, item = pending_head.popleft()
+                else:
+                    i, item = await queue.get()
                 if item is DONE:
                     live -= 1
                     continue

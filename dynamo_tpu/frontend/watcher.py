@@ -183,12 +183,20 @@ class ModelWatcher:
         # when ``heartbeat_ttl_s`` is set (each ForwardPassMetrics
         # publication refreshes the worker's soft lease — TpuEngine
         # publishes on idle ticks too, so silence really means wedged).
-        # The metrics subscription only runs when something consumes it:
-        # a TTL here, or a caller-provided tracker.
-        self._follow_heartbeats = health is not None or heartbeat_ttl_s
         self.health = health or WorkerHealthTracker(
             heartbeat_ttl_s=heartbeat_ttl_s
         )
+        # overload plane: one live queue-depth/budget view shared by
+        # every model's router (fed by the same metrics subscription as
+        # heartbeats) — routing spills away from saturating workers
+        from dynamo_tpu.overload import WorkerLoadView
+
+        self.load = WorkerLoadView()
+        # shared breaker state (resilience/shared.py): trips observed by
+        # THIS frontend publish on the store's pub/sub plane so sibling
+        # frontends stop routing to the dead worker without each paying
+        # the consecutive-failure discovery cost themselves
+        self._breaker_board = None
         self._task: Optional[asyncio.Task] = None
         self._models: dict[str, dict[int, ModelEntry]] = {}  # name -> lease -> entry
         self._chains: dict[str, Any] = {}
@@ -215,13 +223,23 @@ class ModelWatcher:
         self._kv_sub_task = asyncio.get_running_loop().create_task(
             self._follow_kv_events()
         )
-        if self._follow_heartbeats:
-            self._metrics_sub_task = asyncio.get_running_loop().create_task(
-                self._follow_metrics()
-            )
+        # the metrics tap now always runs: the overload plane's load
+        # view consumes every publication (heartbeats additionally
+        # refresh soft leases when a TTL is configured)
+        self._metrics_sub_task = asyncio.get_running_loop().create_task(
+            self._follow_metrics()
+        )
+        from dynamo_tpu.resilience.shared import SharedBreakerBoard
+
+        self._breaker_board = await SharedBreakerBoard(
+            self.rt.kv, self.health, namespace=self.namespace
+        ).start()
         return self
 
     async def stop(self) -> None:
+        if self._breaker_board is not None:
+            await self._breaker_board.stop()
+            self._breaker_board = None
         for t in (self._task, self._kv_sub_task, self._metrics_sub_task):
             if t is not None:
                 t.cancel()
@@ -269,6 +287,7 @@ class ModelWatcher:
             except (KeyError, ValueError, TypeError):
                 continue
             self.health.observe_metrics(m)
+            self.load.observe(m)
 
     def _route_kv_event(self, event: KvCacheEvent, *,
                         buffer_unclaimed: bool = True) -> bool:
@@ -337,7 +356,8 @@ class ModelWatcher:
 
         if entry.router_mode == "kv":
             router = KvRouter(entry.block_size, self.router_config)
-            push = KvPushRouter(router, health=self.health)
+            push = KvPushRouter(router, health=self.health,
+                                load=self.load)
             self._routers[name] = push
 
             def sync_workers(instances: list[Instance], push=push,

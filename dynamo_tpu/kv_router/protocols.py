@@ -73,6 +73,13 @@ class WorkerStats:
     request_active_slots: int = 0
     request_total_slots: int = 0
     num_requests_waiting: int = 0
+    # overload plane (dynamo_tpu/overload/): waiting prefill-token
+    # backlog + the engine's admission budgets (0 = unbounded) — what
+    # lets the router spill AWAY from a saturating worker before its
+    # queue bound sheds, instead of discovering it one bounce at a time
+    num_waiting_prefill_tokens: int = 0
+    max_waiting_requests: int = 0
+    max_waiting_prefill_tokens: int = 0
     # speculative decoding acceptance (dynamo_tpu/spec/): cumulative
     # proposed/accepted drafts and the rolling acceptance rate — the
     # signal a planner needs to gate speculation per workload. All zero

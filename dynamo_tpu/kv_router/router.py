@@ -31,6 +31,12 @@ from dynamo_tpu.kv_router.scheduler import (
     SchedulingRequest,
 )
 from dynamo_tpu.kv_router.sequence import ActiveSequencesMultiWorker
+from dynamo_tpu.overload import (
+    OVERLOAD,
+    EngineOverloadedError,
+    PreemptedError,
+    WorkerLoadView,
+)
 from dynamo_tpu.protocols.common import (
     FinishReason,
     LLMEngineOutput,
@@ -118,10 +124,15 @@ class KvPushRouter:
         health: Optional[WorkerHealthTracker] = None,
         migration: Optional[MigrationPolicy] = None,
         retry: Optional[RetryPolicy] = None,
+        load: Optional[WorkerLoadView] = None,
     ):
         self.router = router
         self.workers: dict[WorkerId, Any] = workers or {}
         self.health = health or WorkerHealthTracker()
+        # overload plane: live queue-depth/budget view fed by the
+        # metrics plane + wire-observed overload bounces — routing
+        # steers AWAY from saturating workers (spill-before-shed)
+        self.load = load or WorkerLoadView()
         self.migration = migration or MigrationPolicy()
         # backoff between failover attempts (small base: failover latency
         # is client-visible TTFT)
@@ -141,6 +152,7 @@ class KvPushRouter:
         self.router.update_workers(list(self.workers))
         self.router.indexer.remove_worker(worker_id)
         self.health.forget(worker_id)
+        self.load.forget(worker_id)
 
     async def clear_kv_blocks(self) -> int:
         """Fan /clear_kv_blocks out to every routed worker and drop their
@@ -163,23 +175,43 @@ class KvPushRouter:
         self, rid: str, cur: PreprocessedRequest, tried: set[WorkerId]
     ) -> tuple[WorkerId, int]:
         """One routing decision: exclude workers already tried for this
-        request AND workers the health plane blocks (tripped breakers,
-        stale heartbeats). When the breaker exclusion leaves nothing,
-        relax it — availability beats precision; the dead ones stay
+        request, workers the health plane blocks (tripped breakers,
+        stale heartbeats), AND workers the overload plane would steer
+        away from (published queue budget saturated, live bounce
+        cooldown, or — for a deadline-carrying request — an estimated
+        queue wait that can't meet the deadline). Exclusions relax in
+        reverse order of confidence when they empty the candidate list —
+        availability beats precision; overload hints first (the worker
+        will shed what it must), then breakers; the dead ones stay
         excluded via ``tried``. Raises NoEndpoints when no worker is
         routable at all."""
-        blocked = self.health.blocked(list(self.workers))
-        try:
-            return self.router.find_best_match(
-                rid, cur.token_ids, salt=cur.model,
-                exclude=tried | blocked,
-            )
-        except NoEndpoints:
-            if not blocked:
-                raise
-            return self.router.find_best_match(
-                rid, cur.token_ids, salt=cur.model, exclude=tried,
-            )
+        workers = list(self.workers)
+        blocked = self.health.blocked(workers)
+        overloaded = self.load.blocked(
+            workers, deadline=getattr(cur, "deadline", None)
+        )
+        stages = [tried | blocked | overloaded]
+        if overloaded:
+            stages.append(tried | blocked)
+        if blocked:
+            stages.append(tried)
+        last = len(stages) - 1
+        for i, exclude in enumerate(stages):
+            try:
+                worker, overlap = self.router.find_best_match(
+                    rid, cur.token_ids, salt=cur.model, exclude=exclude,
+                )
+            except NoEndpoints:
+                if i == last:
+                    raise
+                continue
+            # (spills are counted at the BOUNCE, not here: whether the
+            # proactive exclusion changed THIS decision's outcome is
+            # unknowable without re-running the scheduler, and counting
+            # every route made while any worker cools down would
+            # overstate the storm)
+            return worker, overlap
+        raise NoEndpoints("no routable worker")  # unreachable
 
     async def generate(
         self, request: PreprocessedRequest
@@ -205,7 +237,10 @@ class KvPushRouter:
         tried: set[WorkerId] = set()
         cur = request
         route_attempts = max(1, len(self.workers))
-        migrations_left = self.migration.budget(len(self.workers))
+        # migration budget is evaluated at FAILURE time against the
+        # fleet as it is then — workers added after this request started
+        # (scale-up mid-stream) are valid migration targets
+        migrations_used = 0
         last_err: Optional[BaseException] = None
         attempt = 0
         while attempt < route_attempts + self.migration.max_migrations:
@@ -252,9 +287,35 @@ class KvPushRouter:
                     yield out
                 self.health.record_success(worker_id)
                 return
+            except EngineOverloadedError as e:
+                # overload bounce: the worker refused ADMISSION, so no
+                # tokens exist to lose — spill to the next-best peer.
+                # The worker is healthy (it answered!), so no breaker
+                # strike and no eviction; the load view just cools it
+                # down for exactly the window it asked for.
+                last_err = e
+                if streamed:
+                    raise  # can't happen (admission is pre-stream)
+                tried.add(worker_id)
+                self.load.note_overloaded(
+                    worker_id, getattr(e, "retry_after_s", 1.0)
+                )
+                OVERLOAD.inc("dynamo_overload_router_spills_total")
+                log.info(
+                    "worker %s overloaded; spilling %s to a peer "
+                    "(retry_after %.2fs)",
+                    worker_id, rid, getattr(e, "retry_after_s", 1.0),
+                )
+                continue
             except (ConnectionError, OSError) as e:
                 last_err = e
-                self.health.record_failure(worker_id)
+                # PreemptedError is a DELIBERATE action by a healthy
+                # worker (a higher-priority request took the lane): no
+                # breaker strike, never evict the worker — the victim
+                # request just moves elsewhere (exclusion via `tried`).
+                preempted = isinstance(e, PreemptedError)
+                if not preempted:
+                    self.health.record_failure(worker_id)
                 tried.add(worker_id)
                 if finish_seen:
                     # the finish output was already delivered — the worker
@@ -267,6 +328,14 @@ class KvPushRouter:
                     )
                     return
                 if not streamed:
+                    if preempted:
+                        # nothing emitted yet: the original request
+                        # re-routes as-is — worker stays in the fleet
+                        log.info(
+                            "worker %s preempted %s before its first "
+                            "token; re-routing", worker_id, rid,
+                        )
+                        continue
                     log.warning(
                         "worker %s unreachable (%s); evicting and "
                         "re-routing %s", worker_id, e, rid,
@@ -278,10 +347,12 @@ class KvPushRouter:
                         raise
                     continue
                 # ---- mid-stream: live migration ----
-                if not self.migration.enabled or migrations_left <= 0:
+                if (not self.migration.enabled
+                        or migrations_used
+                        >= self.migration.budget(len(self.workers))):
                     RESILIENCE.inc("dynamo_migration_failed_total")
                     raise
-                migrations_left -= 1
+                migrations_used += 1
                 replay = build_replay_request(request, emitted)
                 if replay is None:
                     # token budget already delivered: the uninterrupted
@@ -314,6 +385,14 @@ class KvPushRouter:
                 self.router.free(rid)
         if emitted:
             RESILIENCE.inc("dynamo_migration_failed_total")
+        if isinstance(last_err, EngineOverloadedError) and not emitted:
+            # every worker bounced admission: the FLEET is overloaded —
+            # surface the typed, retriable error (frontend: 429 +
+            # Retry-After) instead of a generic connection failure
+            raise EngineOverloadedError(
+                f"all workers overloaded for request {rid}",
+                retry_after_s=last_err.retry_after_s,
+            ) from last_err
         raise ConnectionError(
             f"no reachable worker for request {rid}"
         ) from last_err

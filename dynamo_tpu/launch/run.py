@@ -91,6 +91,30 @@ def build_parser() -> argparse.ArgumentParser:
                    help="deadline in seconds for one queued page "
                         "export/import op (raise for multi-GiB chunked "
                         "imports on slow host links)")
+    p.add_argument("--kv-transfer-stream-idle-timeout", type=float,
+                   default=cfg.kv_transfer_stream_idle_timeout_s,
+                   help="idle-timeout in seconds reclaiming a chunked "
+                        "export stream whose receiver stalled (pinned "
+                        "gather handles/page refs freed)")
+    # overload plane (dynamo_tpu/overload/)
+    p.add_argument("--max-waiting-requests", type=int,
+                   default=cfg.max_waiting_requests,
+                   help="bounded admission: waiting-queue depth budget; "
+                        "intake past it is refused with a retriable "
+                        "overload error (HTTP 429 + Retry-After at the "
+                        "frontend). 0 = unbounded")
+    p.add_argument("--max-waiting-prefill-tokens", type=int,
+                   default=cfg.max_waiting_prefill_tokens,
+                   help="bounded admission: prompt-token budget over "
+                        "the waiting queue. 0 = unbounded")
+    p.add_argument("--preempt-running",
+                   default="on" if cfg.preempt_running else "off",
+                   choices=["on", "off"],
+                   help="allow a waiting HIGH-priority request to "
+                        "force-migrate the lowest-priority RUNNING "
+                        "stream (preemption-as-migration via the "
+                        "resilience plane; exactly-once, greedy "
+                        "token-identical)")
     # speculative decoding (dynamo_tpu/spec/)
     p.add_argument("--speculative", default=cfg.speculative,
                    choices=["off", "ngram", "draft"],
@@ -469,6 +493,12 @@ def build_chain(args) -> "Any":
             kv_transfer_chunk_pages=args.kv_transfer_chunk_pages,
             kv_transfer_inflight_chunks=args.kv_transfer_inflight_chunks,
             xfer_op_timeout_s=args.xfer_op_timeout,
+            kv_transfer_stream_idle_timeout_s=(
+                args.kv_transfer_stream_idle_timeout
+            ),
+            max_waiting_requests=args.max_waiting_requests,
+            max_waiting_prefill_tokens=args.max_waiting_prefill_tokens,
+            preempt_running=args.preempt_running == "on",
         )
         draft_cfg = None
         if args.speculative == "draft":

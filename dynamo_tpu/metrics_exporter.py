@@ -104,6 +104,18 @@ class MetricsExporter:
         gauge("dynamo_worker_waiting_requests", "queued requests",
               {w: m.worker_stats.num_requests_waiting
                for w, m in snap.metrics.items()})
+        gauge("dynamo_worker_waiting_prefill_tokens",
+              "prompt tokens waiting for prefill",
+              {w: m.worker_stats.num_waiting_prefill_tokens
+               for w, m in snap.metrics.items()})
+        gauge("dynamo_worker_max_waiting_requests",
+              "admission queue-depth budget (0 = unbounded)",
+              {w: m.worker_stats.max_waiting_requests
+               for w, m in snap.metrics.items()})
+        gauge("dynamo_worker_max_waiting_prefill_tokens",
+              "admission prefill-token budget (0 = unbounded)",
+              {w: m.worker_stats.max_waiting_prefill_tokens
+               for w, m in snap.metrics.items()})
         gauge("dynamo_kv_active_blocks", "KV pages in use",
               {w: m.kv_stats.kv_active_blocks
                for w, m in snap.metrics.items()})
@@ -158,13 +170,14 @@ class MetricsExporter:
                 )[2:])
         gauge("dynamo_metrics_workers",
               "workers in the last load-plane snapshot", len(snap.metrics))
-        # resilience + KV-transfer planes: process-local counters, same
-        # families on every scrape surface
+        # resilience + KV-transfer + overload planes: process-local
+        # counters, same families on every scrape surface
         from dynamo_tpu.kv_transfer_metrics import KV_TRANSFER
+        from dynamo_tpu.overload import OVERLOAD
         from dynamo_tpu.resilience.metrics import RESILIENCE
 
         return ("\n".join(lines) + "\n" + RESILIENCE.render()
-                + KV_TRANSFER.render())
+                + KV_TRANSFER.render() + OVERLOAD.render())
 
     async def handle_metrics(self, request: web.Request) -> web.Response:
         return web.Response(

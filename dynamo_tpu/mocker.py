@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import asyncio
 import time
+from collections import deque
 from dataclasses import dataclass, field
 from typing import AsyncIterator, Callable, Optional
 
@@ -56,6 +57,12 @@ class MockerArgs:
     speedup_ratio: float = 1.0
     enable_prefix_caching: bool = True
     worker_id: str = "mocker"
+    # overload plane (dynamo_tpu/overload/): bounded admission budgets
+    # over the waiting queue (0 = unbounded), so router/frontend
+    # overload paths test on CPU. Unlike TpuEngine the bound applies to
+    # every priority class (no preemption machinery here).
+    max_waiting_requests: int = 0
+    max_waiting_prefill_tokens: int = 0
 
 
 @dataclass
@@ -104,6 +111,20 @@ class MockerEngine:
         self.step_count = 0
         self.tokens_generated = 0
         self.preemptions = 0
+        # overload plane: bounded admission + deadline shedding, with a
+        # load-derived Retry-After from recently observed queue waits
+        from dynamo_tpu.overload import AdmissionController
+
+        self._queue_waits: deque = deque(maxlen=32)
+        self.admission = AdmissionController(
+            self.args.max_waiting_requests,
+            self.args.max_waiting_prefill_tokens,
+            queue_wait_s=lambda: (
+                sum(self._queue_waits) / len(self._queue_waits)
+                if self._queue_waits else None
+            ),
+        )
+        self.sheds = 0
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -152,6 +173,32 @@ class MockerEngine:
             self.start()
         if not request.token_ids:
             raise ValueError("empty prompt")
+        if (request.deadline is not None
+                and time.time() > request.deadline):
+            from dynamo_tpu.overload import OVERLOAD
+
+            self.sheds += 1
+            OVERLOAD.inc("dynamo_overload_shed_total")
+            yield LLMEngineOutput(
+                token_ids=[], finish_reason=FinishReason.DEADLINE,
+                annotations={"shed": {"reason": "deadline",
+                                      "queued_s": 0.0}},
+            )
+            return
+        # the bound applies to EVERY priority class here: the mocker has
+        # no waiting-entry preemption, so force-admitting high-priority
+        # traffic would leave its queue unbounded (priority preemption
+        # is a TpuEngine feature — see engine.py _enforce_bounds)
+        if self.admission.bounded:
+            from dynamo_tpu.overload import OVERLOAD
+
+            waiting = len(self._waiting)
+            tokens = sum(len(w.prompt) for w in self._waiting)
+            try:
+                self.admission.check(waiting, tokens)
+            except Exception:
+                OVERLOAD.inc("dynamo_overload_rejected_total")
+                raise
         r = _MockRequest(
             req=request,
             seq=TokenBlockSequence.from_tokens(
@@ -183,6 +230,13 @@ class MockerEngine:
                 request_active_slots=len(self._active),
                 request_total_slots=self.args.max_decode_slots,
                 num_requests_waiting=len(self._waiting),
+                num_waiting_prefill_tokens=sum(
+                    len(w.prompt) for w in self._waiting
+                ),
+                max_waiting_requests=self.args.max_waiting_requests,
+                max_waiting_prefill_tokens=(
+                    self.args.max_waiting_prefill_tokens
+                ),
             ),
             kv_stats=KvStats(
                 kv_active_blocks=a.active_pages,
@@ -250,6 +304,30 @@ class MockerEngine:
 
     def _admit(self) -> None:
         a = self.args
+        # deadline-aware shedding: drop still-WAITING requests whose
+        # deadline passed (zero tokens, DEADLINE finish) — never one
+        # that already produced output (preemption re-queues those)
+        now = time.time()
+        kept = []
+        for r in self._waiting:
+            if (r.produced == 0 and not r.prefilling
+                    and r.req.deadline is not None
+                    and now > r.req.deadline):
+                from dynamo_tpu.overload import OVERLOAD
+
+                self.sheds += 1
+                OVERLOAD.inc("dynamo_overload_shed_total")
+                r.out.put_nowait(LLMEngineOutput(
+                    token_ids=[], finish_reason=FinishReason.DEADLINE,
+                    annotations={"shed": {
+                        "reason": "deadline",
+                        "queued_s": round(
+                            time.monotonic() - r.enqueue_time, 3),
+                    }},
+                ))
+            else:
+                kept.append(r)
+        self._waiting = kept
         while self._waiting and len(self._active) < a.max_decode_slots:
             r = self._waiting[0]
             ps = a.page_size
@@ -270,6 +348,7 @@ class MockerEngine:
                 return  # head-of-line blocks until space frees
             r.pages = matched + fresh
             r.prefilling = True
+            self._queue_waits.append(time.monotonic() - r.enqueue_time)
             self._waiting.pop(0)
             self._active.append(r)
             # simulated prefill cost for the non-cached suffix

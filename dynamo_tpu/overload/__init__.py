@@ -1,0 +1,67 @@
+"""Overload-protection plane: bounded admission, deadline-aware
+shedding, end-to-end backpressure, and two-class priority preemption.
+
+The failure mode this closes: nothing in the stack bounded load — the
+engine's waiting queue grew without limit, the frontend never said 429,
+and a request that had already blown its SLA still consumed prefill
+compute. A saturated worker degraded EVERYONE's TTFT unboundedly
+instead of degrading gracefully.
+
+Pieces (each documented in its module):
+
+  errors      EngineOverloadedError (retriable, carries Retry-After) +
+              PreemptedError (mid-stream; routed into the migration
+              plane)
+  admission   per-engine waiting-queue budgets + load-derived retry
+              hints
+  deadline    absolute deadlines + two-class priority, minted at the
+              frontend (headers / nvext), threaded through
+              PreprocessedRequest
+  load        router-side live queue-depth/budget view — spill to warm
+              peers BEFORE the shed
+  metrics     dynamo_overload_* counters/gauges on all three scrape
+              surfaces
+"""
+from dynamo_tpu.overload.admission import (
+    AdmissionController,
+    DEFAULT_QUEUE_WAIT_S,
+    RETRY_AFTER_MAX_S,
+    RETRY_AFTER_MIN_S,
+)
+from dynamo_tpu.overload.deadline import (
+    DEADLINE_HEADER,
+    PRIORITY_HEADER,
+    PRIORITY_HIGH,
+    PRIORITY_NORMAL,
+    apply_request_hints,
+    expired,
+    mint_deadline,
+    parse_priority,
+    remaining_s,
+)
+from dynamo_tpu.overload.errors import (
+    EngineOverloadedError,
+    PreemptedError,
+)
+from dynamo_tpu.overload.load import WorkerLoadView
+from dynamo_tpu.overload.metrics import OVERLOAD
+
+__all__ = [
+    "AdmissionController",
+    "DEADLINE_HEADER",
+    "DEFAULT_QUEUE_WAIT_S",
+    "EngineOverloadedError",
+    "OVERLOAD",
+    "PRIORITY_HEADER",
+    "PRIORITY_HIGH",
+    "PRIORITY_NORMAL",
+    "PreemptedError",
+    "RETRY_AFTER_MAX_S",
+    "RETRY_AFTER_MIN_S",
+    "WorkerLoadView",
+    "apply_request_hints",
+    "expired",
+    "mint_deadline",
+    "parse_priority",
+    "remaining_s",
+]

@@ -1,0 +1,38 @@
+"""Overload-plane error types.
+
+Both subclass ``ConnectionError`` so every existing retriable-error path
+(the endpoint wire's ``retriable`` frames, the router's failover loop)
+treats them as "this worker, right now" problems rather than request
+failures — the same contract ``WorkerDrainingError`` rides.
+
+``EngineOverloadedError`` additionally carries a load-derived
+``retry_after_s`` hint end-to-end: the engine computes it from its queue
+state, the endpoint wire ships it in the error frame, the router uses it
+as the spill cooldown for the bounced worker, and the frontend surfaces
+it as the HTTP 429 ``Retry-After`` header.
+"""
+from __future__ import annotations
+
+
+class EngineOverloadedError(ConnectionError):
+    """Admission refused: the engine's waiting-queue budget is full.
+
+    Retriable by construction — the request was never admitted, so a
+    retry (on a peer now, or here after ``retry_after_s``) cannot
+    duplicate work or tokens.
+    """
+
+    def __init__(self, message: str, retry_after_s: float = 1.0):
+        super().__init__(message)
+        self.retry_after_s = max(0.0, float(retry_after_s))
+
+
+class PreemptedError(ConnectionError):
+    """A running low-priority stream was force-evicted to free a lane
+    for a higher-priority request.
+
+    Deliberately NOT ``EngineOverloadedError``: the router must treat
+    this as a mid-stream loss and run the migration plane (replay
+    prompt + emitted tokens on a peer, exactly-once) — preemption IS a
+    forced migration, not a shed.
+    """

@@ -250,4 +250,10 @@ class OpenAIPreprocessor:
         nvext = req.nvext or {}
         if nvext.get("annotations"):
             pre.annotations = list(nvext["annotations"])
+        # overload plane: nvext priority/timeout_ms fold onto the
+        # request here so every caller of preprocess() gets them; the
+        # HTTP service re-applies with headers on top (headers win)
+        from dynamo_tpu.overload import apply_request_hints
+
+        apply_request_hints(pre, None, nvext)
         return pre

@@ -21,6 +21,9 @@ class FinishReason(str, enum.Enum):
     LENGTH = "length"
     CANCELLED = "cancelled"
     ERROR = "error"
+    # shed while still WAITING: the request's deadline passed before any
+    # prefill work ran (overload plane) — zero tokens by construction
+    DEADLINE = "deadline"
 
     def to_openai(self) -> str:
         # OpenAI surfaces only {stop, length, content_filter, tool_calls}
@@ -30,6 +33,7 @@ class FinishReason(str, enum.Enum):
             FinishReason.LENGTH: "length",
             FinishReason.CANCELLED: "stop",
             FinishReason.ERROR: "stop",
+            FinishReason.DEADLINE: "stop",
         }[self]
 
 
@@ -74,6 +78,13 @@ class PreprocessedRequest:
     stop_conditions: StopConditions = field(default_factory=StopConditions)
     sampling_options: SamplingOptions = field(default_factory=SamplingOptions)
     output_options: OutputOptions = field(default_factory=OutputOptions)
+    # Overload plane (dynamo_tpu/overload/): two-class priority (0 =
+    # normal, 1 = high — high may preempt waiting/low-priority work) and
+    # an ABSOLUTE unix-time deadline minted at the frontend; the engine
+    # sheds still-waiting requests whose deadline passed, the router
+    # skips workers whose queue can't meet it.
+    priority: int = 0
+    deadline: Optional[float] = None
     # Router annotation: expected prefix-cache hit depth for this worker
     # (reference kv_router.rs estimated_prefix_hit_num_blocks).
     estimated_prefix_hit_num_blocks: Optional[int] = None

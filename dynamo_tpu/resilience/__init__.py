@@ -20,6 +20,9 @@ TPU-native analogue, spanning every serving layer:
   chaos.py      fault-injection harness: kill_worker / stall_stream /
                 drop_response / delay hooks armed via env, CLI, or the
                 system server's /chaos control (tools/chaos.py)
+  shared.py     SharedBreakerBoard: breaker trips/closes published on
+                the store's pub/sub plane so sibling frontends stop
+                routing to a dead worker without re-discovering it
   metrics.py    dynamo_migration_* / dynamo_resilience_* counters
                 rendered on all three scrape surfaces
 """
@@ -29,9 +32,11 @@ from dynamo_tpu.resilience.health import WorkerHealthTracker
 from dynamo_tpu.resilience.metrics import RESILIENCE, ResilienceMetrics
 from dynamo_tpu.resilience.migration import MigrationPolicy, build_replay_request
 from dynamo_tpu.resilience.policy import BreakerState, CircuitBreaker, RetryPolicy
+from dynamo_tpu.resilience.shared import SharedBreakerBoard
 
 __all__ = [
     "BreakerState",
+    "SharedBreakerBoard",
     "CHAOS",
     "ChaosHooks",
     "ChaosPoint",

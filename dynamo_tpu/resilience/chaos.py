@@ -19,6 +19,10 @@ side of the push-RPC plane — exactly where a real worker death manifests):
                  testing loss DETECTION; migration can't repair in-band
                  loss)
   delay          sleep ``t`` seconds before each output (slow worker)
+  storm          synthetic overload: refuse the request AT STREAM START
+                 with the retriable EngineOverloadedError (``t`` is the
+                 Retry-After hint) — exercises the whole 429/spill/
+                 backpressure machinery without generating real load
 
 Entry grammar: comma-separated ``name[:key=value]*`` with keys
 ``p`` (probability, default 1), ``t`` (seconds), ``after`` (output count).
@@ -35,7 +39,8 @@ from dynamo_tpu.resilience.metrics import RESILIENCE
 
 log = logging.getLogger(__name__)
 
-POINT_NAMES = ("kill_worker", "stall_stream", "drop_response", "delay")
+POINT_NAMES = ("kill_worker", "stall_stream", "drop_response", "delay",
+               "storm")
 
 
 class ChaosInjectedError(ConnectionResetError):
@@ -166,6 +171,17 @@ class ChaosHooks:
         self, stream: AsyncIterator[Any]
     ) -> AsyncIterator[Any]:
         """Apply armed points to one response stream (worker side)."""
+        storm = self.points["storm"]
+        if storm.armed and self._fire(storm):
+            # synthetic overload: bounce BEFORE any output, exactly like
+            # a full admission queue would — retriable, with the point's
+            # delay as the Retry-After hint
+            from dynamo_tpu.overload.errors import EngineOverloadedError
+
+            raise EngineOverloadedError(
+                "chaos: storm (synthetic overload)",
+                retry_after_s=storm.delay_s or 1.0,
+            )
         n = 0
         kill = self.points["kill_worker"]
         stall = self.points["stall_stream"]

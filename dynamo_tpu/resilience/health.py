@@ -41,6 +41,16 @@ class WorkerHealthTracker:
         self.clock = clock
         self._breakers: dict[str, CircuitBreaker] = {}
         self._last_seen: dict[str, float] = {}
+        # cross-frontend breaker sharing (resilience/shared.py): trips
+        # observed ELSEWHERE block routing here until their window ends;
+        # local trips/closes fire the hook so a board can publish them.
+        # Remote state is advisory only — it never feeds the LOCAL
+        # breaker's failure counts (a remote frontend's view of a worker
+        # is not this frontend's evidence).
+        self._remote_open: dict[str, float] = {}   # wid -> blocked until
+        self.on_state_change: Optional[
+            Callable[[str, str, float], None]
+        ] = None    # (worker_id, "open"|"closed", window_s)
 
     def breaker(self, worker_id: str) -> CircuitBreaker:
         b = self._breakers.get(worker_id)
@@ -78,10 +88,17 @@ class WorkerHealthTracker:
         it here would starve a recovered worker whenever the scheduler
         picked someone else for that decision."""
         out = set()
+        now = self.clock()
         for wid in worker_ids:
             if self.stale(wid):
                 out.add(wid)
                 continue
+            until = self._remote_open.get(wid)
+            if until is not None:
+                if until > now:
+                    out.add(wid)
+                    continue
+                del self._remote_open[wid]   # window over: probe freely
             b = self._breakers.get(wid)
             if b is not None and not b.peek_allow():
                 out.add(wid)
@@ -99,17 +116,49 @@ class WorkerHealthTracker:
     def record_success(self, worker_id: str) -> None:
         b = self._breakers.get(worker_id)
         if b is not None:
+            was_open = b.state is not BreakerState.CLOSED
             b.record_success()
+            if was_open and b.state is BreakerState.CLOSED:
+                # probe succeeded: lift any remote block too and tell
+                # sibling frontends the worker recovered
+                self._remote_open.pop(worker_id, None)
+                self._fire(worker_id, "closed", 0.0)
             self._export_open_gauge()
 
     def record_failure(self, worker_id: str) -> None:
-        self.breaker(worker_id).record_failure()
+        b = self.breaker(worker_id)
+        trips_before = b.trips
+        b.record_failure()
+        if b.trips > trips_before:
+            self._fire(worker_id, "open", self.reset_timeout_s)
         self._export_open_gauge()
+
+    # ---- cross-frontend sharing (resilience/shared.py) ----
+
+    def note_remote_open(self, worker_id: str, window_s: float) -> None:
+        """A sibling frontend's breaker tripped for this worker: block
+        routing here for the remainder of its reset window."""
+        if window_s <= 0:
+            return
+        self._remote_open[worker_id] = self.clock() + window_s
+        self._export_open_gauge()
+
+    def clear_remote_open(self, worker_id: str) -> None:
+        self._remote_open.pop(worker_id, None)
+
+    def _fire(self, worker_id: str, state: str, window_s: float) -> None:
+        if self.on_state_change is None:
+            return
+        try:
+            self.on_state_change(worker_id, state, window_s)
+        except Exception:  # noqa: BLE001 — publishing is best-effort
+            pass
 
     def forget(self, worker_id: str) -> None:
         """Worker left the fleet: drop its breaker + lease state."""
         self._breakers.pop(worker_id, None)
         self._last_seen.pop(worker_id, None)
+        self._remote_open.pop(worker_id, None)
         self._export_open_gauge()
 
     def states(self) -> dict[str, str]:

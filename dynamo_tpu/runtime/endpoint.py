@@ -21,6 +21,7 @@ import asyncio
 import logging
 from typing import Any, AsyncIterator, Callable, Optional
 
+from dynamo_tpu.overload.errors import EngineOverloadedError
 from dynamo_tpu.runtime.protocol import encode_frame, read_frame
 
 log = logging.getLogger(__name__)
@@ -75,6 +76,13 @@ class EndpointServer:
                 frame = {"error": str(e), "done": True}
                 if isinstance(e, ConnectionError):
                     frame["retriable"] = True
+                if isinstance(e, EngineOverloadedError):
+                    # overload is retriable AND typed: the client must
+                    # re-raise the overload class (the router's spill
+                    # path and the frontend's 429 both key on it) with
+                    # the load-derived Retry-After hint intact
+                    frame["overloaded"] = True
+                    frame["retry_after_s"] = e.retry_after_s
                 writer.write(encode_frame(frame))
                 await writer.drain()
             except (ConnectionResetError, BrokenPipeError, OSError):
@@ -113,6 +121,12 @@ async def call_endpoint(
             if "data" in msg:
                 yield msg["data"]
             if msg.get("error"):
+                if msg.get("overloaded"):
+                    raise EngineOverloadedError(
+                        msg["error"],
+                        retry_after_s=float(
+                            msg.get("retry_after_s", 1.0)),
+                    )
                 if msg.get("retriable"):
                     raise EndpointConnectionError(msg["error"])
                 raise EndpointStreamError(msg["error"])

@@ -115,6 +115,15 @@ class SystemServer:
                   ws.request_total_slots)
                 g("dynamo_worker_waiting_requests", "queued requests",
                   ws.num_requests_waiting)
+                g("dynamo_worker_waiting_prefill_tokens",
+                  "prompt tokens waiting for prefill",
+                  ws.num_waiting_prefill_tokens)
+                g("dynamo_worker_max_waiting_requests",
+                  "admission queue-depth budget (0 = unbounded)",
+                  ws.max_waiting_requests)
+                g("dynamo_worker_max_waiting_prefill_tokens",
+                  "admission prefill-token budget (0 = unbounded)",
+                  ws.max_waiting_prefill_tokens)
                 g("dynamo_kv_active_blocks", "KV pages in use",
                   ks.kv_active_blocks)
                 g("dynamo_kv_total_blocks", "KV page capacity",
@@ -142,11 +151,13 @@ class SystemServer:
                         name, snap.get("help", name), snap,
                         label=f'worker="{w}"',
                     ))
-        # resilience + KV-transfer planes: counters of THIS process
+        # resilience + KV-transfer + overload planes: counters of THIS
+        # process
         from dynamo_tpu.kv_transfer_metrics import KV_TRANSFER
+        from dynamo_tpu.overload import OVERLOAD
 
         return ("\n".join(lines) + "\n" + RESILIENCE.render()
-                + KV_TRANSFER.render())
+                + KV_TRANSFER.render() + OVERLOAD.render())
 
     async def handle_metrics(self, request: web.Request) -> web.Response:
         return web.Response(text=self.render(), content_type="text/plain")

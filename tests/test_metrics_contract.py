@@ -154,6 +154,18 @@ def test_readme_documents_canonical_series():
         "dynamo_kv_transfer_chunk_seconds",
         "dynamo_kv_transfer_seconds",
         "dynamo_disagg_fallback_total",
+        # overload-protection plane (dynamo_tpu/overload/)
+        "dynamo_overload_rejected_total",
+        "dynamo_overload_shed_total",
+        "dynamo_overload_preempted_total",
+        "dynamo_overload_preempt_migrations_total",
+        "dynamo_overload_http_429_total",
+        "dynamo_overload_router_spills_total",
+        "dynamo_overload_queue_depth",
+        "dynamo_overload_queue_tokens",
+        "dynamo_worker_waiting_prefill_tokens",
+        "dynamo_worker_max_waiting_requests",
+        "dynamo_worker_max_waiting_prefill_tokens",
     ):
         assert name in readme, f"{name} missing from README"
     for endpoint in ("/debug/trace", "/debug/flight"):

@@ -1,0 +1,846 @@
+"""Overload-protection plane (dynamo_tpu/overload/): bounded admission,
+deadline-aware shedding, end-to-end backpressure, priority preemption.
+
+The keystones:
+  - intake past the queue budget bounces RETRIABLY end-to-end (typed
+    wire frames, router spill to warm peers, HTTP 429 + Retry-After at
+    the frontend) and a retry after the hint succeeds with no duplicate
+    tokens;
+  - a still-waiting request whose deadline passed sheds with ZERO
+    tokens and the DEADLINE finish reason — never a mid-stream one;
+  - preempting a running low-priority stream IS a forced migration:
+    the victim's merged client stream is greedy token-identical to an
+    uninterrupted run.
+"""
+import asyncio
+import time
+
+import numpy as np
+import pytest
+
+from dynamo_tpu.mocker import MockerArgs, MockerEngine
+from dynamo_tpu.overload import (
+    OVERLOAD,
+    AdmissionController,
+    EngineOverloadedError,
+    WorkerLoadView,
+    apply_request_hints,
+    mint_deadline,
+    parse_priority,
+)
+from dynamo_tpu.protocols.common import (
+    FinishReason,
+    LLMEngineOutput,
+    PreprocessedRequest,
+    StopConditions,
+)
+
+BS = 16
+
+
+@pytest.fixture(autouse=True)
+def _reset_overload():
+    OVERLOAD.reset()
+    yield
+    OVERLOAD.reset()
+
+
+def _req(tokens, max_tokens=8, priority=0, deadline=None):
+    r = PreprocessedRequest(
+        token_ids=list(tokens),
+        stop_conditions=StopConditions(max_tokens=max_tokens,
+                                       ignore_eos=True),
+    )
+    r.priority = priority
+    r.deadline = deadline
+    return r
+
+
+# ---------------------------------------------------------------------------
+# AdmissionController / deadline helpers (pure units)
+
+
+def test_admission_budgets_and_retry_after():
+    adm = AdmissionController(max_waiting_requests=2,
+                              max_waiting_prefill_tokens=100,
+                              queue_wait_s=lambda: 0.4)
+    assert adm.bounded
+    adm.check(1, 50)  # under both budgets: fine
+    with pytest.raises(EngineOverloadedError) as ei:
+        adm.check(2, 0)  # depth at budget
+    assert ei.value.retry_after_s == pytest.approx(0.8)
+    with pytest.raises(EngineOverloadedError):
+        adm.check(0, 100)  # token budget at budget
+    # clamp: deep backlog never asks for more than the max window
+    assert AdmissionController(
+        1, 0, queue_wait_s=lambda: 100.0
+    ).retry_after_s(50) == 30.0
+    # floor: a barely-full queue never asks for a sub-500ms hammer
+    assert AdmissionController(
+        1, 0, queue_wait_s=lambda: 0.001
+    ).retry_after_s(1) == 0.5
+    # unbounded controller never raises
+    AdmissionController(0, 0).check(10_000, 10_000_000)
+
+
+def test_priority_and_deadline_parsing():
+    assert parse_priority("high") == 1
+    assert parse_priority("HIGH") == 1
+    assert parse_priority("normal") == 0
+    assert parse_priority("low") == 0
+    assert parse_priority(1) == 1
+    assert parse_priority("garbage") == 0
+    assert parse_priority(None) == 0
+    d = mint_deadline(250.0, now=1000.0)
+    assert d == pytest.approx(1000.25)
+    assert mint_deadline("nope") is None
+    assert mint_deadline(-5) is None
+
+    pre = _req([1, 2, 3])
+    apply_request_hints(pre, None, {"priority": "high",
+                                    "timeout_ms": 1000})
+    assert pre.priority == 1
+    assert pre.deadline is not None
+
+    # headers override nvext
+    class H(dict):
+        pass
+
+    pre2 = _req([1])
+    apply_request_hints(
+        pre2, {"X-Request-Priority": "normal",
+               "X-Request-Timeout-Ms": "50"},
+        {"priority": "high"},
+    )
+    assert pre2.priority == 0
+    assert pre2.deadline == pytest.approx(time.time() + 0.05, abs=0.5)
+
+
+def test_worker_load_view_saturation_cooldown_and_deadline():
+    from dynamo_tpu.kv_router.protocols import (
+        ForwardPassMetrics,
+        WorkerStats,
+    )
+    from dynamo_tpu.telemetry import TelemetryRegistry
+    from dynamo_tpu.telemetry import metrics as tmetrics
+
+    class Clock:
+        now = 100.0
+
+        def __call__(self):
+            return self.now
+
+    clk = Clock()
+    view = WorkerLoadView(stale_after_s=5.0, clock=clk)
+
+    def publish(wid, waiting, max_waiting, queue_s=None):
+        hists = {}
+        if queue_s is not None:
+            reg = TelemetryRegistry()
+            h = reg.histogram(*tmetrics.QUEUE)
+            for _ in range(10):
+                h.observe(queue_s)
+            hists = reg.snapshot()
+        view.observe(ForwardPassMetrics(
+            worker_id=wid,
+            worker_stats=WorkerStats(
+                num_requests_waiting=waiting,
+                max_waiting_requests=max_waiting,
+            ),
+            histograms=hists,
+        ))
+
+    publish("w0", waiting=3, max_waiting=4)
+    assert not view.saturated("w0")
+    publish("w0", waiting=4, max_waiting=4)
+    assert view.saturated("w0")
+    assert view.blocked(["w0", "w1"]) == {"w0"}
+    # stale data never blocks
+    clk.now += 10.0
+    assert not view.saturated("w0")
+    # live bounce cooldown blocks for exactly the hint window
+    view.note_overloaded("w1", retry_after_s=2.0)
+    assert view.saturated("w1")
+    clk.now += 2.1
+    assert not view.saturated("w1")
+    # deadline skip: 5 waiting x ~1s observed queue wait >> 1s budget
+    publish("w2", waiting=5, max_waiting=0, queue_s=1.0)
+    assert view.cant_meet("w2", time.time() + 1.0)
+    assert not view.cant_meet("w2", time.time() + 60.0)
+    assert view.blocked(["w2"], deadline=time.time() + 1.0) == {"w2"}
+    assert view.blocked(["w2"]) == set()
+
+
+# ---------------------------------------------------------------------------
+# Mocker engine: bounded admission + deadline shed (deterministic CPU)
+
+
+async def test_mocker_bounded_admission_bounces_retriably():
+    eng = MockerEngine(MockerArgs(
+        page_size=BS, max_decode_slots=1, max_waiting_requests=1,
+        prefill_time_per_token_s=0.002, decode_time_per_step_s=0.01,
+    ))
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(1, 5000, 32).tolist() for _ in range(3)]
+
+    async def drive(req):
+        toks = []
+        async for out in eng.generate(req):
+            toks.extend(out.token_ids)
+        return toks
+
+    t1 = asyncio.ensure_future(drive(_req(prompts[0], max_tokens=12)))
+    for _ in range(200):   # t1 admitted: holds the only slot
+        if eng._active:
+            break
+        await asyncio.sleep(0.005)
+    t2 = asyncio.ensure_future(drive(_req(prompts[1], max_tokens=12)))
+    for _ in range(200):   # t2 waiting: the budget is now full
+        if len(eng._waiting) >= 1:
+            break
+        await asyncio.sleep(0.005)
+    with pytest.raises(EngineOverloadedError) as ei:
+        async for _ in eng.generate(_req(prompts[2], max_tokens=12)):
+            pass
+    assert ei.value.retry_after_s >= 0.5
+    assert OVERLOAD.get("dynamo_overload_rejected_total") == 1
+    out1, out2 = await asyncio.gather(t1, t2)
+    # retriable end-to-end: the bounced request retried on the
+    # recovered engine succeeds with tokens identical to an unloaded
+    # run (it was never admitted, so nothing ran twice)
+    retried = await drive(_req(prompts[2], max_tokens=12))
+    ref = MockerEngine(MockerArgs(page_size=BS, max_decode_slots=1))
+    expected = []
+    async for out in ref.generate(_req(prompts[2], max_tokens=12)):
+        expected.extend(out.token_ids)
+    assert retried == expected
+    await ref.stop()
+    await eng.stop()
+    assert len(out1) == 12 and len(out2) == 12
+
+
+async def test_mocker_deadline_shed_while_waiting():
+    eng = MockerEngine(MockerArgs(
+        page_size=BS, max_decode_slots=1,
+        prefill_time_per_token_s=0.002, decode_time_per_step_s=0.02,
+    ))
+    rng = np.random.RandomState(1)
+    long_req = _req(rng.randint(1, 5000, 32).tolist(), max_tokens=20)
+    hog = asyncio.ensure_future(_drain(eng.generate(long_req)))
+    for _ in range(200):
+        if eng._active:
+            break
+        await asyncio.sleep(0.005)
+    # expires while WAITING behind the hog
+    doomed = _req(rng.randint(1, 5000, 16).tolist(), max_tokens=4,
+                  deadline=time.time() + 0.05)
+    outs = []
+    async for out in eng.generate(doomed):
+        outs.append(out)
+    assert len(outs) == 1
+    assert outs[0].finish_reason is FinishReason.DEADLINE
+    assert outs[0].token_ids == []
+    assert outs[0].annotations["shed"]["reason"] == "deadline"
+    assert eng.sheds == 1
+    assert OVERLOAD.get("dynamo_overload_shed_total") == 1
+    await hog
+    await eng.stop()
+
+
+async def _drain(stream):
+    toks = []
+    async for out in stream:
+        toks.extend(out.token_ids)
+    return toks
+
+
+# ---------------------------------------------------------------------------
+# The wire: typed overloaded error frames over the endpoint plane
+
+
+async def test_overload_error_propagates_over_the_wire():
+    from dynamo_tpu.runtime.endpoint import EndpointServer, call_endpoint
+
+    async def handler(payload):
+        raise EngineOverloadedError("queue full", retry_after_s=7.5)
+        yield  # pragma: no cover — makes this an async generator
+
+    srv = EndpointServer(handler)
+    host, port = await srv.start()
+    with pytest.raises(EngineOverloadedError) as ei:
+        async for _ in call_endpoint(host, port, {"x": 1}):
+            pass
+    # the typed class survives the hop WITH its hint, and stays a
+    # ConnectionError so every retriable-error path treats it as one
+    assert ei.value.retry_after_s == pytest.approx(7.5)
+    assert isinstance(ei.value, ConnectionError)
+    await srv.stop()
+
+
+async def test_worker_draining_still_distinct_from_overload():
+    from dynamo_tpu.resilience.drain import WorkerDrainingError
+    from dynamo_tpu.runtime.endpoint import (
+        EndpointConnectionError,
+        EndpointServer,
+        call_endpoint,
+    )
+
+    async def handler(payload):
+        raise WorkerDrainingError("draining")
+        yield  # pragma: no cover
+
+    srv = EndpointServer(handler)
+    host, port = await srv.start()
+    with pytest.raises(EndpointConnectionError):
+        async for _ in call_endpoint(host, port, {}):
+            pass
+    await srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# Router: spill-before-shed + typed fleet-wide overload
+
+
+class _OverloadedWorker:
+    def __init__(self, retry_after_s=3.0):
+        self.retry_after_s = retry_after_s
+        self.attempts = 0
+
+    async def generate(self, req):
+        self.attempts += 1
+        raise EngineOverloadedError("full", retry_after_s=self.retry_after_s)
+        yield  # pragma: no cover
+
+
+class _ServingWorker:
+    def __init__(self):
+        self.served = 0
+
+    async def generate(self, req):
+        self.served += 1
+        for t in (11, 12, 13):
+            yield LLMEngineOutput(token_ids=[t])
+        yield LLMEngineOutput(token_ids=[],
+                              finish_reason=FinishReason.LENGTH)
+
+
+def _warm_indexer(router, wid, tokens):
+    """Make `wid` the KV-warm (and therefore chosen) worker."""
+    from dynamo_tpu.kv_router.protocols import (
+        KvCacheEvent,
+        KvEventKind,
+        StoredBlock,
+    )
+    from dynamo_tpu.tokens import compute_block_hashes
+
+    hashes = compute_block_hashes(tokens, BS)
+    router.indexer.apply_event(KvCacheEvent(
+        kind=KvEventKind.STORED, worker_id=wid,
+        blocks=[StoredBlock(block_hash=h) for h in hashes],
+    ))
+
+
+async def test_router_spills_overload_to_peer_without_eviction():
+    from dynamo_tpu.kv_router.router import KvPushRouter, KvRouter
+    from dynamo_tpu.kv_router.scheduler import KvRouterConfig
+
+    router = KvRouter(BS, KvRouterConfig(router_temperature=0.0))
+    push = KvPushRouter(router)
+    full = _OverloadedWorker(retry_after_s=2.5)
+    ok = _ServingWorker()
+    push.add_worker("w_full", full)
+    push.add_worker("w_ok", ok)
+    prompt = list(range(1, 4 * BS + 1))
+    _warm_indexer(router, "w_full", prompt)  # KV-warm: chosen first
+
+    toks = await _drain(push.generate(_req(prompt)))
+    assert toks == [11, 12, 13]
+    assert full.attempts == 1 and ok.served == 1
+    # the overloaded worker is NOT evicted (overload is transient) but
+    # IS cooled down for its Retry-After window; exactly ONE spill is
+    # counted per bounce
+    assert "w_full" in push.workers
+    assert push.load.saturated("w_full")
+    assert OVERLOAD.get("dynamo_overload_router_spills_total") == 1
+    # the cooldown steers the NEXT request away proactively
+    toks2 = await _drain(push.generate(_req(prompt)))
+    assert toks2 == [11, 12, 13]
+    assert full.attempts == 1  # never re-tried inside the window
+    # proactive steering is NOT a spill: the counter reports bounces
+    assert OVERLOAD.get("dynamo_overload_router_spills_total") == 1
+
+
+async def test_router_all_overloaded_raises_typed_error():
+    from dynamo_tpu.kv_router.router import KvPushRouter, KvRouter
+    from dynamo_tpu.kv_router.scheduler import KvRouterConfig
+
+    router = KvRouter(BS, KvRouterConfig(router_temperature=0.0))
+    push = KvPushRouter(router)
+    push.add_worker("w0", _OverloadedWorker(retry_after_s=4.0))
+    push.add_worker("w1", _OverloadedWorker(retry_after_s=4.0))
+    with pytest.raises(EngineOverloadedError) as ei:
+        await _drain(push.generate(_req(list(range(1, BS + 1)))))
+    assert ei.value.retry_after_s == pytest.approx(4.0)
+
+
+async def test_router_proactive_spill_from_published_budgets():
+    """Backpressure half: published queue-budget saturation steers
+    routing BEFORE any bounce happens."""
+    from dynamo_tpu.kv_router.protocols import (
+        ForwardPassMetrics,
+        WorkerStats,
+    )
+    from dynamo_tpu.kv_router.router import KvPushRouter, KvRouter
+    from dynamo_tpu.kv_router.scheduler import KvRouterConfig
+
+    router = KvRouter(BS, KvRouterConfig(router_temperature=0.0))
+    push = KvPushRouter(router)
+    full = _OverloadedWorker()
+    ok = _ServingWorker()
+    push.add_worker("w_full", full)
+    push.add_worker("w_ok", ok)
+    prompt = list(range(1, 4 * BS + 1))
+    _warm_indexer(router, "w_full", prompt)
+    # the metrics plane says w_full's queue budget is saturated
+    push.load.observe(ForwardPassMetrics(
+        worker_id="w_full",
+        worker_stats=WorkerStats(num_requests_waiting=4,
+                                 max_waiting_requests=4),
+    ))
+    toks = await _drain(push.generate(_req(prompt)))
+    assert toks == [11, 12, 13]
+    assert full.attempts == 0  # never even dispatched to
+    # no bounce happened, so no spill is counted (the counter reports
+    # live bounces, not every steered decision)
+    assert OVERLOAD.get("dynamo_overload_router_spills_total") == 0
+
+
+# ---------------------------------------------------------------------------
+# TpuEngine: bounded admission, deadline shed, priority preemption
+
+
+def _tiny_engine(**kw):
+    from dynamo_tpu.engine.config import EngineConfig
+    from dynamo_tpu.engine.engine import TpuEngine
+    from dynamo_tpu.models.config import ModelConfig
+    from dynamo_tpu.parallel.mesh import MeshConfig
+
+    cfg = ModelConfig.tiny(dtype="float32")
+    ecfg = EngineConfig(
+        num_pages=128, page_size=BS, max_pages_per_seq=16,
+        max_decode_slots=kw.pop("max_decode_slots", 1),
+        prefill_buckets=(64,), cache_dtype="float32", **kw,
+    )
+    return TpuEngine(cfg, ecfg, params=kw.get("params"),
+                     mesh_config=MeshConfig(tp=1)), cfg
+
+
+async def test_engine_bounded_admission_and_recovery():
+    eng, cfg = _tiny_engine(max_waiting_requests=1)
+    rng = np.random.RandomState(2)
+    prompts = [rng.randint(1, cfg.vocab_size, 40).tolist()
+               for _ in range(3)]
+    hog = asyncio.ensure_future(
+        _drain(eng.generate(_req(prompts[0], max_tokens=120)))
+    )
+    for _ in range(600):   # hog holds the only lane
+        if any(s is not None for s in eng._slots) or eng._prefilling:
+            break
+        await asyncio.sleep(0.005)
+    waiter = asyncio.ensure_future(
+        _drain(eng.generate(_req(prompts[1], max_tokens=4)))
+    )
+    # budget (1 waiting) fills once the waiter queues behind the hog
+    for _ in range(600):
+        if (sum(1 for r in eng._waiting if r.slot < 0)
+                + eng._intake.qsize()) >= 1:
+            break
+        await asyncio.sleep(0.005)
+    with pytest.raises(EngineOverloadedError) as ei:
+        await _drain(eng.generate(_req(prompts[2], max_tokens=4)))
+    assert ei.value.retry_after_s >= 0.5
+    assert OVERLOAD.get("dynamo_overload_rejected_total") == 1
+    out0 = await hog
+    await waiter
+    assert len(out0) == 120
+    # recovered: the same request admits now
+    out2 = await _drain(eng.generate(_req(prompts[2], max_tokens=4)))
+    assert len(out2) == 4
+    await eng.stop()
+
+
+async def test_engine_deadline_shed_while_waiting_no_tokens():
+    eng, cfg = _tiny_engine()
+    rng = np.random.RandomState(3)
+    hog = asyncio.ensure_future(_drain(eng.generate(
+        _req(rng.randint(1, cfg.vocab_size, 40).tolist(),
+             max_tokens=180)
+    )))
+    for _ in range(600):
+        if any(s is not None for s in eng._slots) or eng._prefilling:
+            break
+        await asyncio.sleep(0.005)
+    doomed = _req(rng.randint(1, cfg.vocab_size, 24).tolist(),
+                  max_tokens=8, deadline=time.time() + 0.02)
+    outs = []
+    async for out in eng.generate(doomed):
+        outs.append(out)
+    assert [o.finish_reason for o in outs] == [FinishReason.DEADLINE]
+    assert outs[0].token_ids == []
+    assert eng.sheds == 1
+    assert OVERLOAD.get("dynamo_overload_shed_total") == 1
+    await hog
+    await eng.stop()
+
+
+async def test_engine_high_priority_preempts_waiting_entry():
+    eng, cfg = _tiny_engine(max_waiting_requests=1)
+    rng = np.random.RandomState(4)
+    hog = asyncio.ensure_future(_drain(eng.generate(
+        _req(rng.randint(1, cfg.vocab_size, 40).tolist(),
+             max_tokens=100)
+    )))
+    for _ in range(600):   # hog holds the only lane
+        if any(s is not None for s in eng._slots) or eng._prefilling:
+            break
+        await asyncio.sleep(0.005)
+    lowq = rng.randint(1, cfg.vocab_size, 24).tolist()
+    low = asyncio.ensure_future(_drain(eng.generate(
+        _req(lowq, max_tokens=4)
+    )))
+    for _ in range(600):
+        if (sum(1 for r in eng._waiting if r.slot < 0)
+                + eng._intake.qsize()) >= 1:
+            break
+        await asyncio.sleep(0.005)
+    # high-priority arrival on a full queue: admitted anyway — the
+    # waiting low-priority entry is evicted retriably in its place
+    high = asyncio.ensure_future(_drain(eng.generate(
+        _req(rng.randint(1, cfg.vocab_size, 24).tolist(),
+             max_tokens=4, priority=1)
+    )))
+    with pytest.raises(EngineOverloadedError):
+        await low
+    assert OVERLOAD.get("dynamo_overload_preempted_total") == 1
+    assert eng.waiting_preemptions == 1
+    await hog
+    out_high = await high
+    assert len(out_high) == 4
+    await eng.stop()
+
+
+async def test_preemption_as_migration_greedy_token_identical():
+    """Running half: a high-priority arrival force-migrates the running
+    low-priority stream through the router's migration plane — the
+    victim's merged client stream is token-identical to an unloaded
+    run, and the high-priority request serves on the freed lane."""
+    from dynamo_tpu.engine.config import EngineConfig
+    from dynamo_tpu.engine.engine import TpuEngine
+    from dynamo_tpu.kv_router.router import KvPushRouter, KvRouter
+    from dynamo_tpu.kv_router.scheduler import KvRouterConfig
+    from dynamo_tpu.models import llama
+    from dynamo_tpu.models.config import ModelConfig
+    from dynamo_tpu.parallel.mesh import MeshConfig
+    from dynamo_tpu.resilience.metrics import RESILIENCE
+
+    cfg = ModelConfig.tiny(dtype="float32")
+    params = llama.init_params(cfg, 0)
+
+    def mk(wid, preempt=False):
+        return TpuEngine(cfg, EngineConfig(
+            num_pages=128, page_size=BS, max_pages_per_seq=16,
+            max_decode_slots=1, prefill_buckets=(64,),
+            cache_dtype="float32", worker_id=wid,
+            preempt_running=preempt,
+        ), params=params, mesh_config=MeshConfig(tp=1))
+
+    rng = np.random.RandomState(5)
+    victim_prompt = rng.randint(1, cfg.vocab_size, 40).tolist()
+    victim_req = _req(victim_prompt, max_tokens=100)
+
+    # unloaded greedy reference
+    ref = mk("ref")
+    expected = await _drain(ref.generate(_req(victim_prompt,
+                                              max_tokens=100)))
+    await ref.stop()
+
+    eng_a = mk("A", preempt=True)
+    eng_b = mk("B")
+    router = KvRouter(BS, KvRouterConfig(router_temperature=0.0))
+    push = KvPushRouter(router)
+    push.add_worker("A", eng_a)  # the only worker: victim lands here
+
+    migrations_before = RESILIENCE.get("dynamo_migration_total")
+    got: list[int] = []
+
+    async def run_victim():
+        async for out in push.generate(victim_req):
+            got.extend(out.token_ids)
+
+    vt = asyncio.ensure_future(run_victim())
+    for _ in range(2000):
+        if len(got) >= 8:
+            break
+        await asyncio.sleep(0.005)
+    assert len(got) >= 8, "victim never started streaming"
+    push.add_worker("B", eng_b)  # migration target
+    # high-priority request straight at the saturated worker A
+    high = asyncio.ensure_future(_drain(eng_a.generate(
+        _req(rng.randint(1, cfg.vocab_size, 24).tolist(),
+             max_tokens=6, priority=1)
+    )))
+    await vt
+    out_high = await high
+    assert got == expected, "merged stream must be token-identical"
+    assert len(out_high) == 6
+    assert eng_a.preempt_migrations == 1
+    assert OVERLOAD.get("dynamo_overload_preempt_migrations_total") == 1
+    assert (RESILIENCE.get("dynamo_migration_total")
+            == migrations_before + 1)
+    await eng_a.stop()
+    await eng_b.stop()
+
+
+async def test_engine_publishes_queue_budgets_in_metrics():
+    eng, _cfg = _tiny_engine(max_waiting_requests=7,
+                             max_waiting_prefill_tokens=4096)
+    m = eng.metrics()
+    assert m.worker_stats.max_waiting_requests == 7
+    assert m.worker_stats.max_waiting_prefill_tokens == 4096
+    assert m.worker_stats.num_waiting_prefill_tokens == 0
+    await eng.stop()
+
+
+# ---------------------------------------------------------------------------
+# Export-stream idle timeout (carried satellite): a stalled receiver's
+# stream is reclaimed after the idle window, not the full xfer deadline
+
+
+def test_export_stream_idle_timeout_reclaims_stalled_stream():
+    eng, _cfg = _tiny_engine(kv_transfer_stream_idle_timeout_s=0.3)
+    eng.start()
+    stream = eng.export_pages_stream([1, 2, 3, 4], chunk_pages=1,
+                                     inflight=1)
+    # stall: consume nothing past the double-buffer for > idle window
+    time.sleep(1.2)
+    with pytest.raises(RuntimeError, match="abandoned"):
+        for _ in stream:
+            pass
+    asyncio.run(eng.stop())
+
+
+# ---------------------------------------------------------------------------
+# Chaos: the storm injection mode
+
+
+async def test_chaos_storm_bounces_with_retry_after():
+    from dynamo_tpu.resilience.chaos import CHAOS
+
+    CHAOS.reset()
+    CHAOS.arm("storm", delay_s=2.5, once=True)
+
+    async def src():
+        yield {"t": 1}
+
+    with pytest.raises(EngineOverloadedError) as ei:
+        async for _ in CHAOS.wrap_stream(src()):
+            pass
+    assert ei.value.retry_after_s == pytest.approx(2.5)
+    assert not CHAOS.points["storm"].armed  # once-fuse consumed
+    # disarmed: the stream flows
+    items = []
+    async for item in CHAOS.wrap_stream(src()):
+        items.append(item)
+    assert items == [{"t": 1}]
+    CHAOS.reset()
+
+
+# ---------------------------------------------------------------------------
+# Shared breaker state across frontends (carried satellite)
+
+
+async def test_breaker_trips_share_across_frontends():
+    from dynamo_tpu.resilience.health import WorkerHealthTracker
+    from dynamo_tpu.resilience.shared import SharedBreakerBoard
+    from dynamo_tpu.runtime.client import KvClient
+    from dynamo_tpu.runtime.store import serve_store
+
+    server, _store = await serve_store(port=0, sweep_interval_s=0.05)
+    port = server.sockets[0].getsockname()[1]
+    kv_a = await KvClient("127.0.0.1", port).connect()
+    kv_b = await KvClient("127.0.0.1", port).connect()
+    health_a = WorkerHealthTracker(failure_threshold=2,
+                                   reset_timeout_s=30.0)
+    health_b = WorkerHealthTracker(failure_threshold=2,
+                                   reset_timeout_s=30.0)
+    board_a = await SharedBreakerBoard(kv_a, health_a, "t").start()
+    board_b = await SharedBreakerBoard(kv_b, health_b, "t").start()
+
+    # frontend A pays the discovery cost; B learns without any failures
+    health_a.record_failure("w0")
+    health_a.record_failure("w0")
+    for _ in range(100):
+        if "w0" in health_b.blocked(["w0"]):
+            break
+        await asyncio.sleep(0.02)
+    assert "w0" in health_b.blocked(["w0"])
+    # B's own breaker saw no evidence — only the advisory remote block
+    assert health_b.states().get("w0") is None
+    # A's recovery probe succeeds: the close lifts B's block early
+    # (without it, B stays blocked for the full 30s window)
+    health_a.breaker("w0").begin_probe()
+    health_a.record_success("w0")
+    for _ in range(100):
+        if "w0" not in health_b.blocked(["w0"]):
+            break
+        await asyncio.sleep(0.02)
+    assert "w0" not in health_b.blocked(["w0"])
+    await board_a.stop()
+    await board_b.stop()
+    await kv_a.close()
+    await kv_b.close()
+    server.close()
+
+
+# ---------------------------------------------------------------------------
+# Frontend: HTTP 429 + Retry-After, header minting
+
+
+class _OverloadedEngine:
+    async def generate(self, req):
+        raise EngineOverloadedError("engine overloaded: queue at budget",
+                                    retry_after_s=3.2)
+        yield  # pragma: no cover
+
+
+class _CaptureEngine:
+    def __init__(self):
+        self.last = None
+
+    async def generate(self, req):
+        self.last = req
+        yield LLMEngineOutput(token_ids=[5],
+                              finish_reason=FinishReason.LENGTH)
+
+
+def _service(engine):
+    from dynamo_tpu.backend import Backend
+    from dynamo_tpu.frontend import HttpService, ModelChain, ModelManager
+    from dynamo_tpu.preprocessor import (
+        OpenAIPreprocessor,
+        PromptFormatter,
+    )
+    from dynamo_tpu.tokenizer import make_test_tokenizer
+
+    tok = make_test_tokenizer([f"w{i}" for i in range(30)])
+    chain = ModelChain(
+        name="m",
+        preprocessor=OpenAIPreprocessor(tokenizer=tok,
+                                        formatter=PromptFormatter(),
+                                        model_name="m"),
+        engine=engine,
+        backend=Backend(tok),
+    )
+    manager = ModelManager()
+    manager.register(chain)
+    return HttpService(manager)
+
+
+async def _client(svc):
+    from aiohttp.test_utils import TestClient, TestServer
+
+    client = TestClient(TestServer(svc.app))
+    await client.start_server()
+    return client
+
+
+async def test_frontend_unary_429_with_retry_after():
+    svc = _service(_OverloadedEngine())
+    client = await _client(svc)
+    r = await client.post("/v1/chat/completions", json={
+        "model": "m",
+        "messages": [{"role": "user", "content": "w1 w2"}],
+        "max_tokens": 4,
+    })
+    assert r.status == 429
+    assert r.headers["Retry-After"] == "4"  # ceil(3.2)
+    body = await r.json()
+    assert body["error"]["type"] == "overloaded_error"
+    assert OVERLOAD.get("dynamo_overload_http_429_total") == 1
+    # 429s land in the request counter under their real status
+    text = (await (await client.get("/metrics")).text())
+    assert 'status="429"' in text
+    assert "dynamo_overload_http_429_total 1" in text
+    await client.close()
+
+
+async def test_frontend_streaming_429_before_sse_prepare():
+    svc = _service(_OverloadedEngine())
+    client = await _client(svc)
+    r = await client.post("/v1/chat/completions", json={
+        "model": "m",
+        "messages": [{"role": "user", "content": "w1"}],
+        "max_tokens": 4,
+        "stream": True,
+    })
+    # a clean retriable 429 — NOT a 200 SSE stream carrying an error
+    assert r.status == 429
+    assert "Retry-After" in r.headers
+    body = await r.json()
+    assert body["error"]["code"] == 429
+    await client.close()
+
+
+async def test_frontend_mints_priority_and_deadline_from_headers():
+    cap = _CaptureEngine()
+    svc = _service(cap)
+    client = await _client(svc)
+    t0 = time.time()
+    r = await client.post(
+        "/v1/chat/completions",
+        json={"model": "m",
+              "messages": [{"role": "user", "content": "w1"}],
+              "max_tokens": 1},
+        headers={"X-Request-Priority": "high",
+                 "X-Request-Timeout-Ms": "30000"},
+    )
+    assert r.status == 200
+    assert cap.last is not None
+    assert cap.last.priority == 1
+    assert cap.last.deadline == pytest.approx(t0 + 30.0, abs=2.0)
+    # nvext path (no headers)
+    await client.post(
+        "/v1/chat/completions",
+        json={"model": "m",
+              "messages": [{"role": "user", "content": "w1"}],
+              "max_tokens": 1,
+              "nvext": {"priority": 1, "timeout_ms": 5000}},
+    )
+    assert cap.last.priority == 1
+    assert cap.last.deadline == pytest.approx(time.time() + 5.0, abs=2.0)
+    await client.close()
+
+
+async def test_frontend_deadline_finish_reason_maps_to_stop():
+    """A DEADLINE shed surfaces as a completed (empty) response, not an
+    HTTP error — the request's budget ran out, nothing failed."""
+
+    class _ShedEngine:
+        async def generate(self, req):
+            yield LLMEngineOutput(
+                token_ids=[], finish_reason=FinishReason.DEADLINE,
+                annotations={"shed": {"reason": "deadline"}},
+            )
+
+    svc = _service(_ShedEngine())
+    client = await _client(svc)
+    r = await client.post("/v1/chat/completions", json={
+        "model": "m",
+        "messages": [{"role": "user", "content": "w1"}],
+        "max_tokens": 4,
+    })
+    assert r.status == 200
+    body = await r.json()
+    assert body["choices"][0]["finish_reason"] == "stop"
+    assert body["usage"]["completion_tokens"] == 0
+    await client.close()

@@ -144,7 +144,7 @@ async def test_system_server_chaos_control():
         resp = await c.get("/chaos")
         names = {p["name"] for p in (await resp.json())["points"]}
         assert names == {"kill_worker", "stall_stream", "drop_response",
-                         "delay"}
+                         "delay", "storm"}
         resp = await c.post("/chaos", json={
             "point": "kill_worker", "probability": 0.5,
             "after_outputs": 3, "once": True,
