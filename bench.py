@@ -45,11 +45,13 @@ DEFAULT_PEAK = (197e12, 819e9)  # assume v5e if unknown
 def _chip_info():
     import jax
 
-    kind = jax.devices()[0].device_kind
+    dev = jax.devices()[0]
+    kind = dev.device_kind
+    on_accel = dev.platform != "cpu"
     for name, peak in CHIP_PEAKS.items():
         if name.lower() in kind.lower():
-            return kind, peak
-    return kind, DEFAULT_PEAK
+            return kind, peak, on_accel
+    return kind, DEFAULT_PEAK, on_accel
 
 
 def _count_params(params) -> int:
@@ -108,7 +110,7 @@ async def run_bench() -> dict:
 
     eng = TpuEngine(cfg, ecfg, mesh_config=MeshConfig(tp=1))
     n_params = _count_params(eng.params)
-    chip, (peak_flops, peak_bw) = _chip_info()
+    chip, (peak_flops, peak_bw), on_accel = _chip_info()
     eng.start()
 
     rng = np.random.RandomState(0)
@@ -175,15 +177,26 @@ async def run_bench() -> dict:
     )
 
     # ---- phase B: steady-state decode (ITL distribution from
-    # dynamo_request_itl_seconds, this phase's observations only) ----
+    # dynamo_request_itl_seconds, this phase's observations only).
+    # Dispatch-budget accounting rides the same window: deltas of the
+    # engine's dispatch_counts over the phase pin how many host->device
+    # program launches + fetch initiations one decode round costs. ----
     eng.telemetry.reset()
     steps0 = eng.step_count
+    disp0 = dict(eng.dispatch_counts)
     t0 = time.monotonic()
     results = await asyncio.gather(
         *[drive(make_req(max_tokens), t0) for _ in range(n_requests)]
     )
     decode_wall = time.monotonic() - t0
     steps = eng.step_count - steps0
+    disp_delta = {
+        k: v - disp0.get(k, 0) for k, v in eng.dispatch_counts.items()
+    }
+    rounds = disp_delta.get("round", 0) + disp_delta.get("round_seal", 0)
+    dispatches_per_round = (
+        sum(disp_delta.values()) / rounds if rounds else None
+    )
     h_itl = eng.telemetry.get("dynamo_request_itl_seconds")
     itl_p50 = h_itl.percentile(0.50)
     itl_p95 = h_itl.percentile(0.95)
@@ -205,6 +218,12 @@ async def run_bench() -> dict:
     weight_pass_ceiling = peak_bw / param_bytes      # steps/s if BW-bound
     roofline_frac = steps_per_s / weight_pass_ceiling
     mfu = decode_tok_s * 2 * n_params / peak_flops
+    if not on_accel:
+        # CPU harness (tiny bench / CI): the denominators above are a
+        # TPU's peak FLOPs/bandwidth, so "mfu 0.0 / roofline 0.0001"
+        # would be bogus points polluting the perf trajectory — emit
+        # null for utilization fields that are meaningless on CPU
+        prefill_mfu = mfu = roofline_frac = None
 
     # ---- device-only time per fused round (dispatch + block) ----
     device_ms_per_step = None
@@ -224,25 +243,36 @@ async def run_bench() -> dict:
             dest=jnp.arange(B, dtype=jnp.int32),
             tokens=jnp.ones((B,), jnp.int32),
         )
-        out = eng._engine_round(eng.params, eng.ctx, eng.ring, dev,
-                                e.flush_every, False, False)
-        jax.block_until_ready(out)
-        eng.ctx, eng.ring, dev = out[0], out[1], out[2]
+        # time the FUSED round (round + flush + dummy seal) — the
+        # program the serving loop actually dispatches, already hot
+        # from phase B. Two warmups: the first call's outputs carry
+        # jit-output shardings that key one more compilation.
+        def one_round(dev):
+            out = eng._engine_round_seal(
+                eng.params, eng.ctx, eng.ring, dev, eng.cache,
+                *eng._zero_seal, e.flush_every, False, False,
+            )
+            eng.ctx, eng.ring, eng.cache = out[0], out[1], out[3]
+            jax.block_until_ready(out)  # block each rep: no overlap illusion
+            return out[2]
+
+        dev = one_round(one_round(dev))
         t0 = time.monotonic()
         reps = 5
         for _ in range(reps):
-            out = eng._engine_round(
-                eng.params, eng.ctx, eng.ring, dev, e.flush_every,
-                False, False,
-            )
-            eng.ctx, eng.ring, dev = out[0], out[1], out[2]
-            jax.block_until_ready(out)  # block each rep: no overlap illusion
+            dev = one_round(dev)
         device_ms_per_step = (
             (time.monotonic() - t0) / (reps * e.flush_every) * 1e3
         )
     except Exception:  # noqa: BLE001 — breakdown is best-effort
         pass
 
+    decode_ms_per_step = 1e3 / steps_per_s if steps_per_s else None
+    host_ms_per_step = (
+        decode_ms_per_step - device_ms_per_step
+        if decode_ms_per_step is not None and device_ms_per_step is not None
+        else None
+    )
     return {
         "decode_tok_s": decode_tok_s,
         "prefill_tok_s": prefill_tok_s,
@@ -252,10 +282,12 @@ async def run_bench() -> dict:
         "itl_p50_s": itl_p50,
         "itl_p95_s": itl_p95,
         "itl_p99_s": itl_p99,
-        "decode_ms_per_step": 1e3 / steps_per_s if steps_per_s else None,
+        "decode_ms_per_step": decode_ms_per_step,
         "ttft_isolated_s": ttft_isolated,
         "prefill_mfu": prefill_mfu,
         "device_ms_per_step": device_ms_per_step,
+        "host_ms_per_step": host_ms_per_step,
+        "dispatches_per_round": dispatches_per_round,
         "mfu": mfu,
         "roofline_frac": roofline_frac,
         "chip": chip,
@@ -647,7 +679,8 @@ def main():
     for k in ("prefill_tok_s", "prefill_mfu", "ttft_p50_s", "ttft_p95_s",
               "ttft_p99_s", "itl_p50_s", "itl_p95_s", "itl_p99_s",
               "ttft_isolated_s", "decode_ms_per_step",
-              "device_ms_per_step", "mfu",
+              "device_ms_per_step", "host_ms_per_step",
+              "dispatches_per_round", "mfu",
               "roofline_frac", "chip", "params_m", "batch",
               "core_error", "routing_error",
               "routing_kv_ttft_ms", "routing_random_ttft_ms",
@@ -671,7 +704,18 @@ def main():
               "disagg_chunked_ttft_ms", "disagg_mono_ttft_ms",
               "disagg_ttft_speedup", "transfer_overlap_ratio",
               "disagg_chunks_streamed", "disagg_token_equal",
-              "disagg_error"):
+              "disagg_commit_wakeups", "disagg_poll_wakeups_saved",
+              "disagg_error",
+              # kv_quant phase (bench_modes.kv_quant_experiment):
+              # int8-vs-bf16 pool A/B through the disagg relay —
+              # transfer bytes ~0.5x, pool capacity ~2x, prefix-hit
+              # TTFT parity, token-match/logprob-delta parity
+              "kv_quant_tx_bytes_int8", "kv_quant_tx_bytes_bf16",
+              "kv_quant_bytes_ratio", "kv_quant_pool_blocks_int8",
+              "kv_quant_pool_blocks_bf16", "kv_quant_capacity_ratio",
+              "kv_quant_hit_ttft_int8_ms", "kv_quant_hit_ttft_bf16_ms",
+              "kv_quant_token_match_pct", "kv_quant_logprob_delta_max",
+              "kv_quant_remote_prefills", "kv_quant_error"):
         v = stats.get(k)
         if v is None and k.endswith("_error"):
             continue

@@ -618,6 +618,206 @@ async def disagg_experiment(
     }
 
 
+async def kv_quant_experiment(
+    n_requests: int = 3,
+    blocks: int = 16,
+    chunk_pages: int = 4,
+    bandwidth_mbps: float = 32.0,
+    n_new: int = 8,
+) -> dict:
+    """Int8 KV-pool economy A/B (the PR 7 tentpole) through the disagg
+    relay: the SAME prompts remote-prefill into an int8-pool fleet and a
+    bf16-pool fleet, both arms given the SAME pool HBM byte budget (so
+    the int8 pool holds ~2x the blocks) and the same fixed-bandwidth
+    wire. Reports per-arm transfer bytes (int8 payloads + header scales
+    ~0.5x the bf16 bytes), pool capacity in blocks, prefix-HIT TTFT
+    (resubmitting a remote-prefilled prompt loads the pool through the
+    fused dequant — must be no worse than the bf16 pool), greedy token
+    match percentage across arms, and the max chosen-token logprob
+    delta over the matched prefix (the quantization-error bound the
+    differential tests pin)."""
+    from dataclasses import replace
+
+    from dynamo_tpu.disagg import (
+        DisaggConfig,
+        DisaggConfigWatcher,
+        DisaggDecodeEngine,
+        PrefillWorker,
+    )
+    from dynamo_tpu.engine.config import EngineConfig
+    from dynamo_tpu.engine.engine import TpuEngine
+    from dynamo_tpu.kv_transfer import (
+        BlocksetDescriptor,
+        BlockTransferServer,
+        KvCacheLayout,
+        publish_descriptor,
+    )
+    from dynamo_tpu.kv_transfer_metrics import KV_TRANSFER
+    from dynamo_tpu.models import llama
+    from dynamo_tpu.models.config import ModelConfig
+    from dynamo_tpu.parallel.mesh import MeshConfig
+    from dynamo_tpu.protocols.common import (
+        OutputOptions,
+        PreprocessedRequest,
+        StopConditions,
+    )
+    from dynamo_tpu.runtime.component import DistributedRuntime
+    from dynamo_tpu.runtime.store import serve_store
+
+    ps = 16
+    cfg = ModelConfig.tiny(dtype="float32")
+    params = llama.init_params(cfg, 0)
+    # equal-HBM pools: the bf16 arm gets a page budget in bytes; the
+    # int8 arm fits ~2x the pages (+ per-page scale sidecar) in it
+    pages_bf16 = 256
+    page_bytes_bf16 = 2 * cfg.num_layers * cfg.num_kv_heads * ps * cfg.head_dim * 2
+    page_bytes_int8 = (2 * cfg.num_layers * cfg.num_kv_heads * ps * cfg.head_dim
+                       + 2 * cfg.num_layers * 4)  # + f32 scale sidecar
+    budget = pages_bf16 * page_bytes_bf16
+    pages_int8 = budget // page_bytes_int8
+    rng = np.random.RandomState(5)
+    isl = blocks * ps + ps // 2
+    prompts = [rng.randint(1, cfg.vocab_size, isl).tolist()
+               for _ in range(n_requests)]
+    warm_prompt = rng.randint(1, cfg.vocab_size, isl).tolist()
+
+    def req_for(prompt):
+        return PreprocessedRequest(
+            token_ids=list(prompt),
+            stop_conditions=StopConditions(max_tokens=n_new,
+                                           ignore_eos=True),
+            output_options=OutputOptions(logprobs=1),
+        )
+
+    def make_ecfg(wid: str, kv_quant: str) -> "EngineConfig":
+        return EngineConfig(
+            num_pages=int(pages_int8 if kv_quant == "int8" else pages_bf16),
+            page_size=ps, max_pages_per_seq=blocks + 8,
+            max_decode_slots=4, prefill_buckets=(64,),
+            cache_dtype="bfloat16", kv_quant=kv_quant,
+            prefill_chunks_per_round=1,
+            kv_transfer_chunk_pages=chunk_pages,
+            worker_id=wid,
+        )
+
+    server, store = await serve_store(port=0, sweep_interval_s=0.05)
+    port = server.sockets[0].getsockname()[1]
+
+    async def run_arm(kv_quant: str) -> dict:
+        rt = await DistributedRuntime.connect(port=port)
+        ns = f"bench_kvq_{kv_quant}"
+        decode_inner = TpuEngine(
+            cfg, make_ecfg(f"dec_{kv_quant}", kv_quant),
+            params=params, mesh_config=MeshConfig(tp=1),
+        )
+        conf = DisaggConfigWatcher(
+            rt.kv, ns,
+            default=DisaggConfig(max_local_prefill_length=ps,
+                                 max_prefill_queue_size=8),
+        )
+        decode = DisaggDecodeEngine(
+            decode_inner, rt, namespace=ns, worker_id=f"dec_{kv_quant}",
+            conf=conf, prefill_timeout_s=60.0,
+        )
+        srv = BlockTransferServer(
+            read_fn=decode_inner.export_pages,
+            write_fn=decode.guarded_import,
+        )
+        host, sport = await srv.start()
+        relay = _ThrottledRelay(host, sport, bandwidth_mbps * 125_000)
+        rport = await relay.start()
+        await publish_descriptor(rt.kv, ns, BlocksetDescriptor(
+            worker_id=f"dec_{kv_quant}", host="127.0.0.1", port=rport,
+            layout=KvCacheLayout(
+                cfg.num_layers, cfg.num_kv_heads, ps, cfg.head_dim,
+                "int8" if kv_quant == "int8" else "bfloat16",
+            ),
+        ))
+        pre_eng = TpuEngine(
+            cfg, make_ecfg(f"pre_{kv_quant}", kv_quant),
+            params=params, mesh_config=MeshConfig(tp=1),
+        )
+        pworker = await PrefillWorker(
+            rt, pre_eng, namespace=ns, poll_timeout_s=0.2
+        ).start()
+
+        # warmup compiles (prefill, decode, gather/scatter, lp variants)
+        async for _ in decode.generate(req_for(warm_prompt)):
+            pass
+        tx0 = KV_TRANSFER.get("dynamo_kv_transfer_tx_bytes_total")
+        outs, lps = [], []
+        for p in prompts:
+            toks, lp = [], []
+            async for out in decode.generate(req_for(p)):
+                toks.extend(out.token_ids)
+                lp.extend(out.log_probs or [])
+            outs.append(toks)
+            lps.append(lp)
+        tx_bytes = KV_TRANSFER.get("dynamo_kv_transfer_tx_bytes_total") - tx0
+        # prefix-HIT TTFT: the remote-prefilled blocks are committed in
+        # the decode pool; resubmitting loads pool -> ctx (int8: the
+        # fused dequant path) and computes only the tail
+        hit_ttfts = []
+        for p in prompts:
+            t0 = time.monotonic()
+            async for out in decode.generate(req_for(p)):
+                if out.token_ids:
+                    hit_ttfts.append(time.monotonic() - t0)
+                    break
+        stats = {
+            "outs": outs, "lps": lps, "tx_bytes": tx_bytes,
+            "hit_ttft": sorted(hit_ttfts)[len(hit_ttfts) // 2]
+            if hit_ttfts else None,
+            "remote": decode.remote_prefills,
+            "wakeups_saved": pworker.poll_wakeups_saved,
+            "commit_wakeups": pworker.commit_wakeups,
+        }
+        await pworker.stop()
+        await relay.stop()
+        await srv.stop()
+        await conf.stop()
+        await decode.stop()
+        await pre_eng.stop()
+        await rt.close()
+        return stats
+
+    a = await run_arm("int8")
+    b = await run_arm("none")
+    server.close()
+
+    matched = total = 0
+    lp_delta = 0.0
+    for oa, ob, la, lb in zip(a["outs"], b["outs"], a["lps"], b["lps"]):
+        total += max(len(oa), len(ob))
+        matched += sum(x == y for x, y in zip(oa, ob))
+        # logprob delta over the agreeing prefix (past a divergence the
+        # sequences condition on different tokens — not comparable)
+        for i, (x, y) in enumerate(zip(oa, ob)):
+            if x != y:
+                break
+            if i < len(la) and i < len(lb):
+                lp_delta = max(lp_delta, abs(la[i] - lb[i]))
+    return {
+        "kv_quant_tx_bytes_int8": int(a["tx_bytes"]),
+        "kv_quant_tx_bytes_bf16": int(b["tx_bytes"]),
+        "kv_quant_bytes_ratio": round(
+            a["tx_bytes"] / max(b["tx_bytes"], 1), 4),
+        "kv_quant_pool_blocks_int8": int(pages_int8 - 1),
+        "kv_quant_pool_blocks_bf16": int(pages_bf16 - 1),
+        "kv_quant_capacity_ratio": round(
+            (pages_int8 - 1) / (pages_bf16 - 1), 3),
+        "kv_quant_hit_ttft_int8_ms": (
+            round(a["hit_ttft"] * 1e3, 2) if a["hit_ttft"] else None),
+        "kv_quant_hit_ttft_bf16_ms": (
+            round(b["hit_ttft"] * 1e3, 2) if b["hit_ttft"] else None),
+        "kv_quant_token_match_pct": round(100.0 * matched / max(total, 1), 2),
+        "kv_quant_logprob_delta_max": round(lp_delta, 5),
+        "kv_quant_remote_prefills": a["remote"] + b["remote"],
+        "disagg_commit_wakeups": a["commit_wakeups"],
+        "disagg_poll_wakeups_saved": a["wakeups_saved"],
+    }
+
+
 def main():
     out = asyncio.run(routing_experiment())
     out.update(asyncio.run(fault_experiment()))
@@ -629,6 +829,10 @@ def main():
         out.update(asyncio.run(disagg_experiment()))
     except Exception as e:  # noqa: BLE001 — best-effort phase
         out["disagg_error"] = str(e)[:200]
+    try:
+        out.update(asyncio.run(kv_quant_experiment()))
+    except Exception as e:  # noqa: BLE001 — best-effort phase
+        out["kv_quant_error"] = str(e)[:200]
     print(json.dumps(out))
 
 

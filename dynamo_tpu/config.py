@@ -42,6 +42,9 @@ class RuntimeConfig:
     num_pages: int = 512
     max_decode_slots: int = 8
     cache_dtype: str = "bfloat16"
+    # paged-pool KV quantization: "none" | "int8" (int8 pages with
+    # per-block scales across the G1-G4 tiers and the transfer plane)
+    kv_quant: str = "none"
     host_offload_pages: int = 0
     disk_offload_pages: int = 0
     disk_offload_path: Optional[str] = None

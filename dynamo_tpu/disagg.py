@@ -155,10 +155,15 @@ class PrefillWorker:
     + the DistServe/Mooncake chunk-pipelined KV movement).
 
     The engine commits complete prefix blocks incrementally per prefill
-    chunk (TpuEngine._seal_prefilled); this worker polls the committed
-    prefix length and exports+ships each new run as its own stream frame
-    — so remote-prefill TTFT approaches max(prefill, transfer) instead
-    of prefill + transfer, and host staging is O(chunk). With
+    chunk (TpuEngine._seal_prefilled); this worker subscribes to the
+    engine's COMMIT EVENT (TpuEngine.subscribe_commits — fired when a
+    seal batch's pool copy is dispatched) and exports+ships each new run
+    as its own stream frame — so remote-prefill TTFT approaches
+    max(prefill, transfer) instead of prefill + transfer, and host
+    staging is O(chunk). Engines without the event (mocks) fall back to
+    the legacy fixed-cadence committed-prefix poll; either way
+    ``commit_wakeups``/``timeout_wakeups``/``poll_wakeups_saved`` count
+    how many poll-cadence wakeups the event plane avoided. With
     ``kv_transfer_chunk_pages == 0`` on the engine config, the legacy
     monolithic gather -> one-blob write path is used instead."""
 
@@ -175,10 +180,20 @@ class PrefillWorker:
         self.namespace = namespace
         self.poll_timeout_s = poll_timeout_s
         # cadence of the committed-prefix poll while prefill runs
+        # (fallback when the engine exposes no commit event; also the
+        # unit the saved-wakeup accounting is expressed in)
         self.stream_poll_s = stream_poll_s
         self.jobs_handled = 0
         self.jobs_failed = 0
         self.jobs_expired = 0
+        # commit-event accounting: wakeups driven by the engine's seal
+        # event vs safety-timeout wakeups, and how many fixed-cadence
+        # poll wakeups the event subscription avoided
+        self.commit_wakeups = 0
+        self.timeout_wakeups = 0
+        self.poll_wakeups_saved = 0
+        self._commit_evt: Optional[asyncio.Event] = None
+        self._commit_cb: Optional[Any] = None
         # chunk-pipeline stats (bench disagg phase + tests read these):
         # transfer seconds spent while the prefill forward was STILL
         # computing count as hidden — overlap_ratio = hidden / total
@@ -200,14 +215,65 @@ class PrefillWorker:
         start = getattr(self.engine, "start", None)
         if start is not None:
             start()
+        subscribe = getattr(self.engine, "subscribe_commits", None)
+        if subscribe is not None:
+            # engine-side commit event: the seal flush wakes us exactly
+            # when the committed prefix grew (thread -> loop handoff)
+            loop = asyncio.get_running_loop()
+            evt = asyncio.Event()
+            self._commit_evt = evt
+
+            def _on_commit() -> None:
+                loop.call_soon_threadsafe(evt.set)
+
+            self._commit_cb = _on_commit
+            subscribe(_on_commit)
         self._task = asyncio.get_running_loop().create_task(self._loop())
         return self
 
     async def stop(self) -> None:
         self._stopping = True
+        if self._commit_cb is not None:
+            unsub = getattr(self.engine, "unsubscribe_commits", None)
+            if unsub is not None:
+                unsub(self._commit_cb)
+            self._commit_cb = None
         if self._task is not None:
             self._task.cancel()
             self._task = None
+
+    async def _wait_progress(self, gen_task, pending_task) -> None:
+        """Park until the committed prefix may have grown: the engine's
+        commit event when subscribed (plus the prefill/export tasks and
+        a safety timeout — a commit fired between waits stays latched in
+        the Event), else the legacy fixed-cadence sleep. Counts how many
+        fixed-cadence wakeups the event plane saved."""
+        if self._commit_evt is None:
+            await asyncio.sleep(self.stream_poll_s)
+            return
+        t0 = time.monotonic()
+        evt_task = asyncio.ensure_future(self._commit_evt.wait())
+        wait_set = {evt_task}
+        for t in (gen_task, pending_task):
+            if t is not None and not t.done():
+                wait_set.add(t)
+        done, _ = await asyncio.wait(
+            wait_set, timeout=max(self.stream_poll_s * 25, 0.05),
+            return_when=asyncio.FIRST_COMPLETED,
+        )
+        if evt_task in done:
+            self.commit_wakeups += 1
+            self._commit_evt.clear()
+        else:
+            # leave the latch alone: a commit that fired while we woke
+            # for a task completion must wake the NEXT wait immediately
+            evt_task.cancel()
+            if not done:
+                self.timeout_wakeups += 1
+        waited = time.monotonic() - t0
+        self.poll_wakeups_saved += max(
+            0, int(waited / self.stream_poll_s) - 1
+        )
 
     async def _loop(self) -> None:
         queue = prefill_queue_name(self.namespace)
@@ -434,7 +500,9 @@ class PrefillWorker:
                 if pending is None and (evicted
                                         or (prefill_done and avail <= sent)):
                     break
-                await asyncio.sleep(self.stream_poll_s)
+                await self._wait_progress(
+                    gen_task, pending[3] if pending is not None else None
+                )
             if sent <= first:
                 raise RuntimeError("prefilled blocks evicted before export")
             # wire-time accounting fix: write_chunk's drain() returns

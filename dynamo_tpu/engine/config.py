@@ -172,6 +172,15 @@ class EngineConfig:
 
     # model memory
     cache_dtype: str = "bfloat16"
+    # paged-pool KV quantization: "none" (pool stores cache_dtype, the
+    # legacy A/B path) | "int8" (pool stores int8 pages with
+    # per-block-per-layer absmax scales; quantize fuses into the
+    # seal_blocks ctx->pool gather, dequantize into the load_ctx_pages
+    # admission copy — the hot decode path stays cache_dtype). Halves
+    # pool HBM residency, G2/G3 tier footprint, and the payload bytes of
+    # every disagg/G4/offload transfer; greedy outputs stay >=99%
+    # token-identical on the differential harness (tests/test_kv_quant).
+    kv_quant: str = "none"
 
     # identity on the control plane
     worker_id: str = ""

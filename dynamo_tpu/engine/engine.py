@@ -61,6 +61,7 @@ from dynamo_tpu.engine.cache import PageAllocator
 from dynamo_tpu.engine.config import EngineConfig, pow2_cover  # noqa: F401
 # (pow2_cover re-exported: engine.engine was its historical home)
 from dynamo_tpu.engine import sampling
+from dynamo_tpu.kv_quant import KV_QUANT, QuantizedPages, to_pool_dtype
 from dynamo_tpu.kv_router.protocols import (
     ForwardPassMetrics,
     KvCacheEvent,
@@ -201,9 +202,10 @@ class _Entry:
     # offload: hashes/parents aligned with the gathered pages
     hashes: list[int] = field(default_factory=list)
     parents: list[int] = field(default_factory=list)
-    # logprobs: stacked (chosen [F,B], top_ids [F,B,K], top_lps [F,B,K])
-    # for rounds, or the single-step tuple for "first" entries
-    lp_handle: Optional[tuple] = None
+    # logprobs: ONE packed f32 handle — [F, B, 1+2K] for rounds,
+    # [1, 1+2K] for "first" entries (chosen | top ids as f32 | top lps;
+    # see _build_jits.pack_lp / _unpack_lp)
+    lp_handle: Optional[Any] = None
     # spec verify: (slot, request, history-length-at-dispatch) per live
     # row, aligned with the leading rows of the fetched arrays
     rows: list[tuple] = field(default_factory=list)
@@ -231,7 +233,8 @@ class _ExportStream:
     inflight: int
     out_q: queue_mod.Queue
     pos: int = 0                      # next page index to gather
-    # (n_real_pages, device handle) per dispatched, unconsumed chunk
+    # (n_real_pages, data handle, scales handle|None) per dispatched,
+    # unconsumed chunk
     pending: deque = field(default_factory=deque)
     # hash-addressed exports pin their matched refs until every gather
     # is dispatched (device order then protects the reads)
@@ -289,6 +292,10 @@ class TpuEngine:
 
         c, e = self.config, self.ecfg
         cache_dtype = jnp.dtype(e.cache_dtype)
+        # int8 KV-block economy: the paged pool (and every tier/transfer
+        # consumer downstream of it) stores int8 pages + per-block
+        # scales; the serving ctx region stays cache_dtype
+        self.kv_quant = e.kv_quant == "int8"
         p_sh = llama.param_shardings(c, self.mesh)
         if params is None:
             params = llama.init_params(c, rng_seed)
@@ -297,8 +304,9 @@ class TpuEngine:
         # admission prefixes copied out — models/llama.py module doc)
         self.cache = jax.tree.map(
             lambda x, s: jax.device_put(x, s),
-            llama.init_cache(c, e.num_pages, e.page_size, cache_dtype),
-            llama.cache_shardings(c, self.mesh),
+            llama.init_cache(c, e.num_pages, e.page_size, cache_dtype,
+                             kv_quant=e.kv_quant),
+            llama.cache_shardings(c, self.mesh, kv_quant=e.kv_quant),
         )
         # contiguous per-slot serving context (+1 scratch lane for freed
         # slots' in-flight garbage steps)
@@ -342,14 +350,20 @@ class TpuEngine:
             page_shape = (
                 2, c.num_layers, c.num_kv_heads, e.page_size, c.head_dim
             )
+            # tiers store what the pool stores: int8 pages + per-page
+            # scale sidecars under kv_quant, so G2/G3 hold ~2x the
+            # blocks per byte too
+            tier_dtype = np.int8 if self.kv_quant else cache_dtype
+            scale_shape = (2, c.num_layers) if self.kv_quant else ()
             spill = None
             if e.disk_offload_pages > 0:
                 spill = DiskOffloadTier(
-                    e.disk_offload_pages, page_shape, cache_dtype,
-                    path=e.disk_offload_path,
+                    e.disk_offload_pages, page_shape, tier_dtype,
+                    path=e.disk_offload_path, scale_shape=scale_shape,
                 )
             self.offload = HostOffloadTier(
-                e.host_offload_pages, page_shape, cache_dtype, spill=spill,
+                e.host_offload_pages, page_shape, tier_dtype, spill=spill,
+                scale_shape=scale_shape,
             )
             self.allocator.on_park = (
                 lambda p, h, par: self._offload_cands.append((p, h, par))
@@ -455,6 +469,28 @@ class TpuEngine:
         self.tokens_generated = 0
         self.sp_prefills = 0
         self.batch_prefills = 0     # batched-prefill dispatches (K >= 2)
+        # dispatch-budget accounting (tools/profile_round.py
+        # --dispatch-budget, the bench dispatches_per_round field, and
+        # the tier-1 regression pin): every host->device program launch
+        # or async D2H fetch initiation increments its bucket
+        self.dispatch_counts: dict[str, int] = {
+            "round": 0, "round_seal": 0, "seal": 0, "patch": 0,
+            "prefill": 0, "prefill_batch": 0, "sp_prefill": 0,
+            "load_ctx": 0, "sample_first": 0, "fetch": 0,
+            "offload_gather": 0, "xfer_gather": 0, "xfer_scatter": 0,
+            # speculative path: the fused batch-draft and verify
+            # programs (the legacy PER-SLOT draft loop's dispatches are
+            # accounted by spec.stats()['spec_draft_dispatch_total'])
+            "spec_draft": 0, "spec_verify": 0,
+        }
+        # prefix-commit event plane: subscribers (the disagg streaming
+        # export, offload candidacy, future replication) are notified
+        # when a seal batch's pool copy is DISPATCHED — exporting after
+        # the callback is device-order safe — instead of polling the
+        # allocator on a fixed cadence (the PR 5 2 ms poll)
+        self._commit_cbs: list[Callable[[], None]] = []
+        self._commit_lock = threading.Lock()
+        self._last_metrics_pub = 0.0
 
     # ------------------------------------------------------------------
     # jitted programs
@@ -463,13 +499,32 @@ class TpuEngine:
         c, e = self.config, self.ecfg
         max_top_k = e.max_top_k
         max_context = e.max_context
+        # fused-seal width: ONE static shape so the fused round program
+        # compiles exactly once per (n_steps, lp, sample) combo — a
+        # pow2-per-batch width would compile the whole round program per
+        # width bucket (measured +40% on the CPU test suite). Sized for
+        # a full aligned burst (every slot completing blocks the same
+        # round); larger admission-time bursts overflow to the
+        # standalone seal_blocks path.
+        self._seal_fuse_w = pow2_cover(max(
+            e.max_decode_slots,
+            e.max_decode_slots * e.flush_every // max(e.page_size, 1),
+            1,
+        ))
 
         max_logprobs = e.max_logprobs
 
-        @functools.partial(jax.jit, donate_argnums=(1, 2, 3),
-                           static_argnums=(4, 5, 6))
-        def engine_round(params, ctx_kv, ring, dev, n_steps, want_lp,
-                         want_sample):
+        def pack_lp(chosen, ids, lps):
+            """One f32 row [..., 1+2K] per step: chosen logprob, top ids
+            (exact in f32 — vocab << 2^24), top logprobs. Packing means
+            ONE stacked fetch per lp round instead of three separate
+            copy_to_host_async pipelines (the dispatch diet)."""
+            return jnp.concatenate(
+                [chosen[..., None], ids.astype(jnp.float32), lps], axis=-1
+            )
+
+        def round_body(params, ctx_kv, ring, dev, n_steps, want_lp,
+                       want_sample):
             """A FULL scheduling round in one program: n_steps fused
             decode+sample steps via lax.fori_loop (body compiles once) and
             the ring->ctx flush — one dispatch + one result fetch per
@@ -484,9 +539,7 @@ class TpuEngine:
             ring_base = jnp.maximum(dev["ctx"] - 1, 0)
             toks_out = jnp.zeros((n_steps, B), jnp.int32)
             lp_out = (
-                (jnp.zeros((n_steps, B), jnp.float32),
-                 jnp.zeros((n_steps, B, max_logprobs), jnp.int32),
-                 jnp.zeros((n_steps, B, max_logprobs), jnp.float32))
+                jnp.zeros((n_steps, B, 1 + 2 * max_logprobs), jnp.float32)
                 if want_lp else None
             )
             sp = sampling.SamplingParams(
@@ -522,13 +575,8 @@ class TpuEngine:
                     chosen, ids, lps = sampling.compute_logprobs(
                         logits, toks, max_logprobs
                     )
-                    lp_out = (
-                        jax.lax.dynamic_update_index_in_dim(
-                            lp_out[0], chosen, s, 0),
-                        jax.lax.dynamic_update_index_in_dim(
-                            lp_out[1], ids, s, 0),
-                        jax.lax.dynamic_update_index_in_dim(
-                            lp_out[2], lps, s, 0),
+                    lp_out = jax.lax.dynamic_update_index_in_dim(
+                        lp_out, pack_lp(chosen, ids, lps), s, 0
                     )
                 dev = dict(
                     dev,
@@ -550,14 +598,40 @@ class TpuEngine:
             )
             return ctx_kv, ring, dev, toks_out, lp_out
 
+        engine_round = functools.partial(
+            jax.jit, donate_argnums=(1, 2, 3), static_argnums=(4, 5, 6)
+        )(round_body)
+
+        @functools.partial(jax.jit, donate_argnums=(1, 2, 3, 4),
+                           static_argnums=(8, 9, 10))
+        def engine_round_seal(params, ctx_kv, ring, dev, cache,
+                              seal_slots, seal_starts, seal_pages,
+                              n_steps, want_lp, want_sample):
+            """engine_round with the round's pending ctx->pool seal batch
+            FUSED onto the tail — in steady decode a block completes
+            nearly every round, so the previously separate seal_blocks
+            program was a per-round straggler dispatch. The seal runs
+            after the flush and reads positions written by already-
+            dispatched programs (the host only queues a seal for
+            positions whose results it has processed, which lag the
+            dispatch front by at least a round)."""
+            ctx_kv, ring, dev, toks_out, lp_out = round_body(
+                params, ctx_kv, ring, dev, n_steps, want_lp, want_sample
+            )
+            cache = llama.seal_blocks_impl(
+                cache, ctx_kv, seal_slots, seal_starts, seal_pages,
+                e.page_size,
+            )
+            return ctx_kv, ring, dev, cache, toks_out, lp_out
+
         @functools.partial(jax.jit, donate_argnums=(0,))
-        def patch(
-            dev, clear_mask,
-            admit_slot, admit_ctx, admit_tok, admit_keys,
-            admit_temp, admit_top_k, admit_top_p,
-            admit_freq, admit_pres, admit_rep,
-            admit_counts,
-        ):
+        def patch(dev, clear_mask, admit_meta, admit_tok, admit_keys,
+                  admit_counts):
+            """State patch (releases + one admission). ``admit_meta`` is
+            ONE packed f32[8] row — [slot, ctx, temp, top_k, top_p, freq,
+            pres, rep] — instead of ten scalar device_puts per admission
+            (every int here is exact in f32; ctx < 2^24). slot == B is
+            the no-admission sentinel: every .at[] update is dropped."""
             B = dev["tokens"].shape[0]
             dev = dict(dev)
             dev["ctx"] = jnp.where(clear_mask, 1, dev["ctx"])
@@ -569,21 +643,22 @@ class TpuEngine:
             dev["dest"] = jnp.where(
                 clear_mask, B, dev["dest"]
             ).astype(jnp.int32)
-            # single admission (admit_slot == B sentinel -> all .at[] dropped)
-            s = admit_slot
+            s = admit_meta[0].astype(jnp.int32)
             dev["tokens"] = dev["tokens"].at[s].set(admit_tok[0])
-            dev["ctx"] = dev["ctx"].at[s].set(admit_ctx)
-            dev["dest"] = dev["dest"].at[s].set(admit_slot)
+            dev["ctx"] = dev["ctx"].at[s].set(admit_meta[1].astype(jnp.int32))
+            dev["dest"] = dev["dest"].at[s].set(s)
             dev["keys"] = dev["keys"].at[s].set(admit_keys)
             # fresh admissions pass the cached zero row; a penalized slot
             # despeculating back to the fused round restores its histogram
             dev["counts"] = dev["counts"].at[s].set(admit_counts)
-            dev["temp"] = dev["temp"].at[s].set(admit_temp)
-            dev["top_k"] = dev["top_k"].at[s].set(admit_top_k)
-            dev["top_p"] = dev["top_p"].at[s].set(admit_top_p)
-            dev["freq"] = dev["freq"].at[s].set(admit_freq)
-            dev["pres"] = dev["pres"].at[s].set(admit_pres)
-            dev["rep"] = dev["rep"].at[s].set(admit_rep)
+            dev["temp"] = dev["temp"].at[s].set(admit_meta[2])
+            dev["top_k"] = dev["top_k"].at[s].set(
+                admit_meta[3].astype(jnp.int32)
+            )
+            dev["top_p"] = dev["top_p"].at[s].set(admit_meta[4])
+            dev["freq"] = dev["freq"].at[s].set(admit_meta[5])
+            dev["pres"] = dev["pres"].at[s].set(admit_meta[6])
+            dev["rep"] = dev["rep"].at[s].set(admit_meta[7])
             return dev
 
         @functools.partial(jax.jit, static_argnums=(5, 6))
@@ -597,16 +672,23 @@ class TpuEngine:
                 repetition_penalty=jnp.ones(1),
             )
             toks, _ = sampling.sample_step_impl(logits[None], st, sp, max_top_k)
-            lp = (sampling.compute_logprobs(logits[None], toks, max_logprobs)
+            lp = (pack_lp(*sampling.compute_logprobs(
+                      logits[None], toks, max_logprobs))
                   if want_lp else None)
-            return toks, lp  # [1] i32, optional ([1], [1,K], [1,K])
+            return toks, lp  # [1] i32, optional packed [1, 1+2K] f32
 
         self._engine_round = engine_round
+        self._engine_round_seal = engine_round_seal
         self._patch = patch
         self._sample_first = sample_first
         # reusable zero counts row for ordinary admissions (no per-patch
-        # [V]-sized H2D upload)
+        # [V]-sized H2D upload) + the no-admission token placeholder
         self._zero_counts = jnp.zeros(c.vocab_size, jnp.int32)
+        self._zero_tok = jnp.zeros(1, jnp.int32)
+        # cached all-scratch dummy seal batch: seal-less rounds reuse it
+        # so the fused round costs ZERO extra H2D uploads
+        z = jnp.zeros(self._seal_fuse_w, jnp.int32)
+        self._zero_seal = (z, z, z)
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -641,6 +723,33 @@ class TpuEngine:
 
     def drained(self) -> bool:
         return self._drained_evt.is_set()
+
+    # ---- prefix-commit event plane ----
+
+    def subscribe_commits(self, cb: Callable[[], None]) -> None:
+        """Register a callback fired (from the engine thread) whenever a
+        batch of sealed blocks' pool copies has been DISPATCHED — the
+        committed prefix grew and exporting it is device-order safe.
+        Replaces fixed-cadence allocator polling for streaming export /
+        offload candidacy / replication consumers; callbacks must be
+        cheap and non-blocking (bounce to your own loop/queue)."""
+        with self._commit_lock:
+            if cb not in self._commit_cbs:
+                self._commit_cbs.append(cb)
+
+    def unsubscribe_commits(self, cb: Callable[[], None]) -> None:
+        with self._commit_lock:
+            if cb in self._commit_cbs:
+                self._commit_cbs.remove(cb)
+
+    def _notify_commits(self) -> None:
+        with self._commit_lock:
+            cbs = list(self._commit_cbs)
+        for cb in cbs:
+            try:
+                cb()
+            except Exception:  # noqa: BLE001 — never kill the loop
+                log.exception("commit listener failed")
 
     # ------------------------------------------------------------------
     # AsyncEngine surface
@@ -732,20 +841,55 @@ class TpuEngine:
     # page 0 (garbage by contract)
 
     def _gather_padded(self, pages: list[int]):
-        """Device gather of whole pages; returns the DEVICE array
-        [2, L, kvh, pow2(n), ps, hd] — callers slice [:len(pages)] on the
-        page axis after fetching."""
+        """Device gather of whole pages; returns DEVICE arrays
+        ``(data [2, L, kvh, pow2(n), ps, hd], scales|None)`` — callers
+        slice [:len(pages)] on the page axis after fetching. Quantized
+        pools return the int8 payload plus its [2, L, pow2(n)] scale
+        sidecar."""
         w = pow2_cover(len(pages))
         padded = np.zeros(w, np.int32)
         padded[: len(pages)] = pages
-        return llama.gather_pages(self.cache, jnp.asarray(padded))
+        if self.kv_quant:
+            return llama.gather_pages_q(self.cache, jnp.asarray(padded))
+        return llama.gather_pages(self.cache, jnp.asarray(padded)), None
 
-    def _scatter_padded(self, pages: list[int], data: np.ndarray) -> None:
-        """Scatter host pages [2, L, kvh, n, ps, hd] into the pool."""
+    def _host_pages(self, data_h, scales_h, n: int):
+        """Fetch a padded device gather to host and trim the padding:
+        a QuantizedPages bundle for int8 pools, a dense array else."""
+        data = np.asarray(data_h)[:, :, :, :n]
+        if scales_h is None:
+            return data
+        return QuantizedPages(data, np.asarray(scales_h)[:, :, :n])
+
+    def _scatter_padded(self, pages: list[int], data) -> None:
+        """Scatter host pages [2, L, kvh, n, ps, hd] into the pool.
+        ``data`` may be a dense array or a QuantizedPages bundle; either
+        is converted to what THIS pool stores at the boundary (a bf16
+        peer's push quantizes on the way in; an int8 bundle landing in a
+        bf16 pool dequantizes)."""
+        data = to_pool_dtype(
+            data, self.kv_quant, np.dtype(self.cache["k"].dtype)
+        )
         n = len(pages)
         w = pow2_cover(n)
         padded = np.zeros(w, np.int32)
         padded[:n] = pages
+        self.dispatch_counts["xfer_scatter"] += 1
+        if self.kv_quant:
+            d, s = data.data, data.scales
+            if w > n:
+                d = np.concatenate(
+                    [d, np.zeros(d.shape[:3] + (w - n,) + d.shape[4:],
+                                 d.dtype)], axis=3,
+                )
+                s = np.concatenate(
+                    [s, np.zeros(s.shape[:2] + (w - n,), s.dtype)], axis=2,
+                )
+            self.cache = llama.scatter_pages_q(
+                self.cache, jnp.asarray(padded),
+                jnp.asarray(d), jnp.asarray(s),
+            )
+            return
         if w > n:
             pad_shape = list(data.shape)
             pad_shape[3] = w - n
@@ -761,9 +905,11 @@ class TpuEngine:
     # kv_transfer.py BlockTransferServer read_fn/write_fn)
 
     def export_pages(self, page_ids: list[int]) -> np.ndarray:
-        """Gather whole pages to host: [2, L, kvh, n, ps, hd]. Thread-safe —
-        blocks the CALLER until the engine loop services it at a round
-        boundary (device-order safe w.r.t. in-flight steps)."""
+        """Gather whole pages to host: [2, L, kvh, n, ps, hd] (a
+        kv_quant.QuantizedPages bundle — int8 + scales — for quantized
+        pools). Thread-safe — blocks the CALLER until the engine loop
+        services it at a round boundary (device-order safe w.r.t.
+        in-flight steps)."""
         return self._xfer_op("export", page_ids, None)
 
     def import_pages(self, page_ids: list[int], data: np.ndarray) -> None:
@@ -869,6 +1015,9 @@ class TpuEngine:
         drain)."""
         if not self._xfer_streams:
             return False
+        if self._seal_queue:
+            # stream gathers read the pool: queued seal copies first
+            self._flush_seals()
         now = time.monotonic()
         keep: list[_ExportStream] = []
         progressed = False
@@ -911,19 +1060,26 @@ class TpuEngine:
     def _advance_stream(self, st: _ExportStream) -> bool:
         progressed = False
         # convert ready heads — bounded by consumer pull so a stalled
-        # peer can't grow unbounded host staging
+        # peer can't grow unbounded host staging (BOTH handles must be
+        # ready: np.asarray on a pending scale copy would block the loop)
         while (st.pending and st.pending[0][1].is_ready()
+               and (st.pending[0][2] is None
+                    or st.pending[0][2].is_ready())
                and st.out_q.qsize() < st.inflight):
-            n, handle = st.pending.popleft()
-            st.out_q.put(np.asarray(handle)[:, :, :, :n])
+            n, handle, scales_h = st.pending.popleft()
+            st.out_q.put(self._host_pages(handle, scales_h, n))
             progressed = True
         # dispatch the next gathers (async D2H behind compute)
         while (st.pos < len(st.ids) and len(st.pending) < st.inflight
                and st.out_q.qsize() < st.inflight):
             chunk = st.ids[st.pos: st.pos + st.chunk_pages]
-            out = self._gather_padded(chunk)
+            self.dispatch_counts["xfer_gather"] += 1
+            out, scales = self._gather_padded(chunk)
             out.copy_to_host_async()
-            st.pending.append((len(chunk), out))
+            self.dispatch_counts["fetch"] += 1
+            if scales is not None:
+                scales.copy_to_host_async()
+            st.pending.append((len(chunk), out, scales))
             st.pos += len(chunk)
             progressed = True
         if st.pos >= len(st.ids) and st.free_pages is not None:
@@ -972,10 +1128,18 @@ class TpuEngine:
                 kind, ids, data, done, box = self._xfer.get_nowait()
             except queue_mod.Empty:
                 return
+            if kind != "import" and self._seal_queue:
+                # pool reads (exports, hash matches, clears) must see
+                # queued seal copies dispatched first — commits are
+                # matchable the moment _queue_seal runs, but with seals
+                # riding the fused round their device copy may still be
+                # pending this round
+                self._flush_seals()
             try:
                 if kind == "export":
-                    out = self._gather_padded(ids)
-                    box["result"] = np.asarray(out)[:, :, :, : len(ids)]
+                    self.dispatch_counts["xfer_gather"] += 1
+                    out, scales = self._gather_padded(ids)
+                    box["result"] = self._host_pages(out, scales, len(ids))
                 elif kind == "export_stream":
                     chunk_pages, inflight, out_q = data
                     self._xfer_streams.append(_ExportStream(
@@ -1004,8 +1168,9 @@ class TpuEngine:
                     if not pages:
                         box["result"] = (0, None)
                     else:
-                        out = self._gather_padded(pages)
-                        data = np.asarray(out)[:, :, :, : len(pages)]
+                        self.dispatch_counts["xfer_gather"] += 1
+                        out, scales = self._gather_padded(pages)
+                        data = self._host_pages(out, scales, len(pages))
                         self.allocator.free(pages)
                         box["result"] = (len(pages), data)
                 elif kind == "clear":
@@ -1100,6 +1265,9 @@ class TpuEngine:
         # process-level overload gauges (all three scrape surfaces)
         OVERLOAD.set("dynamo_overload_queue_depth", num_waiting)
         OVERLOAD.set("dynamo_overload_queue_tokens", waiting_tokens)
+        # pool capacity in blocks: the kv_quant=int8 headline — the same
+        # HBM budget holds ~2x the blocks of a bf16 pool
+        KV_QUANT.set("dynamo_kv_pool_capacity_blocks", a.total_pages)
         return ForwardPassMetrics(
             worker_id=self.ecfg.worker_id,
             worker_stats=WorkerStats(
@@ -1225,7 +1393,10 @@ class TpuEngine:
         self._enforce_bounds()
         rounds_in_flight = sum(1 for en in self._entries if en.kind == "round")
         self._process_entries(block=rounds_in_flight > e.max_inflight_rounds)
-        self._flush_seals()
+        # seals queued by result processing are NOT flushed here: they
+        # ride this round's fused dispatch (_dispatch_round). Pool
+        # readers below (transfers, streams, offload, prefill_begin)
+        # flush standalone first themselves.
         self._apply_releases()
         self._process_transfers()
         stream_work = self._service_export_streams()
@@ -1250,8 +1421,19 @@ class TpuEngine:
             did_work = dispatched = True
         if self.spec is not None and self._dispatch_spec():
             did_work = dispatched = True
+        if self._seal_queue:
+            # no round rode them this time (pipeline full / all-spec):
+            # dispatch standalone rather than letting commits sit
+            self._flush_seals()
+            did_work = True
         if self.on_metrics is not None:
-            self.on_metrics(self.metrics())
+            # publish at the subscriber cadence, not once per round —
+            # building ForwardPassMetrics every round was measurable
+            # host tax and the pub/sub plane throttles to ~4 Hz anyway
+            now = time.monotonic()
+            if now - self._last_metrics_pub >= 0.1:
+                self._last_metrics_pub = now
+                self.on_metrics(self.metrics())
         if (not dispatched and self._entries
                 and self._intake.empty() and not self._waiting):
             # nothing to overlap with the in-flight fetches (e.g. every
@@ -1427,19 +1609,53 @@ class TpuEngine:
                     or (so.repetition_penalty or 1.0) != 1.0)
 
         want_sample = any(needs_sampler(i) for i in active)
+        # the round's pending seal batch rides the SAME program (the
+        # dispatch diet: in steady decode a block completes nearly every
+        # round, and the separate seal_blocks program was a per-round
+        # straggler dispatch). Fixed width = one compiled variant;
+        # admission-burst overflow drains via the standalone flush at
+        # the end of _round.
+        seal = self._take_seal_batch(width=self._seal_fuse_w)
         if self.on_dispatch is not None:
-            self.on_dispatch("round", {
+            # followers must replay the identical (fused) program, so
+            # the seal arrays always travel — zeros for seal-less rounds
+            w = self._seal_fuse_w
+            payload = {
                 "n_steps": n, "want_lp": want_lp,
                 "want_sample": want_sample,
-            })
-        # one fused program: n decode+sample steps + flush (engine_round)
+                "seal": ({
+                    "slots": seal[0].tolist(),
+                    "starts": seal[1].tolist(),
+                    "pages": seal[2].tolist(),
+                } if seal is not None else {
+                    "slots": [0] * w, "starts": [0] * w,
+                    "pages": [0] * w,
+                }),
+            }
+            self.on_dispatch("round", payload)
+        # one fused program: n decode+sample steps + flush + seal. The
+        # SAME program runs whether the round has seals or not (seal-
+        # less rounds pass the cached all-scratch dummy batch — page 0
+        # is garbage by contract) — one compiled variant per engine, not
+        # one per seal-width plus a plain variant, which is what keeps
+        # the fusion free at compile time too.
         t_disp = time.monotonic()
-        self.ctx, self.ring, self._dev, stacked, lp_stacked = (
-            self._engine_round(
-                self.params, self.ctx, self.ring, self._dev, n,
-                want_lp, want_sample,
-            )
+        if seal is not None:
+            self.dispatch_counts["round_seal"] += 1
+            seal_dev = (jnp.asarray(seal[0]), jnp.asarray(seal[1]),
+                        jnp.asarray(seal[2]))
+        else:
+            self.dispatch_counts["round"] += 1
+            seal_dev = self._zero_seal
+        (self.ctx, self.ring, self._dev, self.cache, stacked,
+         lp_stacked) = self._engine_round_seal(
+            self.params, self.ctx, self.ring, self._dev, self.cache,
+            *seal_dev, n, want_lp, want_sample,
         )
+        if seal is not None:
+            if self.kv_quant:
+                KV_QUANT.inc("dynamo_kv_quant_pages_total", seal[3])
+            self._notify_commits()
         self.flight.record(
             "round", slots=list(active), n_steps=n,
             spec_slots=[
@@ -1455,9 +1671,11 @@ class TpuEngine:
         )
         self.step_count += n
         stacked.copy_to_host_async()
+        self.dispatch_counts["fetch"] += 1
         if lp_stacked is not None:
-            for arr in lp_stacked:
-                arr.copy_to_host_async()
+            # packed: ONE extra fetch pipeline, not three
+            lp_stacked.copy_to_host_async()
+            self.dispatch_counts["fetch"] += 1
         self._entries.append(
             _Entry(
                 kind="round",
@@ -1498,19 +1716,20 @@ class TpuEngine:
             clear[s] = True
         a = admit or {}
         counts = a.get("counts")
+        # one packed f32 row instead of ten scalar uploads (the patch
+        # jit unpacks; see _build_jits.patch)
+        meta = np.array([
+            a.get("slot", B), a.get("ctx", 1),
+            a.get("temp", 0.0), a.get("top_k", 0), a.get("top_p", 1.0),
+            a.get("freq", 0.0), a.get("pres", 0.0), a.get("rep", 1.0),
+        ], np.float32)
+        self.dispatch_counts["patch"] += 1
         self._dev = self._patch(
             self._dev,
             jnp.asarray(clear),
-            jnp.int32(a.get("slot", B)),
-            jnp.int32(a.get("ctx", 1)),
-            a.get("tok", jnp.zeros(1, jnp.int32)),
+            jnp.asarray(meta),
+            a.get("tok", self._zero_tok),
             jnp.asarray(a.get("keys", np.zeros(2, np.uint32))),
-            jnp.float32(a.get("temp", 0.0)),
-            jnp.int32(a.get("top_k", 0)),
-            jnp.float32(a.get("top_p", 1.0)),
-            jnp.float32(a.get("freq", 0.0)),
-            jnp.float32(a.get("pres", 0.0)),
-            jnp.float32(a.get("rep", 1.0)),
             self._zero_counts if counts is None
             else jnp.asarray(counts, jnp.int32),
         )
@@ -1599,6 +1818,7 @@ class TpuEngine:
         if self.spec.draft is not None and e.spec_batch_draft:
             # ONE multi-slot multi-token draft program; the [B, K] device
             # result splices into the verify tokens INSIDE the verify jit
+            self.dispatch_counts["spec_draft"] += 1
             drafted = self.spec.propose_batch(
                 [(slot, r.spec_tokens) for slot, r, _, _ in rows], B, K,
             )
@@ -1611,6 +1831,7 @@ class TpuEngine:
                     if drafted is None:
                         drafted = jnp.zeros((B, K), jnp.int32)
                     drafted = drafted.at[j].set(proposal)
+        self.dispatch_counts["spec_verify"] += 1
         self.ctx, out_toks, n_out, new_keys = self.spec.verify(
             self.params, self.ctx, jnp.asarray(toks), drafted, slots_a,
             q_starts, seq_lens, keys, temps, top_ks, top_ps,
@@ -1618,6 +1839,7 @@ class TpuEngine:
         )
         for arr in (out_toks, n_out, new_keys):
             arr.copy_to_host_async()
+            self.dispatch_counts["fetch"] += 1
         self.flight.record(
             "spec_verify", slots=[slot for slot, *_ in rows], k=K,
             dispatch_ms=round((time.monotonic() - t_disp) * 1e3, 3),
@@ -1834,32 +2056,57 @@ class TpuEngine:
             self._queue_seal(r, blk.position, blk.block_hash, blk.parent_hash)
         r.sealed_prefix = max(r.sealed_prefix, done_blocks)
 
-    def _flush_seals(self) -> None:
-        """Dispatch the batched ctx->pool seal copy (pow2-padded; padding
-        rows target scratch page 0). Device order makes this safe: the
-        sealed positions were written by already-dispatched programs, and
-        any admission/offload/export that READS these pool pages is
-        dispatched after this."""
+    def _take_seal_batch(self, width: Optional[int] = None):
+        """Pop + pad the pending seal queue as (slots, starts, pages)
+        int32 arrays (padding rows -> scratch page 0), or None.
+
+        With ``width`` (the fused-round path) at most ``width`` entries
+        are taken and the arrays are padded to EXACTLY that width — one
+        static shape, one compile. Without it (standalone flush) the
+        whole queue is taken at a pow2-bucketed width."""
         if not self._seal_queue:
-            return
-        batch = self._seal_queue
-        self._seal_queue = []
-        w = pow2_cover(len(batch))
+            return None
+        if width is None:
+            batch = self._seal_queue
+            self._seal_queue = []
+            w = pow2_cover(len(batch))
+        else:
+            batch = self._seal_queue[:width]
+            self._seal_queue = self._seal_queue[width:]
+            w = width
         slots = np.zeros(w, np.int32)
         starts = np.zeros(w, np.int32)
         pages = np.zeros(w, np.int32)  # padding -> scratch page 0
         for i, (s, st, pg) in enumerate(batch):
             slots[i], starts[i], pages[i] = s, st, pg
+        return slots, starts, pages, len(batch)
+
+    def _flush_seals(self) -> None:
+        """Dispatch the batched ctx->pool seal copy standalone (pow2-
+        padded). Device order makes this safe: the sealed positions were
+        written by already-dispatched programs, and any admission/
+        offload/export that READS these pool pages is dispatched after
+        this. The steady-decode path doesn't come here — its seals ride
+        the fused round program (_dispatch_round); this covers admission
+        boundaries and rounds that read the pool before dispatching."""
+        batch = self._take_seal_batch()
+        if batch is None:
+            return
+        slots, starts, pages, n_real = batch
         if self.on_dispatch is not None:
             self.on_dispatch("seal", {
                 "slots": slots.tolist(), "starts": starts.tolist(),
                 "pages": pages.tolist(),
             })
+        self.dispatch_counts["seal"] += 1
         self.cache = llama.seal_blocks(
             self.cache, self.ctx,
             jnp.asarray(slots), jnp.asarray(starts), jnp.asarray(pages),
             page_size=self.ecfg.page_size,
         )
+        if self.kv_quant:
+            KV_QUANT.inc("dynamo_kv_quant_pages_total", n_real)
+        self._notify_commits()
 
     # ---- offload (G2 tier) ----
 
@@ -1885,9 +2132,16 @@ class TpuEngine:
             batch.append(cand)
         if not batch:
             return
+        if self._seal_queue:
+            # the gather reads the pool: queued seal copies first
+            self._flush_seals()
         t_disp = time.monotonic()
-        out = self._gather_padded([p for p, _, _ in batch])
+        self.dispatch_counts["offload_gather"] += 1
+        out, scales = self._gather_padded([p for p, _, _ in batch])
         out.copy_to_host_async()
+        self.dispatch_counts["fetch"] += 1
+        if scales is not None:
+            scales.copy_to_host_async()
         self.flight.record(
             "g2_offload", pages=len(batch),
             dispatch_ms=round((time.monotonic() - t_disp) * 1e3, 3),
@@ -1896,6 +2150,7 @@ class TpuEngine:
             kind="offload", handle=out, n_steps=len(batch),
             hashes=[h for _, h, _ in batch],
             parents=[par for _, _, par in batch],
+            aux=scales,
         ))
 
     def _onboard_from_host(
@@ -1918,9 +2173,13 @@ class TpuEngine:
         # uniform chunk width reuses one compiled scatter shape
         cp = self.ecfg.kv_transfer_chunk_pages or len(pages)
         for i in range(0, len(pages), cp):
-            sub = run[i:i + cp]
+            hs = [h for h, _ in run[i:i + cp]]
+            data = self.offload.gather(hs)
+            scales = self.offload.gather_scales(hs)
             self._scatter_padded(
-                pages[i:i + cp], self.offload.gather([h for h, _ in sub])
+                pages[i:i + cp],
+                QuantizedPages(data, scales) if scales is not None
+                else data,
             )
         for pg, (h, parent) in zip(pages, run):
             self.allocator.commit(pg, h, parent)
@@ -1966,10 +2225,15 @@ class TpuEngine:
             nonlocal t_prev
             n = int(arr.shape[3])
             sub = missing[offset:offset + n]
+            # mode boundary: an int8 peer's bundle lands as-is in an
+            # int8 tier; cross-mode payloads convert here
+            payload = to_pool_dtype(arr, self.kv_quant, off.dtype)
+            if not isinstance(payload, QuantizedPages):
+                payload = np.asarray(payload, dtype=off.dtype)
             self._host_ingest.put((
                 [b.block_hash for b in sub],
                 [b.parent_hash for b in sub],
-                np.asarray(arr, dtype=off.dtype),
+                payload,
             ))
             chunk_spans.append(_span_dict(
                 "g4_chunk", t_prev, blocks=n, offset=offset,
@@ -2153,6 +2417,7 @@ class TpuEngine:
                 "seq_lens": seq_lens.tolist(), "ctx_span": ctx_span,
             })
         t_disp = time.monotonic()
+        self.dispatch_counts["prefill_batch"] += 1
         self.ctx, logits = llama.batch_prefill(
             self.config, self.params, self.ctx, jnp.asarray(toks),
             jnp.asarray(slots), jnp.asarray(q_starts),
@@ -2246,6 +2511,7 @@ class TpuEngine:
                 self.on_dispatch("load_ctx", {
                     "slot": slot, "pages": padded.tolist(),
                 })
+            self.dispatch_counts["load_ctx"] += 1
             self.ctx = llama.load_ctx_pages(
                 self.ctx, self.cache, jnp.int32(slot),
                 jnp.asarray(padded),
@@ -2322,6 +2588,7 @@ class TpuEngine:
                 "start": start, "end": start + len(chunk),
             })
         t_disp = time.monotonic()
+        self.dispatch_counts["prefill"] += 1
         self.ctx, logits = llama.prefill(
             self.config, self.params, self.ctx,
             jnp.asarray(toks), jnp.int32(r.slot),
@@ -2368,6 +2635,7 @@ class TpuEngine:
                 "tokens": toks.tolist(), "slot": slot, "n": len(prompt),
             })
         t_disp = time.monotonic()
+        self.dispatch_counts["sp_prefill"] += 1
         kv, logits = llama.sp_prefill(
             self.config, self.params,
             sp_shard(jnp.asarray(toks), self.mesh),
@@ -2423,6 +2691,7 @@ class TpuEngine:
                 "want_lp": want_lp,
                 "index": index,
             })
+        self.dispatch_counts["sample_first"] += 1
         first_tok, first_lp = self._sample_first(
             logits,
             jnp.asarray(first_key),
@@ -2469,9 +2738,10 @@ class TpuEngine:
             )
         # first token reaches the client via the async fetch pipeline
         first_tok.copy_to_host_async()
+        self.dispatch_counts["fetch"] += 1
         if first_lp is not None:
-            for arr in first_lp:
-                arr.copy_to_host_async()
+            first_lp.copy_to_host_async()  # packed: one fetch
+            self.dispatch_counts["fetch"] += 1
         self._entries.append(_Entry(
             kind="first", handle=first_tok, request=r, lp_handle=first_lp
         ))
@@ -2501,6 +2771,13 @@ class TpuEngine:
             self._consume_entry(entry)
             block = False  # only force at most one blocking wait
 
+    def _unpack_lp(self, packed: np.ndarray):
+        """Split one packed logprob row/stack [..., 1+2K] back into
+        (chosen, top_ids, top_lps) — inverse of the jit-side pack_lp."""
+        K = self.ecfg.max_logprobs
+        return (packed[..., 0], packed[..., 1:1 + K].astype(np.int32),
+                packed[..., 1 + K:])
+
     def _consume_entry(self, entry: _Entry) -> None:
         if entry.kind in ("round", "spec") and entry.t_dispatch:
             self._h_round.observe(time.monotonic() - entry.t_dispatch)
@@ -2508,13 +2785,19 @@ class TpuEngine:
         if entry.kind == "first":
             lp = None
             if entry.lp_handle is not None:
-                chosen, ids, lps = (np.asarray(a) for a in entry.lp_handle)
-                lp = (float(chosen[0]), ids[0], lps[0])
+                chosen, ids, lps = self._unpack_lp(
+                    np.asarray(entry.lp_handle)[0]
+                )
+                lp = (float(chosen), ids, lps)
             self._process_first(entry.request, int(data[0]), lp)
         elif entry.kind == "offload":
+            scales = (
+                np.asarray(entry.aux)[:, :, : entry.n_steps]
+                if entry.aux is not None else None
+            )
             self.offload.put_batch(
                 entry.hashes, entry.parents,
-                data[:, :, :, : entry.n_steps],
+                data[:, :, :, : entry.n_steps], scales,
             )
         elif entry.kind == "spec":
             self._process_spec(entry)
@@ -2563,7 +2846,7 @@ class TpuEngine:
         throughput)."""
         lp_arrs = None
         if entry.lp_handle is not None:
-            lp_arrs = tuple(np.asarray(a) for a in entry.lp_handle)
+            lp_arrs = self._unpack_lp(np.asarray(entry.lp_handle))
         for slot, r in enumerate(entry.slots):
             # identity check doubles as the epoch: a recycled slot holds
             # a different _Request object than the snapshot

@@ -221,11 +221,25 @@ class Follower:
         eng = self.engine
         op = cmd["op"]
         if op == "round":
-            out = eng._engine_round(
-                eng.params, eng.ctx, eng.ring, eng._dev,
-                cmd["n_steps"], cmd["want_lp"], cmd["want_sample"],
-            )
-            eng.ctx, eng.ring, eng._dev = out[0], out[1], out[2]
+            seal = cmd.get("seal")
+            if seal:
+                # leader fused the round's seal batch into the program
+                out = eng._engine_round_seal(
+                    eng.params, eng.ctx, eng.ring, eng._dev, eng.cache,
+                    jnp.asarray(np.asarray(seal["slots"], np.int32)),
+                    jnp.asarray(np.asarray(seal["starts"], np.int32)),
+                    jnp.asarray(np.asarray(seal["pages"], np.int32)),
+                    cmd["n_steps"], cmd["want_lp"], cmd["want_sample"],
+                )
+                eng.ctx, eng.ring, eng._dev, eng.cache = (
+                    out[0], out[1], out[2], out[3]
+                )
+            else:
+                out = eng._engine_round(
+                    eng.params, eng.ctx, eng.ring, eng._dev,
+                    cmd["n_steps"], cmd["want_lp"], cmd["want_sample"],
+                )
+                eng.ctx, eng.ring, eng._dev = out[0], out[1], out[2]
         elif op == "patch":
             admit = dict(cmd.get("admit") or {})
             if admit:

@@ -48,12 +48,19 @@ class _PageTier:
     loop) except for read-only counter access. Subclasses provide the
     backing storage via ``_ensure_pool``."""
 
-    def __init__(self, num_pages: int, page_shape: tuple, dtype):
+    def __init__(self, num_pages: int, page_shape: tuple, dtype,
+                 scale_shape: tuple = ()):
         # page_shape = (2, L, kvh, ps, hd)
         self.num_pages = num_pages
         self.page_shape = tuple(page_shape)
         self.dtype = np.dtype(dtype)
         self._pool = None  # lazy: it can be GBs
+        # int8 pools (kv_quant) carry a per-page scale sidecar of this
+        # shape (typically (2, L)); scales are tiny and stay in RAM for
+        # every tier — even the mmap-backed G3 (its file only holds page
+        # payloads; the tier is a cache recreated at engine start)
+        self.scale_shape = tuple(scale_shape)
+        self._scale_pool: Optional[np.ndarray] = None
         # hash -> (slot, parent_hash); insertion order = LRU order
         self._index: "OrderedDict[int, tuple[int, int]]" = OrderedDict()
         self._free: list[int] = list(range(num_pages))
@@ -72,6 +79,13 @@ class _PageTier:
     def _ensure_pool(self) -> np.ndarray:
         raise NotImplementedError
 
+    def _ensure_scales(self) -> np.ndarray:
+        if self._scale_pool is None:
+            self._scale_pool = np.zeros(
+                self.scale_shape + (self.num_pages,), np.float32
+            )
+        return self._scale_pool
+
     def __contains__(self, block_hash: int) -> bool:
         return block_hash in self._index
 
@@ -83,8 +97,10 @@ class _PageTier:
         old_h, (old_slot, _) = self._index.popitem(last=False)
         self._free.append(old_slot)
 
-    def put_one(self, h: int, parent: int, page: np.ndarray) -> bool:
-        """Store one page ([2, L, kvh, ps, hd]); False if already held."""
+    def put_one(self, h: int, parent: int, page: np.ndarray,
+                scale: Optional[np.ndarray] = None) -> bool:
+        """Store one page ([2, L, kvh, ps, hd]); False if already held.
+        ``scale`` ([*scale_shape]) rides along for int8 pools."""
         if h in self._index:
             self._index.move_to_end(h)
             return False
@@ -93,19 +109,30 @@ class _PageTier:
             self._evict_one()
         slot = self._free.pop()
         pool[:, :, :, slot] = page
+        if self.scale_shape:
+            self._ensure_scales()[..., slot] = (
+                scale if scale is not None else 0.0
+            )
         self._index[h] = (slot, parent)
         self.pages_offloaded += 1
         return True
 
     def put_batch(
-        self, hashes: list[int], parents: list[int], data: np.ndarray
+        self, hashes: list[int], parents: list[int], data,
+        scales: Optional[np.ndarray] = None,
     ) -> int:
-        """Store gathered pages (data [2, L, kvh, n, ps, hd], aligned with
-        hashes). Existing entries are refreshed in LRU order. Returns the
-        number of new pages stored."""
+        """Store gathered pages (data [2, L, kvh, n, ps, hd] — or a
+        kv_quant.QuantizedPages bundle — aligned with hashes). Existing
+        entries are refreshed in LRU order. Returns the number of new
+        pages stored."""
+        if scales is None and hasattr(data, "scales"):
+            data, scales = data.data, data.scales
         stored = 0
         for i, (h, parent) in enumerate(zip(hashes, parents)):
-            stored += bool(self.put_one(h, parent, data[:, :, :, i]))
+            stored += bool(self.put_one(
+                h, parent, data[:, :, :, i],
+                scales[..., i] if scales is not None else None,
+            ))
         return stored
 
     def lookup_run(self, hashes: list[int]) -> list[tuple[int, int]]:
@@ -128,10 +155,24 @@ class _PageTier:
         slots = [self._index[h][0] for h in hashes]
         return pool[:, :, :, slots]
 
+    def gather_scales(self, hashes: list[int]) -> Optional[np.ndarray]:
+        """Scale sidecar aligned with ``gather`` ([*scale_shape, n]);
+        None for unquantized tiers."""
+        if not self.scale_shape:
+            return None
+        scales = self._ensure_scales()
+        slots = [self._index[h][0] for h in hashes]
+        return scales[..., slots]
+
     def read_page(self, block_hash: int) -> np.ndarray:
         """One page [2, L, kvh, ps, hd] (must be present)."""
         pool = self._ensure_pool()
         return pool[:, :, :, self._index[block_hash][0]]
+
+    def read_scale(self, block_hash: int) -> Optional[np.ndarray]:
+        if not self.scale_shape:
+            return None
+        return self._ensure_scales()[..., self._index[block_hash][0]]
 
     def drop(self, block_hash: int) -> None:
         ent = self._index.pop(block_hash, None)
@@ -152,8 +193,9 @@ class DiskOffloadTier(_PageTier):
     so spill/onboard never issue synchronous IO on the engine loop."""
 
     def __init__(self, num_pages: int, page_shape: tuple, dtype,
-                 path: Optional[str] = None):
-        super().__init__(num_pages, page_shape, dtype)
+                 path: Optional[str] = None, scale_shape: tuple = ()):
+        super().__init__(num_pages, page_shape, dtype,
+                         scale_shape=scale_shape)
         self.path = path
         self._owns_file = path is None
 
@@ -191,8 +233,10 @@ class HostOffloadTier(_PageTier):
     can be assembled from both tiers (reference offload.rs tier walk)."""
 
     def __init__(self, num_pages: int, page_shape: tuple, dtype,
-                 spill: Optional[_PageTier] = None):
-        super().__init__(num_pages, page_shape, dtype)
+                 spill: Optional[_PageTier] = None,
+                 scale_shape: tuple = ()):
+        super().__init__(num_pages, page_shape, dtype,
+                         scale_shape=scale_shape)
         self.spill = spill
 
     def _ensure_pool(self) -> np.ndarray:
@@ -204,7 +248,9 @@ class HostOffloadTier(_PageTier):
         old_h, (old_slot, old_parent) = self._index.popitem(last=False)
         if self.spill is not None:
             self.spill.put_one(
-                old_h, old_parent, self._ensure_pool()[:, :, :, old_slot]
+                old_h, old_parent, self._ensure_pool()[:, :, :, old_slot],
+                (self._ensure_scales()[..., old_slot]
+                 if self.scale_shape else None),
             )
         self._free.append(old_slot)
 
@@ -236,6 +282,17 @@ class HostOffloadTier(_PageTier):
                 out[:, :, :, i] = self.read_page(h)
             else:
                 out[:, :, :, i] = self.spill.read_page(h)
+        return out
+
+    def gather_scales(self, hashes: list[int]) -> Optional[np.ndarray]:
+        if not self.scale_shape:
+            return None
+        out = np.empty(self.scale_shape + (len(hashes),), np.float32)
+        for i, h in enumerate(hashes):
+            if h in self._index:
+                out[..., i] = self.read_scale(h)
+            else:
+                out[..., i] = self.spill.read_scale(h)
         return out
 
     def clear(self) -> int:

@@ -1,0 +1,167 @@
+"""Int8 KV-block economy: host-side helpers + metrics for the quantized
+paged prefix pool.
+
+With ``kv_quant="int8"`` on EngineConfig the paged pool (G1 prefix-cache
+STORAGE) holds int8 pages with per-block-per-layer absmax scales; the hot
+decode path stays bf16 (the serving ctx region is untouched). The
+quantize happens once, inside the fused ``seal_blocks`` gather (ctx ->
+pool); the dequantize happens once, inside ``load_ctx_pages`` (pool ->
+ctx at admission). Everything DOWNSTREAM of the pool — G2/G3 host/disk
+tiers, disagg pushes, G4 peer fetches, export streams — moves the int8
+bytes plus the small scale sidecar, so a 16 GB chip holds ~2x the
+hittable prefix corpus and every transfer/offload path ships half the
+payload bytes.
+
+This module owns the HOST representation: a page bundle (int8 data +
+f32 scales), host-side quantize/dequantize for tier/mode boundaries
+(a bf16 peer pushing into an int8 pool, or vice versa), the wire-header
+encoding (scales ride the JSON header of the existing two-part frames —
+they are ~1/(2*kvh*ps*hd) of the payload), and the ``dynamo_kv_quant_*``
+metric families rendered on all three scrape surfaces.
+
+Device-side quantize/dequantize lives in models/llama.py
+(seal_blocks/load_ctx_pages/gather_pages_q/scatter_pages_q) — fused into
+the existing pool-boundary programs, never a separate dispatch.
+"""
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Any, Optional
+
+import numpy as np
+
+from dynamo_tpu.telemetry.metrics import CounterRegistry
+
+# scale floor: a block of exact zeros must not divide by zero, and the
+# floor must be far below any real bf16 activation scale
+SCALE_EPS = 1e-8
+
+FAMILIES: tuple[tuple[str, str, str], ...] = (
+    ("dynamo_kv_quant_pages_total", "counter",
+     "KV pages quantized to int8 at a pool/transfer boundary"),
+    ("dynamo_kv_quant_dequant_pages_total", "counter",
+     "int8 KV pages dequantized back to the compute dtype"),
+    ("dynamo_kv_quant_scale_bytes_total", "counter",
+     "bytes of per-block scale sidecars shipped alongside int8 pages"),
+    ("dynamo_kv_pool_capacity_blocks", "gauge",
+     "paged prefix-pool capacity in blocks (usable pages; int8 pools "
+     "fit ~2x the blocks of a bf16 pool in the same HBM)"),
+)
+
+_HISTOGRAMS: tuple[tuple[str, str], ...] = (
+    ("dynamo_kv_quant_dequant_seconds",
+     "wall time of one host-side dequantize (tier/mode boundary "
+     "conversions; the pool->ctx dequant is fused on device)"),
+)
+
+KV_QUANT = CounterRegistry(FAMILIES, _HISTOGRAMS, label="kv-quant")
+
+
+@dataclass
+class QuantizedPages:
+    """Host bundle of int8 KV pages + their per-block-per-layer scales.
+
+    ``data`` is int8 ``[2(k/v), L, kvh, n, ps, hd]`` (the same axis
+    order as llama.gather_pages); ``scales`` is f32 ``[2, L, n]`` —
+    one absmax scale per (k/v, layer, page). Consumers that only need
+    geometry (page counts, byte accounting) use ``shape``/``nbytes``
+    without caring whether they hold a plain array or a bundle."""
+
+    data: np.ndarray
+    scales: np.ndarray
+
+    @property
+    def shape(self) -> tuple[int, ...]:
+        return self.data.shape
+
+    @property
+    def dtype(self) -> np.dtype:
+        return self.data.dtype
+
+    @property
+    def nbytes(self) -> int:
+        return self.data.nbytes + self.scales.nbytes
+
+    @property
+    def n_pages(self) -> int:
+        return int(self.data.shape[3])
+
+    def slice_pages(self, lo: int, hi: int) -> "QuantizedPages":
+        return QuantizedPages(
+            self.data[:, :, :, lo:hi], self.scales[:, :, lo:hi]
+        )
+
+    def page(self, i: int) -> tuple[np.ndarray, np.ndarray]:
+        """(page [2, L, kvh, ps, hd], scale [2, L]) for one page."""
+        return self.data[:, :, :, i], self.scales[:, :, i]
+
+    def dequantize(self, dtype) -> np.ndarray:
+        """Back to a dense array in ``dtype`` (tier/mode boundaries
+        only — the pool->ctx path dequantizes on device)."""
+        t0 = time.monotonic()
+        out = (
+            self.data.astype(np.float32)
+            * self.scales[:, :, None, :, None, None]
+        ).astype(dtype)
+        KV_QUANT.observe(
+            "dynamo_kv_quant_dequant_seconds", time.monotonic() - t0
+        )
+        KV_QUANT.inc("dynamo_kv_quant_dequant_pages_total", self.n_pages)
+        return out
+
+
+def quantize_pages(data: np.ndarray) -> QuantizedPages:
+    """Host-side symmetric int8 quantize of dense pages
+    ``[2, L, kvh, n, ps, hd]`` with per-(k/v, layer, page) absmax scales
+    — the mode boundary for bf16 payloads entering an int8 pool (the
+    ctx->pool seal quantizes on device instead)."""
+    f = np.asarray(data, np.float32)
+    s = np.maximum(
+        np.abs(f).max(axis=(2, 4, 5)) / 127.0, SCALE_EPS
+    )  # [2, L, n]
+    q = np.clip(
+        np.rint(f / s[:, :, None, :, None, None]), -127, 127
+    ).astype(np.int8)
+    KV_QUANT.inc("dynamo_kv_quant_pages_total", q.shape[3])
+    return QuantizedPages(q, s.astype(np.float32))
+
+
+def is_quantized(data: Any) -> bool:
+    return isinstance(data, QuantizedPages)
+
+
+# ---------------------------------------------------------------------------
+# wire form: int8 payload + scales in the frame header (kv_transfer.py
+# two-part frames). The scale sidecar is small enough for the JSON
+# header — [2, L, n] f32 vs [2, L, kvh, n, ps, hd] int8 payload.
+
+def attach_wire_scales(header: dict, qp: QuantizedPages) -> None:
+    """Add the scale sidecar to an outgoing frame header (shape/dtype
+    fields must describe ``qp.data``, which is the payload)."""
+    header["kv_scales"] = [float(x) for x in qp.scales.ravel()]
+    header["kv_scales_shape"] = list(qp.scales.shape)
+    KV_QUANT.inc("dynamo_kv_quant_scale_bytes_total", qp.scales.nbytes)
+
+
+def from_wire(arr: np.ndarray, header: dict):
+    """Rebuild the receive-side value: a QuantizedPages when the frame
+    carried scales, the plain array otherwise."""
+    if "kv_scales" not in header:
+        return arr
+    scales = np.asarray(header["kv_scales"], np.float32).reshape(
+        header["kv_scales_shape"]
+    )
+    return QuantizedPages(arr, scales)
+
+
+def to_pool_dtype(data: Any, quantized_pool: bool, dtype) -> Any:
+    """Convert an incoming page payload to what the local pool stores:
+    bundles for an int8 pool (quantizing dense payloads from bf16
+    peers), dense ``dtype`` arrays otherwise (dequantizing bundles from
+    int8 peers). Identity when the payload already matches."""
+    if quantized_pool:
+        return data if is_quantized(data) else quantize_pages(data)
+    if is_quantized(data):
+        return data.dequantize(dtype)
+    return data

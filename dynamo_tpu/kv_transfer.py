@@ -51,6 +51,11 @@ from typing import Any, Callable, Iterable, Optional
 import ml_dtypes  # noqa: F401 — registers bfloat16 with np.dtype
 import numpy as np
 
+from dynamo_tpu.kv_quant import (
+    QuantizedPages,
+    attach_wire_scales,
+    from_wire,
+)
 from dynamo_tpu.kv_transfer_metrics import KV_TRANSFER
 from dynamo_tpu.runtime.client import KvClient
 from dynamo_tpu.runtime.protocol import (
@@ -62,13 +67,43 @@ from dynamo_tpu.runtime.protocol import (
 log = logging.getLogger(__name__)
 
 
+def _array_header(data) -> tuple[np.ndarray, dict[str, Any]]:
+    """(payload array, geometry header fields) for a dense array OR a
+    kv_quant.QuantizedPages bundle — int8 payloads ship their per-block
+    scale sidecar in the JSON header (it is ~1/(2*kvh*ps*hd) of the
+    payload), so a quantized move is ~half a bf16 move's wire bytes."""
+    fields: dict[str, Any] = {}
+    if isinstance(data, QuantizedPages):
+        attach_wire_scales(fields, data)
+        data = data.data
+    fields["shape"] = list(data.shape)
+    fields["dtype"] = data.dtype.name
+    return data, fields
+
+
+def _decode_payload(header: dict[str, Any], payload: bytes,
+                    copy: bool = False):
+    """Inverse of _array_header: the dense array, re-bundled with its
+    scales when the frame carried a quantized payload. ``copy`` detaches
+    the result from the frame buffer (writable, own lifetime)."""
+    arr = np.frombuffer(
+        payload, dtype=np.dtype(header["dtype"])
+    ).reshape(header["shape"])
+    if copy:
+        arr = arr.copy()
+    return from_wire(arr, header)
+
+
 def _write_array_frame(
-    writer: asyncio.StreamWriter, header: dict[str, Any], data: np.ndarray
+    writer: asyncio.StreamWriter, header: dict[str, Any], data
 ) -> None:
     """Write header + array payload without copying the array: the length
     prefix and header go as one small bytes, the payload as a zero-copy
     byte view (multi-GiB transfers would otherwise pay an extra memcpy and
-    2x peak host memory per hop)."""
+    2x peak host memory per hop). ``data`` may be a QuantizedPages
+    bundle — its scales join the header, its int8 pages the payload."""
+    data, fields = _array_header(data)
+    header = {**header, **fields}
     data = np.ascontiguousarray(data)
     payload = data.view(np.uint8).reshape(-1)
     writer.write(encode_frame2_header(header, payload.nbytes))
@@ -210,9 +245,7 @@ class BlockTransferServer:
                         if self.write_fn is None:
                             raise RuntimeError("writes not accepted")
                         pages = [int(p) for p in header["pages"]]
-                        data = np.frombuffer(
-                            payload, dtype=np.dtype(header["dtype"])
-                        ).reshape(header["shape"])
+                        data = _decode_payload(header, payload)
                         args = (pages, data)
                         if header.get("job") is not None:
                             args = (pages, data, header["job"])
@@ -278,12 +311,7 @@ class BlockTransferServer:
                         data = await loop.run_in_executor(
                             None, self.read_fn, pages
                         )
-                        _write_array_frame(
-                            writer,
-                            {"ok": True, "shape": list(data.shape),
-                             "dtype": data.dtype.name},
-                            data,
-                        )
+                        _write_array_frame(writer, {"ok": True}, data)
                     elif op == "read_hashes":
                         # G4 remote tier: resolve a chained-hash run
                         # against this worker's sealed pool and export the
@@ -321,9 +349,7 @@ class BlockTransferServer:
                         else:
                             _write_array_frame(
                                 writer,
-                                {"ok": True, "found": int(found),
-                                 "shape": list(data.shape),
-                                 "dtype": data.dtype.name},
+                                {"ok": True, "found": int(found)},
                                 data,
                             )
                             KV_TRANSFER.inc(
@@ -389,8 +415,7 @@ class BlockTransferServer:
             sent_pages += int(data.shape[3])
             _write_array_frame(
                 writer,
-                {"ok": True, "seq": seq, "shape": list(data.shape),
-                 "dtype": data.dtype.name, "eof": sent_pages >= found},
+                {"ok": True, "seq": seq, "eof": sent_pages >= found},
                 data,
             )
             await writer.drain()
@@ -417,8 +442,7 @@ async def write_remote_pages(
     has since cancelled (stale-queue protection)."""
     reader, writer = await asyncio.open_connection(host, port)
     try:
-        header = {"op": "write_pages", "pages": [int(p) for p in pages],
-                  "shape": list(data.shape), "dtype": data.dtype.name}
+        header = {"op": "write_pages", "pages": [int(p) for p in pages]}
         if job_id is not None:
             header["job"] = job_id
         _write_array_frame(writer, header, data)
@@ -468,7 +492,6 @@ class PageStreamWriter:
         await self._ensure_conn()
         header = {
             "op": "write_pages", "pages": [int(p) for p in pages],
-            "shape": list(data.shape), "dtype": data.dtype.name,
             "stream": True, "seq": self.chunks_sent,
         }
         if self.job_id is not None:
@@ -548,9 +571,7 @@ async def read_remote_pages(
             raise BlockTransferError(header.get("error", "read failed"))
         KV_TRANSFER.inc("dynamo_kv_transfer_rx_chunks_total")
         KV_TRANSFER.inc("dynamo_kv_transfer_rx_bytes_total", len(payload))
-        return np.frombuffer(
-            payload, dtype=np.dtype(header["dtype"])
-        ).reshape(header["shape"]).copy()
+        return _decode_payload(header, payload, copy=True)
     finally:
         writer.close()
 
@@ -577,9 +598,7 @@ async def probe_remote_hashes(
             raise BlockTransferError(header.get("error", "probe failed"))
         found = int(header.get("found", 0))
         if payload and found:
-            return found, np.frombuffer(
-                payload, dtype=np.dtype(header["dtype"])
-            ).reshape(header["shape"]).copy()
+            return found, _decode_payload(header, payload, copy=True)
         return found, None
     finally:
         writer.close()
@@ -621,9 +640,7 @@ async def read_remote_hashes(
             KV_TRANSFER.inc("dynamo_kv_transfer_rx_chunks_total")
             KV_TRANSFER.inc("dynamo_kv_transfer_rx_bytes_total",
                             len(payload))
-            data = np.frombuffer(
-                payload, dtype=np.dtype(header["dtype"])
-            ).reshape(header["shape"]).copy()
+            data = _decode_payload(header, payload, copy=True)
             if on_chunk is not None:
                 on_chunk(0, data)
                 return found, None
@@ -636,9 +653,7 @@ async def read_remote_hashes(
                 raise BlockTransferError(
                     h.get("error", "chunk stream failed")
                 )
-            arr = np.frombuffer(
-                payload, dtype=np.dtype(h["dtype"])
-            ).reshape(h["shape"]).copy()
+            arr = _decode_payload(h, payload, copy=True)
             KV_TRANSFER.inc("dynamo_kv_transfer_rx_chunks_total")
             KV_TRANSFER.inc("dynamo_kv_transfer_rx_bytes_total",
                             len(payload))

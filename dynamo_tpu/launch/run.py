@@ -65,6 +65,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--max-decode-slots", type=int,
                    default=cfg.max_decode_slots)
     p.add_argument("--cache-dtype", default=cfg.cache_dtype)
+    p.add_argument("--kv-quant", default=cfg.kv_quant,
+                   choices=["none", "int8"],
+                   help="paged-pool KV quantization: int8 pages with "
+                        "per-block scales halve pool HBM residency, "
+                        "host/disk tier footprint and transfer bytes; "
+                        "the hot decode path stays --cache-dtype")
     p.add_argument("--host-offload-pages", type=int,
                    default=cfg.host_offload_pages,
                    help="host-DRAM KV offload tier capacity in pages "
@@ -483,6 +489,7 @@ def build_chain(args) -> "Any":
             page_size=args.page_size,
             max_decode_slots=args.max_decode_slots,
             cache_dtype=args.cache_dtype,
+            kv_quant=args.kv_quant,
             host_offload_pages=args.host_offload_pages,
             disk_offload_pages=args.disk_offload_pages,
             disk_offload_path=args.disk_offload_path,
@@ -880,7 +887,10 @@ async def _attach_data_plane(args, rt, engine, worker_id: str):
         layout=KvCacheLayout(
             num_layers=cfg.num_layers, num_kv_heads=cfg.num_kv_heads,
             page_size=ecfg.page_size, head_dim=cfg.head_dim,
-            dtype=ecfg.cache_dtype,
+            # what moves on the wire: int8 payloads (+ header scales)
+            # for a quantized pool
+            dtype=("int8" if ecfg.kv_quant == "int8"
+                   else ecfg.cache_dtype),
         ),
     ))
     return srv

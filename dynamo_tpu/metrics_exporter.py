@@ -172,12 +172,14 @@ class MetricsExporter:
               "workers in the last load-plane snapshot", len(snap.metrics))
         # resilience + KV-transfer + overload planes: process-local
         # counters, same families on every scrape surface
+        from dynamo_tpu.kv_quant import KV_QUANT
         from dynamo_tpu.kv_transfer_metrics import KV_TRANSFER
         from dynamo_tpu.overload import OVERLOAD
         from dynamo_tpu.resilience.metrics import RESILIENCE
 
         return ("\n".join(lines) + "\n" + RESILIENCE.render()
-                + KV_TRANSFER.render() + OVERLOAD.render())
+                + KV_TRANSFER.render() + KV_QUANT.render()
+                + OVERLOAD.render())
 
     async def handle_metrics(self, request: web.Request) -> web.Response:
         return web.Response(

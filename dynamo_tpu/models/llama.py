@@ -167,19 +167,46 @@ def param_shardings(config: ModelConfig, mesh: Mesh) -> Params:
 # KV cache
 
 def init_cache(
-    config: ModelConfig, num_pages: int, page_size: int, dtype=None
+    config: ModelConfig, num_pages: int, page_size: int, dtype=None,
+    kv_quant: str = "none",
 ) -> Cache:
     """Paged KV pool — prefix-cache STORAGE (see module doc). Page 0 is the
-    reserved scratch page for padded pool I/O."""
+    reserved scratch page for padded pool I/O.
+
+    With ``kv_quant="int8"`` the pool holds int8 pages plus
+    per-block-per-layer absmax scales (``k_scale``/``v_scale``: f32
+    [L, num_pages]) — half the HBM residency of a bf16 pool, so the same
+    chip holds ~2x the hittable prefix corpus. The hot decode path is
+    untouched: quantize fuses into seal_blocks (ctx->pool), dequantize
+    into load_ctx_pages (pool->ctx)."""
     c = config
-    dtype = dtype or jnp.dtype(c.dtype)
     shape = (c.num_layers, c.num_kv_heads, num_pages, page_size, c.head_dim)
+    if kv_quant == "int8":
+        return {
+            "k": jnp.zeros(shape, jnp.int8),
+            "v": jnp.zeros(shape, jnp.int8),
+            "k_scale": jnp.zeros((c.num_layers, num_pages), jnp.float32),
+            "v_scale": jnp.zeros((c.num_layers, num_pages), jnp.float32),
+        }
+    dtype = dtype or jnp.dtype(c.dtype)
     return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
 
 
-def cache_shardings(config: ModelConfig, mesh: Mesh) -> Cache:
+def cache_shardings(
+    config: ModelConfig, mesh: Mesh, kv_quant: str = "none"
+) -> Cache:
     s = NamedSharding(mesh, P(None, "tp", None, None, None))
-    return {"k": s, "v": s}
+    out = {"k": s, "v": s}
+    if kv_quant == "int8":
+        # per-(layer, page) scales: no head axis, replicated over tp
+        sc = NamedSharding(mesh, P(None, None))
+        out["k_scale"] = sc
+        out["v_scale"] = sc
+    return out
+
+
+def cache_is_quantized(cache: Cache) -> bool:
+    return "k_scale" in cache
 
 
 def init_ctx(
@@ -853,13 +880,20 @@ def load_ctx_pages_impl(
     if usable <= 0:
         return {"k": ctx_kv["k"], "v": ctx_kv["v"]}
     page_ids = page_ids[:usable]
+    quant = cache_is_quantized(cache)
     out = {}
     for name in ("k", "v"):
         pages = cache[name][:, :, page_ids]      # [L, kvh, usable, ps, hd]
+        if quant:
+            # fused dequant: int8 pages * per-(layer, page) scale, in the
+            # same admission-copy program — never a separate dispatch
+            s = cache[name + "_scale"][:, page_ids]       # [L, usable]
+            pages = (pages.astype(jnp.float32)
+                     * s[:, None, :, None, None])
         L, kvh, _, _, hd = pages.shape
         span = pages.reshape(L, kvh, usable * ps, hd)
         out[name] = jax.lax.dynamic_update_slice(
-            ctx_kv[name], span[:, :, None],
+            ctx_kv[name], span[:, :, None].astype(ctx_kv[name].dtype),
             (0, 0, slot, 0, 0),
         )
     return out
@@ -900,10 +934,16 @@ def seal_blocks_impl(
 ) -> Cache:
     """Copy sealed blocks ctx->pool (the storage half of commit). Each
     entry copies ctx_kv[:, :, slots[i], starts[i]:+ps] into pool page
-    pages[i]. Padding rows target scratch page 0 (garbage by contract)."""
-    ps = page_size
+    pages[i]. Padding rows target scratch page 0 (garbage by contract).
 
-    def one(name):
+    Quantized pools (cache_is_quantized) quantize in the SAME fused
+    gather: per-(layer, page) absmax scales over the block's
+    [kvh, ps, hd] elements, int8 payload + scale scattered together —
+    the pool boundary is the one place KV precision drops."""
+    ps = page_size
+    quant = cache_is_quantized(cache)
+    out = {}
+    for name in ("k", "v"):
         # ONE gather over the (lane, position)-flattened axis. The
         # previous vmap(dynamic_index + dynamic_slice) materialized the
         # full [L, kvh, S, hd] LANE per entry before slicing — at long
@@ -914,9 +954,20 @@ def seal_blocks_impl(
         flat = src.reshape(L, kvh, lanes * S, hd)
         idx = (slots * S + starts)[:, None] + jnp.arange(ps)[None, :]
         blocks = flat[:, :, idx]                 # [L, kvh, n, ps, hd]
-        return cache[name].at[:, :, pages].set(blocks)
-
-    return {"k": one("k"), "v": one("v")}
+        if quant:
+            bf = blocks.astype(jnp.float32)
+            s = jnp.max(jnp.abs(bf), axis=(1, 3, 4)) / 127.0   # [L, n]
+            s = jnp.maximum(s, 1e-8)
+            q = jnp.clip(
+                jnp.round(bf / s[:, None, :, None, None]), -127, 127
+            ).astype(jnp.int8)
+            out[name] = cache[name].at[:, :, pages].set(q)
+            out[name + "_scale"] = (
+                cache[name + "_scale"].at[:, pages].set(s)
+            )
+        else:
+            out[name] = cache[name].at[:, :, pages].set(blocks)
+    return out
 
 
 seal_blocks = jax.jit(
@@ -1060,8 +1111,38 @@ def scatter_pages_impl(
     }
 
 
+def gather_pages_q_impl(
+    cache: Cache, page_ids: jnp.ndarray
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """gather_pages for a quantized pool: (int8 pages [2, L, kvh, n, ps,
+    hd], scales [2, L, n]) — the int8 payload plus its scale sidecar is
+    what every downstream tier/transfer consumer moves."""
+    data = jnp.stack(
+        [cache["k"][:, :, page_ids], cache["v"][:, :, page_ids]]
+    )
+    scales = jnp.stack(
+        [cache["k_scale"][:, page_ids], cache["v_scale"][:, page_ids]]
+    )
+    return data, scales
+
+
+def scatter_pages_q_impl(
+    cache: Cache, page_ids: jnp.ndarray,
+    data: jnp.ndarray, scales: jnp.ndarray,
+) -> Cache:
+    """Inverse of gather_pages_q: int8 pages + scales into the pool."""
+    return {
+        "k": cache["k"].at[:, :, page_ids].set(data[0]),
+        "v": cache["v"].at[:, :, page_ids].set(data[1]),
+        "k_scale": cache["k_scale"].at[:, page_ids].set(scales[0]),
+        "v_scale": cache["v_scale"].at[:, page_ids].set(scales[1]),
+    }
+
+
 gather_pages = jax.jit(gather_pages_impl)
 scatter_pages = jax.jit(scatter_pages_impl, donate_argnums=(0,))
+gather_pages_q = jax.jit(gather_pages_q_impl)
+scatter_pages_q = jax.jit(scatter_pages_q_impl, donate_argnums=(0,))
 
 
 # ---------------------------------------------------------------------------

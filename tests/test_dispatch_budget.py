@@ -1,0 +1,187 @@
+"""Dispatch-budget regression pins: the decode-round dispatch diet.
+
+BENCH_r06 showed 6.53 ms wall/step vs 1.04 ms device/step — the gap is
+host tax, and a big slice of it is per-round host→device dispatches.
+After the diet (seals fused into the round program, packed patch
+uploads, packed logprob fetches, metrics publish throttled), a steady
+decode round costs exactly ONE program dispatch + ONE stacked-token
+fetch. These tests pin that budget via the engine's own
+``dispatch_counts`` accounting so future PRs can't silently regrow it
+(the tool view of the same numbers: ``tools/profile_round.py
+--dispatch-budget``).
+"""
+import asyncio
+
+import numpy as np
+import pytest
+
+from dynamo_tpu.engine.config import EngineConfig
+from dynamo_tpu.engine.engine import TpuEngine
+from dynamo_tpu.models.config import ModelConfig
+from dynamo_tpu.parallel.mesh import MeshConfig
+from dynamo_tpu.protocols.common import (
+    OutputOptions,
+    PreprocessedRequest,
+    StopConditions,
+)
+
+PS = 16
+
+
+def _engine(**kw) -> TpuEngine:
+    base = dict(
+        num_pages=128, page_size=PS, max_pages_per_seq=16,
+        max_decode_slots=4, prefill_buckets=(64,),
+        cache_dtype="float32",
+    )
+    base.update(kw)
+    return TpuEngine(ModelConfig.tiny(dtype="float32"),
+                     EngineConfig(**base),
+                     mesh_config=MeshConfig(tp=1))
+
+
+async def test_steady_decode_round_budget():
+    """THE pin: in a steady decode window (every slot active, no
+    admissions/releases/transfers), dispatches-per-round must stay at
+    1 program + 1 fetch — and seals must ride the round program, never
+    a standalone seal_blocks dispatch."""
+    eng = _engine()
+    eng.start()
+    rng = np.random.RandomState(0)
+    n_req, osl = 4, 64
+    prompts = [rng.randint(1, 256, 48).tolist() for _ in range(n_req)]
+    progress = [0] * n_req
+
+    async def one(i):
+        async for out in eng.generate(PreprocessedRequest(
+            token_ids=list(prompts[i]),
+            stop_conditions=StopConditions(max_tokens=osl,
+                                           ignore_eos=True),
+        )):
+            progress[i] += len(out.token_ids)
+
+    tasks = [asyncio.ensure_future(one(i)) for i in range(n_req)]
+    # window opens once every request is admitted and decoding...
+    while not all(p >= 4 for p in progress):
+        await asyncio.sleep(0.005)
+    d0 = dict(eng.dispatch_counts)
+    # ...and closes well before any finishes (the dispatch front runs
+    # ahead of emitted tokens by the pipeline lag — flush_every *
+    # (max_inflight_rounds + 1) = 12 steps — so closing 20 tokens short
+    # of osl keeps release patches out of the window)
+    while not any(p >= osl - 20 for p in progress):
+        await asyncio.sleep(0.005)
+    d1 = dict(eng.dispatch_counts)
+    await asyncio.gather(*tasks)
+    await eng.stop()
+
+    delta = {k: d1[k] - d0.get(k, 0) for k in d1}
+    rounds = delta["round"] + delta["round_seal"]
+    # the dispatch front leads emitted progress by the pipeline lag, so
+    # the window captures a variable-but-positive round count
+    assert rounds >= 5, delta
+    # nothing but round programs + their fetches in the window
+    assert delta["seal"] == 0, delta          # seals fused, not standalone
+    assert delta["patch"] == 0, delta         # no admissions/releases
+    assert delta["prefill"] == 0 and delta["prefill_batch"] == 0, delta
+    assert delta["load_ctx"] == 0 and delta["sample_first"] == 0, delta
+    total = sum(delta.values())
+    # 1 program + 1 fetch per round; the snapshot can land between a
+    # round's program and fetch increments, so allow one straggler
+    # fetch per window edge
+    assert total <= 2 * rounds + 2, (total, rounds, delta)
+    # blocks complete every PS tokens: with 4 slots x 4 steps/round the
+    # fused-seal variant must actually be exercised in the window
+    assert delta["round_seal"] >= 1, delta
+
+
+async def test_whole_run_dispatch_budget():
+    """Coarse whole-workload pin (admission + prefill + decode + tail):
+    the all-in dispatches-per-round number the profile tool reports.
+    Pre-diet this sat around ~4.5 (one standalone seal nearly every
+    round); pin at 4.0 with the measured value ~3.5."""
+    eng = _engine()
+    eng.start()
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(1, 256, 48).tolist() for _ in range(4)]
+
+    async def one(p, mt):
+        async for _ in eng.generate(PreprocessedRequest(
+            token_ids=list(p),
+            stop_conditions=StopConditions(max_tokens=mt,
+                                           ignore_eos=True),
+        )):
+            pass
+
+    # warmup compiles, then the measured window
+    await asyncio.gather(*[one(p, 8) for p in prompts])
+    d0 = dict(eng.dispatch_counts)
+    await asyncio.gather(*[one(p, 40) for p in prompts])
+    delta = {k: v - d0.get(k, 0) for k, v in eng.dispatch_counts.items()}
+    await eng.stop()
+    rounds = delta["round"] + delta["round_seal"]
+    assert rounds >= 8, delta
+    assert sum(delta.values()) / rounds <= 4.0, delta
+
+
+async def test_logprob_fetch_is_packed():
+    """Logprob rounds fetch ONE packed array (chosen + ids + lps), not
+    three — and the unpacked values are self-consistent."""
+    eng = _engine()
+    eng.start()
+    rng = np.random.RandomState(2)
+    toks, lps, top = [], [], []
+    async for out in eng.generate(PreprocessedRequest(
+        token_ids=rng.randint(1, 256, 24).tolist(),
+        stop_conditions=StopConditions(max_tokens=12, ignore_eos=True),
+        output_options=OutputOptions(logprobs=2),
+    )):
+        toks.extend(out.token_ids)
+        lps.extend(out.log_probs or [])
+        top.extend(out.top_logprobs or [])
+    await eng.stop()
+    assert len(toks) == 12 and len(lps) == 12 and len(top) == 12
+    for t, lp, pairs in zip(toks, lps, top):
+        assert len(pairs) == 2
+        # ids survived the f32 packing exactly; greedy chosen == top-1
+        assert pairs[0][0] == t
+        assert lp == pytest.approx(pairs[0][1], abs=1e-5)
+        assert pairs[0][1] >= pairs[1][1]
+
+
+async def test_fused_seal_round_matches_standalone_pin():
+    """Correctness pin for the seal fusion: tokens + the prefix cache a
+    fused-seal run produces are identical to what the engine produced
+    before the fusion — verified by the warm wave hitting the sealed
+    blocks (exact bf16 pool roundtrip) and by forcing a standalone
+    flush path via an offload-tier engine (which flushes seals before
+    its pool-reading gathers)."""
+    outs = {}
+    for mode, kw in (("fused", {}),
+                     ("standalone", {"host_offload_pages": 16})):
+        eng = _engine(**kw)
+        eng.start()
+        rng = np.random.RandomState(3)
+        prompts = [rng.randint(1, 256, 3 * PS + 1).tolist()
+                   for _ in range(2)]
+
+        async def one(p):
+            got = []
+            async for out in eng.generate(PreprocessedRequest(
+                token_ids=list(p),
+                stop_conditions=StopConditions(max_tokens=8,
+                                               ignore_eos=True),
+            )):
+                got.extend(out.token_ids)
+            return got
+
+        w1 = [await one(p) for p in prompts]
+        w2 = [await one(p) for p in prompts]  # prefix-hit via the pool
+        assert w1 == w2  # bf16 pool: byte-exact roundtrip either path
+        outs[mode] = (w1, dict(eng.dispatch_counts))
+        await eng.stop()
+    assert outs["fused"][0] == outs["standalone"][0]
+    # the fused variant was actually exercised (whether the offload
+    # engine's pool-reading gathers forced standalone flushes is
+    # timing-dependent; token identity above is the invariant)
+    assert outs["fused"][1]["round_seal"] >= 1

@@ -154,6 +154,12 @@ def test_readme_documents_canonical_series():
         "dynamo_kv_transfer_chunk_seconds",
         "dynamo_kv_transfer_seconds",
         "dynamo_disagg_fallback_total",
+        # int8 KV-block economy (dynamo_tpu/kv_quant.py)
+        "dynamo_kv_quant_pages_total",
+        "dynamo_kv_quant_dequant_pages_total",
+        "dynamo_kv_quant_scale_bytes_total",
+        "dynamo_kv_quant_dequant_seconds",
+        "dynamo_kv_pool_capacity_blocks",
         # overload-protection plane (dynamo_tpu/overload/)
         "dynamo_overload_rejected_total",
         "dynamo_overload_shed_total",

@@ -175,6 +175,7 @@ def _spec_dispatch_mode(modes: list[str], n_req: int, osl: int) -> int:
             return w
 
         eng._engine_round = wrap("round", eng._engine_round)
+        eng._engine_round_seal = wrap("round", eng._engine_round_seal)
         eng._patch = wrap("patch", eng._patch)
         eng._sample_first = wrap("first", eng._sample_first)
         eng.start()
@@ -219,6 +220,115 @@ def _spec_dispatch_mode(modes: list[str], n_req: int, osl: int) -> int:
     return 0
 
 
+def _dispatch_budget_mode(n_req: int, osl: int, kv_quant: str) -> int:
+    """Profile the PLAIN (non-spec) decode path's host tax: run a tiny
+    engine through a steady-decode workload and report (one JSON line)
+    the engine's dispatch_counts broken down per source, the
+    dispatches-per-decode-round number the tier-1 regression test pins
+    (tests/test_dispatch_budget.py), and host ms/step = wall − device —
+    the exact gap BENCH_r06 showed as 6.53 ms wall vs 1.04 ms device.
+    Run: python tools/profile_round.py --dispatch-budget"""
+    import asyncio
+
+    from dynamo_tpu.engine.config import EngineConfig
+    from dynamo_tpu.engine.engine import TpuEngine
+    from dynamo_tpu.parallel.mesh import MeshConfig
+    from dynamo_tpu.protocols.common import (
+        PreprocessedRequest,
+        StopConditions,
+    )
+
+    cfg = ModelConfig.tiny(dtype="float32")
+    ecfg = EngineConfig(
+        num_pages=128, page_size=16, max_pages_per_seq=16,
+        max_decode_slots=max(n_req, 2), prefill_buckets=(64,),
+        cache_dtype="float32", kv_quant=kv_quant,
+    )
+    eng = TpuEngine(cfg, ecfg, mesh_config=MeshConfig(tp=1))
+    eng.start()
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(1, cfg.vocab_size, 48).tolist()
+               for _ in range(n_req)]
+
+    async def one(p, mt):
+        n = 0
+        async for out in eng.generate(PreprocessedRequest(
+            token_ids=list(p),
+            stop_conditions=StopConditions(max_tokens=mt, ignore_eos=True),
+        )):
+            n += len(out.token_ids)
+        return n
+
+    async def run() -> dict:
+        # warmup: compile prefill/round/seal/patch before the window
+        await asyncio.gather(*[one(p, 8) for p in prompts])
+        d0 = dict(eng.dispatch_counts)
+        steps0 = eng.step_count
+        t0 = time.monotonic()
+        tokens = sum(await asyncio.gather(*[one(p, osl) for p in prompts]))
+        wall = time.monotonic() - t0
+        steps = eng.step_count - steps0
+        delta = {k: v - d0.get(k, 0) for k, v in eng.dispatch_counts.items()}
+        return {"tokens": tokens, "wall_s": wall, "steps": steps,
+                "delta": delta}
+
+    stats = asyncio.run(run())
+    asyncio.run(eng.stop())  # quiesce: the loop must not patch _dev
+                             # while the blocking reps donate it
+
+    # device-only ms/step: blocking reps of the FUSED round (round +
+    # flush + dummy seal — what the serving loop actually dispatches,
+    # already hot) at the engine's own state, same methodology as
+    # bench.py. Two warmups: the first call's outputs carry jit-output
+    # shardings that key one more compilation.
+    B = ecfg.max_decode_slots
+    dev = dict(
+        eng._dev,
+        ctx=jnp.full((B,), 48 + osl, jnp.int32),
+        dest=jnp.arange(B, dtype=jnp.int32),
+        tokens=jnp.ones((B,), jnp.int32),
+    )
+
+    def one_round(dev):
+        out = eng._engine_round_seal(
+            eng.params, eng.ctx, eng.ring, dev, eng.cache,
+            *eng._zero_seal, ecfg.flush_every, False, False,
+        )
+        eng.ctx, eng.ring, eng.cache = out[0], out[1], out[3]
+        jax.block_until_ready(out)
+        return out[2]
+
+    dev = one_round(one_round(dev))
+    t0 = time.monotonic()
+    reps = 10
+    for _ in range(reps):
+        dev = one_round(dev)
+    device_ms_per_step = (
+        (time.monotonic() - t0) / (reps * ecfg.flush_every) * 1e3
+    )
+
+    delta = stats["delta"]
+    rounds = delta.get("round", 0) + delta.get("round_seal", 0)
+    wall_ms_per_step = stats["wall_s"] / max(stats["steps"], 1) * 1e3
+    print(json.dumps({
+        "mode": "dispatch-budget",
+        "kv_quant": kv_quant,
+        "slots": n_req,
+        "tokens": stats["tokens"],
+        "steps": stats["steps"],
+        "rounds": rounds,
+        "dispatch_breakdown": delta,
+        "dispatches_per_round": round(
+            sum(delta.values()) / max(rounds, 1), 3),
+        "standalone_seal_dispatches": delta.get("seal", 0),
+        "wall_ms_per_step": round(wall_ms_per_step, 4),
+        "device_ms_per_step": round(device_ms_per_step, 4),
+        "host_ms_per_step": round(
+            wall_ms_per_step - device_ms_per_step, 4),
+    }))
+    return 0
+
+
 if __name__ == "__main__":
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument(
@@ -226,11 +336,23 @@ if __name__ == "__main__":
         choices=["off", "ngram", "draft", "draft-perslot", "all"],
         help="dispatch-count mode instead of kernel timing",
     )
+    ap.add_argument(
+        "--dispatch-budget", action="store_true",
+        help="plain-round dispatch budget + host-ms/step JSON mode "
+             "(the regression-pinned numbers)",
+    )
+    ap.add_argument("--kv-quant", default="none", choices=["none", "int8"],
+                    help="pool quantization for --dispatch-budget")
     ap.add_argument("--requests", type=int, default=4,
                     help="concurrent requests (= speculating slots)")
     ap.add_argument("--osl", type=int, default=32,
-                    help="output tokens per request in --spec mode")
+                    help="output tokens per request in --spec/"
+                         "--dispatch-budget mode")
     args = ap.parse_args()
+    if args.dispatch_budget:
+        raise SystemExit(
+            _dispatch_budget_mode(args.requests, args.osl, args.kv_quant)
+        )
     if args.spec:
         modes = (["off", "ngram", "draft", "draft-perslot"]
                  if args.spec == "all" else [args.spec])
