@@ -715,7 +715,15 @@ def main():
               "kv_quant_pool_blocks_bf16", "kv_quant_capacity_ratio",
               "kv_quant_hit_ttft_int8_ms", "kv_quant_hit_ttft_bf16_ms",
               "kv_quant_token_match_pct", "kv_quant_logprob_delta_max",
-              "kv_quant_remote_prefills", "kv_quant_error"):
+              "kv_quant_remote_prefills", "kv_quant_error",
+              # integrity phase (bench_modes.integrity_experiment):
+              # clean vs corrupted prefix-hit TTFT under a flip_kv_bits
+              # storm — quarantine/recompute counters fire and token
+              # divergence must be 0
+              "integrity_clean_hit_ttft_ms", "integrity_corrupt_ttft_ms",
+              "integrity_flips_injected", "integrity_quarantined",
+              "integrity_recomputed", "integrity_token_divergence",
+              "integrity_error"):
         v = stats.get(k)
         if v is None and k.endswith("_error"):
             continue

@@ -818,6 +818,103 @@ async def kv_quant_experiment(
     }
 
 
+async def integrity_experiment(n_new: int = 6) -> dict:
+    """KV data-integrity experiment (the PR 8 tentpole): the SAME prompt
+    is served three ways on one small-HBM engine with a G2 host tier —
+    cold, as a clean G2 prefix hit, and as a prefix hit under a
+    ``flip_kv_bits`` corruption storm (every onboard gather corrupted).
+    Reports clean-hit vs corrupted TTFT (the latency price of
+    quarantine-and-recompute), the quarantine/recompute counter deltas,
+    and token divergence vs the clean run — which must be ZERO:
+    corruption costs latency, never wrong tokens."""
+    from dynamo_tpu.engine.config import EngineConfig
+    from dynamo_tpu.engine.engine import TpuEngine
+    from dynamo_tpu.kv_integrity import KV_INTEGRITY
+    from dynamo_tpu.models import llama
+    from dynamo_tpu.models.config import ModelConfig
+    from dynamo_tpu.parallel.mesh import MeshConfig
+    from dynamo_tpu.protocols.common import (
+        PreprocessedRequest,
+        StopConditions,
+    )
+    from dynamo_tpu.resilience.chaos import CHAOS
+
+    ps = 16
+    cfg = ModelConfig.tiny(dtype="float32")
+    params = llama.init_params(cfg, 0)
+    # 12 usable HBM pages + a host tier: pressure evicts fast, so the
+    # prefix hit genuinely onboards from G2
+    ecfg = EngineConfig(
+        num_pages=13, page_size=ps, max_pages_per_seq=8,
+        max_decode_slots=2, prefill_buckets=(32, 64),
+        cache_dtype="float32", host_offload_pages=24, offload_batch=8,
+    )
+    eng = TpuEngine(cfg, ecfg, params=params,
+                    mesh_config=MeshConfig(tp=1))
+    prompt = list(range(1, 50))  # 3 complete blocks + tail
+
+    def req_for(p):
+        return PreprocessedRequest(
+            token_ids=list(p),
+            stop_conditions=StopConditions(max_tokens=n_new,
+                                           ignore_eos=True),
+        )
+
+    async def run(p):
+        t0 = time.monotonic()
+        ttft, toks = None, []
+        async for out in eng.generate(req_for(p)):
+            if out.token_ids and ttft is None:
+                ttft = time.monotonic() - t0
+            toks.extend(out.token_ids)
+        return ttft, toks
+
+    async def evict_a(bases):
+        """Pressure the HBM pool until A's blocks live only in G2."""
+        for _ in range(200):
+            if len(eng.offload) >= 3:
+                break
+            await asyncio.sleep(0.02)
+        for base in bases:
+            await run(list(range(base, base + 49)))
+            await asyncio.sleep(0.05)
+
+    _, ref = await run(prompt)  # cold (also compiles prefill/decode)
+    await evict_a((100, 200, 300, 400))
+    await run(prompt)  # warm hit: compiles the onboard scatter path
+    await evict_a((500, 600, 700, 800))
+    clean_ttft, clean_toks = await run(prompt)
+    await evict_a((900, 1000, 1100, 1200))
+
+    before = KV_INTEGRITY.snapshot()
+    CHAOS.arm("flip_kv_bits", probability=1.0)
+    corrupt_ttft, corrupt_toks = await run(prompt)
+    CHAOS.disarm("flip_kv_bits")
+    after = KV_INTEGRITY.snapshot()
+    flips = CHAOS.points["flip_kv_bits"].injected_total
+    await eng.stop()
+
+    divergence = sum(
+        x != y for x, y in zip(ref, clean_toks)
+    ) + sum(x != y for x, y in zip(ref, corrupt_toks)) + abs(
+        len(ref) - len(clean_toks)
+    ) + abs(len(ref) - len(corrupt_toks))
+    return {
+        "integrity_clean_hit_ttft_ms": round(clean_ttft * 1e3, 2)
+        if clean_ttft else None,
+        "integrity_corrupt_ttft_ms": round(corrupt_ttft * 1e3, 2)
+        if corrupt_ttft else None,
+        "integrity_flips_injected": int(flips),
+        "integrity_quarantined": int(
+            after["dynamo_kv_integrity_quarantined_total"]
+            - before["dynamo_kv_integrity_quarantined_total"]),
+        "integrity_recomputed": int(
+            after["dynamo_kv_integrity_recomputed_total"]
+            - before["dynamo_kv_integrity_recomputed_total"]),
+        "integrity_token_divergence": int(divergence),
+    }
+
+
 def main():
     out = asyncio.run(routing_experiment())
     out.update(asyncio.run(fault_experiment()))
@@ -833,6 +930,10 @@ def main():
         out.update(asyncio.run(kv_quant_experiment()))
     except Exception as e:  # noqa: BLE001 — best-effort phase
         out["kv_quant_error"] = str(e)[:200]
+    try:
+        out.update(asyncio.run(integrity_experiment()))
+    except Exception as e:  # noqa: BLE001 — best-effort phase
+        out["integrity_error"] = str(e)[:200]
     print(json.dumps(out))
 
 

@@ -48,6 +48,9 @@ class RuntimeConfig:
     host_offload_pages: int = 0
     disk_offload_pages: int = 0
     disk_offload_path: Optional[str] = None
+    # eager G3 startup scrub (kv_integrity): verify every manifest entry
+    # against the backing file at attach instead of lazily at gather
+    scrub_on_start: bool = False
     # speculative decoding (dynamo_tpu/spec/): off | ngram | draft
     speculative: str = "off"
     num_speculative_tokens: int = 4

@@ -136,8 +136,16 @@ class EngineConfig:
     # disables. G2's LRU evictions spill into it; requires G2 enabled
     # (the tier hierarchy is strict: G1 -> G2 -> G3).
     disk_offload_pages: int = 0
-    # backing file for the G3 pool (None = fresh tempfile per engine)
+    # backing file for the G3 pool (None = fresh tempfile per engine).
+    # With a path the tier is restart-survivable: a sidecar manifest
+    # (<path>.manifest) journals slot->(hash, crc) and is replayed at
+    # attach (kv_integrity plane).
     disk_offload_path: Optional[str] = None
+    # eager G3 startup scrub: re-checksum every manifest entry against
+    # the backing file at attach, dropping mismatches (torn writes come
+    # back as misses). Off = lazy verify at onboard gather — same
+    # safety, the scrub cost is paid per hit instead of up front.
+    scrub_on_start: bool = False
     # offload dispatch cap per scheduling round (bounds the per-round
     # gather size; pow2-bucketed for compile-cache reuse)
     offload_batch: int = 8

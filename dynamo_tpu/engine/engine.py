@@ -61,6 +61,7 @@ from dynamo_tpu.engine.cache import PageAllocator
 from dynamo_tpu.engine.config import EngineConfig, pow2_cover  # noqa: F401
 # (pow2_cover re-exported: engine.engine was its historical home)
 from dynamo_tpu.engine import sampling
+from dynamo_tpu.kv_integrity import KV_INTEGRITY, KvQuarantine
 from dynamo_tpu.kv_quant import KV_QUANT, QuantizedPages, to_pool_dtype
 from dynamo_tpu.kv_router.protocols import (
     ForwardPassMetrics,
@@ -336,6 +337,11 @@ class TpuEngine:
         # never a swap that could drop a concurrent append.
         self.offload = None
         self._offload_cands: deque = deque()
+        # KV integrity plane (kv_integrity.py): one quarantine shared by
+        # every host tier — a block that ever failed verification is
+        # dropped everywhere and refused re-admission until its TTL
+        # lapses, so the stream recomputes it instead of re-serving rot
+        self.kv_quarantine = KvQuarantine()
         if e.disk_offload_pages > 0 and e.host_offload_pages <= 0:
             raise ValueError(
                 "disk_offload_pages (G3) requires host_offload_pages (G2): "
@@ -360,10 +366,12 @@ class TpuEngine:
                 spill = DiskOffloadTier(
                     e.disk_offload_pages, page_shape, tier_dtype,
                     path=e.disk_offload_path, scale_shape=scale_shape,
+                    quarantine=self.kv_quarantine,
+                    scrub_on_start=e.scrub_on_start,
                 )
             self.offload = HostOffloadTier(
                 e.host_offload_pages, page_shape, tier_dtype, spill=spill,
-                scale_shape=scale_shape,
+                scale_shape=scale_shape, quarantine=self.kv_quarantine,
             )
             self.allocator.on_park = (
                 lambda p, h, par: self._offload_cands.append((p, h, par))
@@ -2172,15 +2180,46 @@ class TpuEngine:
         # peak host staging is O(chunk) instead of O(run), and the
         # uniform chunk width reuses one compiled scatter shape
         cp = self.ecfg.kv_transfer_chunk_pages or len(pages)
+        good = len(run)
         for i in range(0, len(pages), cp):
-            hs = [h for h, _ in run[i:i + cp]]
+            chunk = run[i:i + cp]
+            hs = [h for h, _ in chunk]
             data = self.offload.gather(hs)
             scales = self.offload.gather_scales(hs)
-            self._scatter_padded(
-                pages[i:i + cp],
-                QuantizedPages(data, scales) if scales is not None
-                else data,
-            )
+            # admission verify: gathered G2/G3 bytes are checked against
+            # their seal-time crcs BEFORE the scatter — corrupt tier
+            # content must never reach the device pool
+            bad = self.offload.verify_pages(hs, data, scales)
+            k = bad[0] if bad else len(chunk)
+            if k:
+                self._scatter_padded(
+                    pages[i:i + k],
+                    QuantizedPages(data[:, :, :, :k], scales[..., :k])
+                    if scales is not None else data[:, :, :, :k],
+                )
+            if bad:
+                # quarantine the failed blocks (drop from every tier,
+                # refuse re-admission); the chained run must stay
+                # contiguous, so everything past the first bad block is
+                # surrendered and recomputed as prefill — corruption
+                # costs latency, never wrong tokens
+                for j in bad:
+                    self.kv_quarantine.add(hs[j])
+                    self.offload.drop_everywhere(hs[j])
+                good = i + k
+                KV_INTEGRITY.inc(
+                    "dynamo_kv_integrity_recomputed_total",
+                    len(run) - good,
+                )
+                log.warning(
+                    "KV integrity: %d corrupt block(s) in onboard run "
+                    "quarantined; %d of %d blocks recomputed as prefill",
+                    len(bad), len(run) - good, len(run),
+                )
+                break
+        if good < len(run):
+            self.allocator.free(pages[good:])
+            pages, run = pages[:good], run[:good]
         for pg, (h, parent) in zip(pages, run):
             self.allocator.commit(pg, h, parent)
         log.debug("onboarded %d blocks from host tier", len(pages))

@@ -273,6 +273,7 @@ class HttpService:
         return web.json_response(model_list_response(self.manager.list_models()))
 
     async def handle_metrics(self, request: web.Request) -> web.Response:
+        from dynamo_tpu.kv_integrity import KV_INTEGRITY
         from dynamo_tpu.kv_quant import KV_QUANT
         from dynamo_tpu.kv_transfer_metrics import KV_TRANSFER
         from dynamo_tpu.resilience.metrics import RESILIENCE
@@ -281,6 +282,7 @@ class HttpService:
                 + RESILIENCE.render().encode()
                 + KV_TRANSFER.render().encode()
                 + KV_QUANT.render().encode()
+                + KV_INTEGRITY.render().encode()
                 + OVERLOAD.render().encode())
         return web.Response(
             body=body, content_type=CONTENT_TYPE_LATEST.split(";")[0]

@@ -1,0 +1,223 @@
+"""End-to-end KV data-integrity plane: content checksums at every tier
+boundary, quarantine-and-recompute fallback.
+
+The four-tier KV cache (G1 HBM -> G2 DRAM -> G3 disk -> G4 peers) and the
+transfer wire all move raw page bytes addressed by chained block hashes.
+A single flipped bit anywhere in that path poisons *every* request that
+prefix-hits the block — and the int8 pools add a second surface (one
+corrupted f32 scale garbles a whole block's dequantized values). The
+stream still completes "successfully", so neither the resilience plane
+nor the overload plane can catch it.
+
+This module owns the host-side primitives; call sites live in
+engine/offload.py (tier index + G3 manifest), kv_transfer.py (frame
+headers + receiver verify) and engine/engine.py (onboard admission,
+offload minting, G4 landing):
+
+* **Minting** — a crc32 over the page bytes plus the scale sidecar,
+  computed at the block's first host materialization (the async D2H
+  offload fetch of sealed pool pages — the earliest point the bytes are
+  addressable without an extra device round-trip). The checksum is keyed
+  by and travels with the block hash from then on.
+* **Carrying** — G2/G3 index entries store (slot, parent, crc); wire
+  frames carry a per-page ``kv_crc`` header list; the G3 manifest
+  journals (slot, hash, parent, crc, scale) so the tier survives engine
+  restart and a startup scrub can verify it.
+* **Verifying** — tier gathers at onboard admission, receiver-side
+  before scatter on every wire write, client-side on every wire read.
+* **Quarantine** — a mismatched block is dropped from every local tier
+  and its hash is refused re-admission for a TTL; the requesting stream
+  treats the block as a cache miss and recomputes the prefix as prefill.
+  Corruption costs latency, never wrong tokens.
+
+Checksum choice: zlib.crc32 — in the standard library (the container
+pins dependencies; crc32c/xxhash are not available), C-speed, and 32
+bits is plenty for error *detection* of hardware/transport corruption
+(this is not an authenticity mechanism).
+"""
+from __future__ import annotations
+
+import time
+import zlib
+from typing import Any, Iterable, Optional
+
+import numpy as np
+
+from dynamo_tpu.telemetry.metrics import CounterRegistry
+
+FAMILIES: tuple[tuple[str, str, str], ...] = (
+    ("dynamo_kv_integrity_verified_total", "counter",
+     "KV pages whose content checksum verified clean at a tier or wire "
+     "boundary"),
+    ("dynamo_kv_integrity_failed_total", "counter",
+     "KV pages that failed checksum verification (corruption detected "
+     "before the bytes could reach a pool or a scatter)"),
+    ("dynamo_kv_integrity_quarantined_total", "counter",
+     "KV blocks quarantined after a checksum mismatch: dropped from "
+     "every local tier and refused re-admission for the quarantine TTL"),
+    ("dynamo_kv_integrity_recomputed_total", "counter",
+     "KV blocks a stream recomputed as prefill because the cached copy "
+     "failed verification (the latency cost of corruption)"),
+    ("dynamo_kv_integrity_retries_total", "counter",
+     "wire transfers retried once after a receiver integrity nack"),
+    ("dynamo_kv_integrity_g3_scrub_recovered_total", "counter",
+     "G3 manifest entries adopted at startup scrub (block verified or "
+     "structurally sound and prefix-hittable again after restart)"),
+    ("dynamo_kv_integrity_g3_scrub_dropped_total", "counter",
+     "G3 manifest entries dropped at startup scrub (torn journal lines, "
+     "bad slots, or checksum mismatches — recovered as cache misses)"),
+)
+
+KV_INTEGRITY = CounterRegistry(FAMILIES, (), label="kv-integrity")
+
+
+class KvIntegrityError(RuntimeError):
+    """A KV payload failed content-checksum verification.
+
+    Typed and retriable: on the wire the receiver nacks with an
+    ``error_kind: "integrity"`` frame instead of scattering corrupt
+    bytes, and the sender may retry once (the corruption is most often
+    transport- or DMA-local) before falling back to the miss path."""
+
+    def __init__(self, msg: str, bad_pages: tuple[int, ...] = ()):
+        super().__init__(msg)
+        self.bad_pages = tuple(bad_pages)
+
+
+# ---------------------------------------------------------------------------
+# checksums
+
+
+def checksum_bytes(*parts: bytes) -> int:
+    """Chained crc32 over byte strings (page payload, then sidecar)."""
+    crc = 0
+    for part in parts:
+        crc = zlib.crc32(part, crc)
+    return crc & 0xFFFFFFFF
+
+
+def page_checksum(page: np.ndarray,
+                  scale: Optional[np.ndarray] = None) -> int:
+    """Content checksum of one KV page ``[2, L, kvh, ps, hd]`` plus its
+    optional int8 scale sidecar ``[2, L]``. ``tobytes()`` serializes in
+    C order regardless of the view's strides, so pool slices and dense
+    copies of the same block always agree."""
+    if scale is None:
+        return checksum_bytes(page.tobytes())
+    return checksum_bytes(page.tobytes(),
+                          np.asarray(scale, np.float32).tobytes())
+
+
+def page_checksums(data: Any,
+                   scales: Optional[np.ndarray] = None) -> list[int]:
+    """Per-page checksums for a dense page batch ``[2, L, kvh, n, ps,
+    hd]`` or a kv_quant.QuantizedPages bundle (whose scales are folded
+    into each page's checksum — a flipped scale must fail verification
+    exactly like a flipped payload byte)."""
+    if scales is None and hasattr(data, "scales"):
+        data, scales = data.data, data.scales
+    n = int(data.shape[3])
+    return [
+        page_checksum(
+            data[:, :, :, i],
+            scales[..., i] if scales is not None else None,
+        )
+        for i in range(n)
+    ]
+
+
+# ---------------------------------------------------------------------------
+# wire form: per-page crc list in the two-part frame's JSON header
+
+
+def attach_wire_checksums(header: dict, data: Any) -> None:
+    """Stamp an outgoing page frame with per-page content checksums.
+    Must be called on the pre-serialization value (the QuantizedPages
+    bundle, not its raw int8 payload) so scales are covered."""
+    header["kv_crc"] = page_checksums(data)
+
+
+def verify_wire_payload(header: dict, data: Any, *,
+                        context: str = "wire") -> None:
+    """Receiver-side verify of a decoded page payload against the
+    frame's ``kv_crc`` list. Frames from pre-integrity peers (no
+    ``kv_crc``) pass unverified — the plane degrades to the old
+    trust-the-bytes behavior instead of breaking mixed fleets."""
+    want = header.get("kv_crc")
+    if want is None:
+        return
+    got = page_checksums(data)
+    if len(want) != len(got):
+        KV_INTEGRITY.inc("dynamo_kv_integrity_failed_total", len(got))
+        raise KvIntegrityError(
+            f"{context}: kv_crc count {len(want)} != {len(got)} pages"
+        )
+    bad = tuple(
+        i for i, (w, g) in enumerate(zip(want, got)) if int(w) != g
+    )
+    if bad:
+        KV_INTEGRITY.inc("dynamo_kv_integrity_failed_total", len(bad))
+        KV_INTEGRITY.inc(
+            "dynamo_kv_integrity_verified_total", len(got) - len(bad)
+        )
+        raise KvIntegrityError(
+            f"{context}: checksum mismatch on pages {list(bad)} "
+            f"of {len(got)}", bad_pages=bad,
+        )
+    KV_INTEGRITY.inc("dynamo_kv_integrity_verified_total", len(got))
+
+
+# ---------------------------------------------------------------------------
+# quarantine
+
+
+class KvQuarantine:
+    """TTL'd deny-list of block hashes that failed verification.
+
+    A quarantined hash is dropped from every local tier, refused
+    re-admission (tier puts become no-ops) and never re-served — lookups
+    treat it as a miss, so the requesting stream recomputes the prefix.
+    The TTL (rather than a permanent ban) lets legitimately recomputed
+    content re-cache once the corrupt copies have been flushed
+    everywhere; a capacity cap bounds memory under a corruption storm."""
+
+    def __init__(self, ttl_s: float = 300.0, max_entries: int = 4096):
+        self.ttl_s = float(ttl_s)
+        self.max_entries = int(max_entries)
+        self._deadline: dict[int, float] = {}
+        self.total = 0
+
+    def add(self, block_hash: int) -> bool:
+        """Quarantine a hash; False if it already was (no double count)."""
+        now = time.monotonic()
+        fresh = block_hash not in self._deadline
+        self._deadline[block_hash] = now + self.ttl_s
+        if fresh:
+            self.total += 1
+            KV_INTEGRITY.inc("dynamo_kv_integrity_quarantined_total")
+            if len(self._deadline) > self.max_entries:
+                self._expire(now)
+                while len(self._deadline) > self.max_entries:
+                    self._deadline.pop(next(iter(self._deadline)))
+        return fresh
+
+    def add_all(self, hashes: Iterable[int]) -> int:
+        return sum(self.add(h) for h in hashes)
+
+    def _expire(self, now: float) -> None:
+        dead = [h for h, t in self._deadline.items() if t <= now]
+        for h in dead:
+            self._deadline.pop(h, None)
+
+    def __contains__(self, block_hash: int) -> bool:
+        t = self._deadline.get(block_hash)
+        if t is None:
+            return False
+        if t <= time.monotonic():
+            self._deadline.pop(block_hash, None)
+            return False
+        return True
+
+    def __len__(self) -> int:
+        self._expire(time.monotonic())
+        return len(self._deadline)

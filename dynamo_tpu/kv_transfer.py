@@ -51,6 +51,12 @@ from typing import Any, Callable, Iterable, Optional
 import ml_dtypes  # noqa: F401 — registers bfloat16 with np.dtype
 import numpy as np
 
+from dynamo_tpu.kv_integrity import (
+    KV_INTEGRITY,
+    KvIntegrityError,
+    page_checksums,
+    verify_wire_payload,
+)
 from dynamo_tpu.kv_quant import (
     QuantizedPages,
     attach_wire_scales,
@@ -71,27 +77,74 @@ def _array_header(data) -> tuple[np.ndarray, dict[str, Any]]:
     """(payload array, geometry header fields) for a dense array OR a
     kv_quant.QuantizedPages bundle — int8 payloads ship their per-block
     scale sidecar in the JSON header (it is ~1/(2*kvh*ps*hd) of the
-    payload), so a quantized move is ~half a bf16 move's wire bytes."""
+    payload), so a quantized move is ~half a bf16 move's wire bytes.
+
+    KV page frames (the 6-dim [2, L, kvh, n, ps, hd] geometry) also get
+    a per-page ``kv_crc`` content-checksum list, computed over the
+    pre-serialization value (bundle incl. scales) so the receiver can
+    verify before scattering."""
     fields: dict[str, Any] = {}
     if isinstance(data, QuantizedPages):
         attach_wire_scales(fields, data)
+        if data.data.ndim == 6:
+            fields["kv_crc"] = page_checksums(data)
         data = data.data
+    elif getattr(data, "ndim", 0) == 6:
+        fields["kv_crc"] = page_checksums(data)
     fields["shape"] = list(data.shape)
     fields["dtype"] = data.dtype.name
     return data, fields
 
 
 def _decode_payload(header: dict[str, Any], payload: bytes,
-                    copy: bool = False):
+                    copy: bool = False, verify: bool = False):
     """Inverse of _array_header: the dense array, re-bundled with its
     scales when the frame carried a quantized payload. ``copy`` detaches
-    the result from the frame buffer (writable, own lifetime)."""
-    arr = np.frombuffer(
-        payload, dtype=np.dtype(header["dtype"])
-    ).reshape(header["shape"])
+    the result from the frame buffer (writable, own lifetime).
+
+    The declared geometry is validated against the received byte count
+    BEFORE np.frombuffer — a malformed header becomes a typed
+    BlockTransferError the server answers in-band, not a ValueError that
+    kills the connection. ``verify`` additionally checks the payload
+    against the frame's ``kv_crc`` list (KvIntegrityError on mismatch)."""
+    try:
+        dt = np.dtype(str(header["dtype"]))
+        shape = tuple(int(x) for x in header["shape"])
+    except (KeyError, TypeError, ValueError) as e:
+        raise BlockTransferError(f"malformed frame geometry: {e}") from e
+    if any(d < 0 for d in shape):
+        raise BlockTransferError(
+            f"malformed frame geometry: negative dim in {shape}"
+        )
+    expect = int(np.prod(shape, dtype=np.int64)) * dt.itemsize
+    if expect != len(payload):
+        raise BlockTransferError(
+            f"frame geometry {list(shape)}/{dt.name} declares {expect} "
+            f"payload bytes, got {len(payload)}"
+        )
+    arr = np.frombuffer(payload, dtype=dt).reshape(shape)
     if copy:
         arr = arr.copy()
-    return from_wire(arr, header)
+    try:
+        out = from_wire(arr, header)
+    except (TypeError, ValueError) as e:
+        raise BlockTransferError(f"malformed scale sidecar: {e}") from e
+    if verify:
+        verify_wire_payload(header, out, context="kv-transfer frame")
+    return out
+
+
+def _err_kind(e: BaseException) -> str:
+    return "integrity" if isinstance(e, KvIntegrityError) else "frame"
+
+
+def _raise_nack(header: dict[str, Any], default: str) -> None:
+    """Re-raise a receiver nack client-side with its type preserved:
+    ``kind: integrity`` nacks become the retriable KvIntegrityError."""
+    msg = header.get("error", default)
+    if header.get("kind") == "integrity":
+        raise KvIntegrityError(msg)
+    raise BlockTransferError(msg)
 
 
 def _write_array_frame(
@@ -105,6 +158,12 @@ def _write_array_frame(
     data, fields = _array_header(data)
     header = {**header, **fields}
     data = np.ascontiguousarray(data)
+    # chaos corrupt_frame: wire/DMA corruption on a COPY, after the crc
+    # was stamped — the receiver's verify must catch it; the sender's
+    # pool (which `data` may alias zero-copy) stays clean
+    from dynamo_tpu.resilience.chaos import CHAOS
+
+    data = CHAOS.maybe_corrupt_frame(data)
     payload = data.view(np.uint8).reshape(-1)
     writer.write(encode_frame2_header(header, payload.nbytes))
     writer.write(memoryview(payload))
@@ -236,6 +295,7 @@ class BlockTransferServer:
         # acks, so in-band per-frame errors would desync the protocol
         stream_chunks = 0
         stream_err: Optional[str] = None
+        stream_err_kind: Optional[str] = None
         try:
             while True:
                 header, payload = await read_frame2(reader)
@@ -245,44 +305,91 @@ class BlockTransferServer:
                         if self.write_fn is None:
                             raise RuntimeError("writes not accepted")
                         pages = [int(p) for p in header["pages"]]
-                        data = _decode_payload(header, payload)
-                        args = (pages, data)
-                        if header.get("job") is not None:
-                            args = (pages, data, header["job"])
                         if header.get("stream"):
                             # one chunk of a pipelined stream: guarded
                             # scatter on arrival, ack deferred to eof
                             stream_chunks += 1
-                            if stream_err is None:
-                                t0 = time.monotonic()
-                                try:
-                                    await loop.run_in_executor(
-                                        None, self.write_fn, *args
-                                    )
-                                except Exception as e:  # noqa: BLE001
-                                    stream_err = str(e)
-                                    KV_TRANSFER.inc(
-                                        "dynamo_kv_transfer_errors_total"
-                                    )
-                                    log.warning(
-                                        "chunk scatter failed mid-stream "
-                                        "(job=%s seq=%s): %s",
-                                        header.get("job"),
-                                        header.get("seq"), e,
-                                    )
-                                else:
-                                    KV_TRANSFER.inc(
-                                        "dynamo_kv_transfer_rx_chunks_total"
-                                    )
-                                    KV_TRANSFER.inc(
-                                        "dynamo_kv_transfer_rx_bytes_total",
-                                        len(payload),
-                                    )
-                                    KV_TRANSFER.observe(
-                                        "dynamo_kv_transfer_chunk_seconds",
-                                        time.monotonic() - t0,
-                                    )
+                            if stream_err is not None:
+                                continue  # stream already dead
+                            t0 = time.monotonic()
+                            try:
+                                # decode + integrity verify BEFORE the
+                                # scatter: corrupt or malformed bytes
+                                # never reach the pool
+                                data = _decode_payload(
+                                    header, payload, verify=True
+                                )
+                            except (BlockTransferError,
+                                    KvIntegrityError) as e:
+                                stream_err = str(e)
+                                stream_err_kind = _err_kind(e)
+                                KV_TRANSFER.inc(
+                                    "dynamo_kv_transfer_errors_total"
+                                )
+                                log.warning(
+                                    "chunk rejected mid-stream (job=%s "
+                                    "seq=%s kind=%s): %s",
+                                    header.get("job"), header.get("seq"),
+                                    stream_err_kind, e,
+                                )
+                                continue
+                            args = (pages, data)
+                            if header.get("job") is not None:
+                                args = (pages, data, header["job"])
+                            try:
+                                await loop.run_in_executor(
+                                    None, self.write_fn, *args
+                                )
+                            except Exception as e:  # noqa: BLE001
+                                stream_err = str(e)
+                                stream_err_kind = "scatter"
+                                KV_TRANSFER.inc(
+                                    "dynamo_kv_transfer_errors_total"
+                                )
+                                log.warning(
+                                    "chunk scatter failed mid-stream "
+                                    "(job=%s seq=%s): %s",
+                                    header.get("job"),
+                                    header.get("seq"), e,
+                                )
+                            else:
+                                KV_TRANSFER.inc(
+                                    "dynamo_kv_transfer_rx_chunks_total"
+                                )
+                                KV_TRANSFER.inc(
+                                    "dynamo_kv_transfer_rx_bytes_total",
+                                    len(payload),
+                                )
+                                KV_TRANSFER.observe(
+                                    "dynamo_kv_transfer_chunk_seconds",
+                                    time.monotonic() - t0,
+                                )
                             continue  # no per-chunk reply
+                        try:
+                            data = _decode_payload(
+                                header, payload, verify=True
+                            )
+                        except (BlockTransferError,
+                                KvIntegrityError) as e:
+                            # typed nack: the sender distinguishes a
+                            # retriable integrity miss from a protocol
+                            # bug, and the connection stays usable
+                            KV_TRANSFER.inc(
+                                "dynamo_kv_transfer_errors_total"
+                            )
+                            log.warning(
+                                "write_pages rejected (kind=%s): %s",
+                                _err_kind(e), e,
+                            )
+                            writer.write(encode_frame2(
+                                {"ok": False, "error": str(e),
+                                 "kind": _err_kind(e)}, b"",
+                            ))
+                            await writer.drain()
+                            continue
+                        args = (pages, data)
+                        if header.get("job") is not None:
+                            args = (pages, data, header["job"])
                         await loop.run_in_executor(
                             None, self.write_fn, *args
                         )
@@ -293,10 +400,12 @@ class BlockTransferServer:
                         writer.write(encode_frame2({"ok": True}, b""))
                     elif op == "write_pages_eof":
                         # close one pipelined stream: single ack carrying
-                        # any deferred mid-stream failure
+                        # any deferred mid-stream failure (typed, so an
+                        # integrity nack stays retriable end-to-end)
                         if stream_err is not None:
                             writer.write(encode_frame2(
                                 {"ok": False, "error": stream_err,
+                                 "kind": stream_err_kind,
                                  "chunks": stream_chunks}, b"",
                             ))
                         else:
@@ -304,6 +413,7 @@ class BlockTransferServer:
                                 {"ok": True, "chunks": stream_chunks}, b"",
                             ))
                         stream_chunks, stream_err = 0, None
+                        stream_err_kind = None
                     elif op == "read_pages":
                         if self.read_fn is None:
                             raise RuntimeError("reads not accepted")
@@ -439,7 +549,30 @@ async def write_remote_pages(
     """One-sided write: push pages into a peer's pool (NIXL-write path —
     prefill pushing computed KV into decode's pre-allocated pages).
     `job_id` tags the frame so the receiver can reject writes for a job it
-    has since cancelled (stale-queue protection)."""
+    has since cancelled (stale-queue protection).
+
+    An integrity nack (the receiver's checksum verify failed — the bytes
+    rotted on the wire, not at rest) is retried once before the error
+    propagates to the caller's fallback path."""
+    for attempt in (0, 1):
+        try:
+            await _write_remote_pages_once(host, port, pages, data,
+                                           job_id)
+            return
+        except KvIntegrityError:
+            if attempt:
+                raise
+            KV_INTEGRITY.inc("dynamo_kv_integrity_retries_total")
+            log.warning(
+                "integrity nack on write_pages (job=%s); retrying once",
+                job_id,
+            )
+
+
+async def _write_remote_pages_once(
+    host: str, port: int, pages: list[int], data: np.ndarray,
+    job_id: Optional[str] = None,
+) -> None:
     reader, writer = await asyncio.open_connection(host, port)
     try:
         header = {"op": "write_pages", "pages": [int(p) for p in pages]}
@@ -452,7 +585,7 @@ async def write_remote_pages(
         header, _ = await read_frame2(reader)
         if not header.get("ok"):
             KV_TRANSFER.inc("dynamo_kv_transfer_errors_total")
-            raise BlockTransferError(header.get("error", "write failed"))
+            _raise_nack(header, "write failed")
     finally:
         writer.close()
 
@@ -519,9 +652,7 @@ class PageStreamWriter:
         header, _ = await read_frame2(self._reader)
         if not header.get("ok"):
             KV_TRANSFER.inc("dynamo_kv_transfer_errors_total")
-            raise BlockTransferError(
-                header.get("error", "chunk stream failed")
-            )
+            _raise_nack(header, "chunk stream failed")
         KV_TRANSFER.inc("dynamo_kv_transfer_streams_total")
         if self._t_open is not None:
             KV_TRANSFER.observe(
@@ -546,14 +677,27 @@ async def write_pages_stream(
     returns the number of chunks acked. Convenience over PageStreamWriter
     for callers whose chunks are already materialized (tests, onboarding
     batches); the disagg prefill worker drives the writer directly so it
-    can interleave sends with prefill progress."""
-    w = PageStreamWriter(host, port, job_id=job_id)
-    try:
-        for pages, data in chunks:
-            await w.write_chunk(pages, data)
-        return await w.commit()
-    finally:
-        await w.close()
+    can interleave sends with prefill progress.
+
+    Chunks are materialized so an integrity nack at eof can replay the
+    whole stream once (the nacked copy never reached the pool)."""
+    chunks = list(chunks)
+    for attempt in (0, 1):
+        w = PageStreamWriter(host, port, job_id=job_id)
+        try:
+            for pages, data in chunks:
+                await w.write_chunk(pages, data)
+            return await w.commit()
+        except KvIntegrityError:
+            if attempt:
+                raise
+            KV_INTEGRITY.inc("dynamo_kv_integrity_retries_total")
+            log.warning(
+                "integrity nack on page stream (job=%s); retrying once",
+                job_id,
+            )
+        finally:
+            await w.close()
 
 
 async def read_remote_pages(
@@ -568,10 +712,10 @@ async def read_remote_pages(
         await writer.drain()
         header, payload = await read_frame2(reader)
         if not header.get("ok"):
-            raise BlockTransferError(header.get("error", "read failed"))
+            _raise_nack(header, "read failed")
         KV_TRANSFER.inc("dynamo_kv_transfer_rx_chunks_total")
         KV_TRANSFER.inc("dynamo_kv_transfer_rx_bytes_total", len(payload))
-        return _decode_payload(header, payload, copy=True)
+        return _decode_payload(header, payload, copy=True, verify=True)
     finally:
         writer.close()
 
@@ -595,10 +739,11 @@ async def probe_remote_hashes(
         await writer.drain()
         header, payload = await read_frame2(reader)
         if not header.get("ok"):
-            raise BlockTransferError(header.get("error", "probe failed"))
+            _raise_nack(header, "probe failed")
         found = int(header.get("found", 0))
         if payload and found:
-            return found, _decode_payload(header, payload, copy=True)
+            return found, _decode_payload(header, payload, copy=True,
+                                          verify=True)
         return found, None
     finally:
         writer.close()
@@ -631,7 +776,7 @@ async def read_remote_hashes(
         await writer.drain()
         header, payload = await read_frame2(reader)
         if not header.get("ok"):
-            raise BlockTransferError(header.get("error", "read failed"))
+            _raise_nack(header, "read failed")
         found = int(header.get("found", 0))
         if not found:
             return 0, None
@@ -640,7 +785,8 @@ async def read_remote_hashes(
             KV_TRANSFER.inc("dynamo_kv_transfer_rx_chunks_total")
             KV_TRANSFER.inc("dynamo_kv_transfer_rx_bytes_total",
                             len(payload))
-            data = _decode_payload(header, payload, copy=True)
+            data = _decode_payload(header, payload, copy=True,
+                                   verify=True)
             if on_chunk is not None:
                 on_chunk(0, data)
                 return found, None
@@ -650,10 +796,8 @@ async def read_remote_hashes(
         while offset < found:
             h, payload = await read_frame2(reader)
             if not h.get("ok"):
-                raise BlockTransferError(
-                    h.get("error", "chunk stream failed")
-                )
-            arr = _decode_payload(h, payload, copy=True)
+                _raise_nack(h, "chunk stream failed")
+            arr = _decode_payload(h, payload, copy=True, verify=True)
             KV_TRANSFER.inc("dynamo_kv_transfer_rx_chunks_total")
             KV_TRANSFER.inc("dynamo_kv_transfer_rx_bytes_total",
                             len(payload))
@@ -742,7 +886,9 @@ class RemoteKvFetcher:
         async def probe(desc):
             try:
                 return await read_remote_hashes(desc.host, desc.port, hashes)
-            except (OSError, BlockTransferError):
+            except (OSError, BlockTransferError, KvIntegrityError):
+                # an integrity failure on a read is just a peer whose
+                # copy is bad: treat as a miss (another holder may win)
                 return 0, None
 
         results = await asyncio.gather(
@@ -778,7 +924,7 @@ class RemoteKvFetcher:
                     desc.host, desc.port, hashes
                 )
                 return found, data, desc
-            except (OSError, BlockTransferError):
+            except (OSError, BlockTransferError, KvIntegrityError):
                 return -1, None, desc
 
         results = await asyncio.gather(
@@ -839,7 +985,8 @@ class RemoteKvFetcher:
                     timeout=budget,
                 )
                 return found
-            except (OSError, BlockTransferError, asyncio.TimeoutError):
+            except (OSError, BlockTransferError, KvIntegrityError,
+                    asyncio.TimeoutError):
                 log.exception("chunked G4 fetch from %s failed",
                               desc.worker_id)
         return 0  # every holder failed or the stream deadline passed

@@ -81,7 +81,14 @@ def build_parser() -> argparse.ArgumentParser:
                         "(KVBM G3, spill target of G2); 0 disables")
     p.add_argument("--disk-offload-path", default=cfg.disk_offload_path,
                    help="backing file for the G3 pool "
-                        "(default: fresh tempfile)")
+                        "(default: fresh tempfile); with a path the "
+                        "tier journals a sidecar manifest and survives "
+                        "engine restarts")
+    p.add_argument("--scrub-on-start", action="store_true",
+                   default=cfg.scrub_on_start,
+                   help="eagerly re-checksum every G3 manifest entry at "
+                        "attach, dropping torn/corrupt blocks as misses "
+                        "(default: lazy verify at onboard gather)")
     # chunk-pipelined KV transfer plane (kv_transfer.py)
     p.add_argument("--kv-transfer-chunk-pages", type=int,
                    default=cfg.kv_transfer_chunk_pages,
@@ -493,6 +500,7 @@ def build_chain(args) -> "Any":
             host_offload_pages=args.host_offload_pages,
             disk_offload_pages=args.disk_offload_pages,
             disk_offload_path=args.disk_offload_path,
+            scrub_on_start=args.scrub_on_start,
             speculative=args.speculative,
             num_speculative_tokens=args.num_speculative_tokens,
             spec_adaptive=args.spec_adaptive == "on",

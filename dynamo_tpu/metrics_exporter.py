@@ -172,6 +172,7 @@ class MetricsExporter:
               "workers in the last load-plane snapshot", len(snap.metrics))
         # resilience + KV-transfer + overload planes: process-local
         # counters, same families on every scrape surface
+        from dynamo_tpu.kv_integrity import KV_INTEGRITY
         from dynamo_tpu.kv_quant import KV_QUANT
         from dynamo_tpu.kv_transfer_metrics import KV_TRANSFER
         from dynamo_tpu.overload import OVERLOAD
@@ -179,7 +180,7 @@ class MetricsExporter:
 
         return ("\n".join(lines) + "\n" + RESILIENCE.render()
                 + KV_TRANSFER.render() + KV_QUANT.render()
-                + OVERLOAD.render())
+                + KV_INTEGRITY.render() + OVERLOAD.render())
 
     async def handle_metrics(self, request: web.Request) -> web.Response:
         return web.Response(

@@ -24,6 +24,19 @@ side of the push-RPC plane — exactly where a real worker death manifests):
                  Retry-After hint) — exercises the whole 429/spill/
                  backpressure machinery without generating real load
 
+KV data-integrity points (kv_integrity.py plane — all three corrupt
+*copies* of KV bytes in flight, never a pool, so detection-and-recompute
+is the only way back to correct tokens):
+
+  flip_kv_bits   flip one random bit per fired page in a tier gather's
+                 output (G2/G3 onboard path) — silent DRAM/disk rot
+  corrupt_frame  flip one byte of an outgoing kv_transfer payload frame
+                 (on a copy; the sender's pool stays clean) — wire/DMA
+                 corruption, caught by the receiver's kv_crc verify
+  truncate_g3    zero the tail half of the G3 pool before a gather —
+                 lost/torn disk writes (a live ftruncate would SIGBUS
+                 through the active mmap)
+
 Entry grammar: comma-separated ``name[:key=value]*`` with keys
 ``p`` (probability, default 1), ``t`` (seconds), ``after`` (output count).
 """
@@ -35,12 +48,14 @@ import random
 from dataclasses import dataclass, field
 from typing import Any, AsyncIterator, Optional
 
+import numpy as np
+
 from dynamo_tpu.resilience.metrics import RESILIENCE
 
 log = logging.getLogger(__name__)
 
 POINT_NAMES = ("kill_worker", "stall_stream", "drop_response", "delay",
-               "storm")
+               "storm", "flip_kv_bits", "corrupt_frame", "truncate_g3")
 
 
 class ChaosInjectedError(ConnectionResetError):
@@ -153,6 +168,49 @@ class ChaosHooks:
             return False
         self._record(p)
         return True
+
+    def fire(self, name: str) -> bool:
+        """Synchronous one-roll injection check for data-path points
+        (truncate_g3): True when the armed point fires this call."""
+        p = self.points.get(name)
+        return p is not None and self._fire(p)
+
+    def maybe_flip_bits(self, arr) -> int:
+        """flip_kv_bits: per page of a gathered KV batch ``[2, L, kvh,
+        n, ps, hd]`` (a contiguous copy, never a pool), roll the point's
+        probability and flip one random bit. Returns pages flipped."""
+        p = self.points.get("flip_kv_bits")
+        if p is None or not p.armed or arr is None:
+            return 0
+        u8 = np.ascontiguousarray(arr).view(np.uint8)
+        flipped = 0
+        for i in range(arr.shape[3]):
+            if not p.armed or self.rng.random() >= p.probability:
+                continue
+            idx = tuple(
+                self.rng.randrange(d) if ax != 3 else i
+                for ax, d in enumerate(u8.shape)
+            )
+            u8[idx] ^= 1 << self.rng.randrange(8)
+            self._record(p)
+            flipped += 1
+        if flipped and not np.may_share_memory(u8, arr):
+            # ascontiguousarray copied (non-contiguous input): write the
+            # damage back so the caller's array actually carries it
+            arr[...] = u8.view(arr.dtype).reshape(arr.shape)
+        return flipped
+
+    def maybe_corrupt_frame(self, payload: np.ndarray) -> np.ndarray:
+        """corrupt_frame: flip one byte of an outgoing wire payload on a
+        COPY (zero-copy sends alias live pools; chaos must corrupt the
+        wire, not the sender's cache). Returns the array to transmit."""
+        p = self.points.get("corrupt_frame")
+        if p is None or not self._fire(p) or payload.size == 0:
+            return payload
+        dirty = np.ascontiguousarray(payload).copy()
+        u8 = dirty.view(np.uint8).reshape(-1)
+        u8[self.rng.randrange(u8.size)] ^= 1 << self.rng.randrange(8)
+        return dirty
 
     async def maybe_stall(self, name: str, n_outputs: int) -> bool:
         """Public injection hook for non-stream data paths (the disagg

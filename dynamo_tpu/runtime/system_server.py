@@ -153,13 +153,14 @@ class SystemServer:
                     ))
         # resilience + KV-transfer + overload planes: counters of THIS
         # process
+        from dynamo_tpu.kv_integrity import KV_INTEGRITY
         from dynamo_tpu.kv_quant import KV_QUANT
         from dynamo_tpu.kv_transfer_metrics import KV_TRANSFER
         from dynamo_tpu.overload import OVERLOAD
 
         return ("\n".join(lines) + "\n" + RESILIENCE.render()
                 + KV_TRANSFER.render() + KV_QUANT.render()
-                + OVERLOAD.render())
+                + KV_INTEGRITY.render() + OVERLOAD.render())
 
     async def handle_metrics(self, request: web.Request) -> web.Response:
         return web.Response(text=self.render(), content_type="text/plain")

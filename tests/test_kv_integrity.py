@@ -1,0 +1,616 @@
+"""KV data-integrity plane tests (kv_integrity.py).
+
+Keystones: (1) injected corruption at every tier boundary — a G2/G3
+bit-flip, a torn G3 file, a corrupted wire frame — is DETECTED by the
+content checksums, the poisoned block is quarantined, and the stream's
+output stays token-identical to the clean run (corruption costs latency,
+never wrong tokens); (2) the G3 disk tier is crash-consistent: a
+snapshot of its mid-life on-disk state (pool + journal manifest)
+reattaches on a fresh engine, the startup scrub recovers fully-written
+blocks and drops torn entries as plain misses.
+"""
+import asyncio
+import importlib.util
+import json
+import os
+import shutil
+import time
+from dataclasses import replace
+
+import numpy as np
+import pytest
+
+from dynamo_tpu.config import load_config
+from dynamo_tpu.engine.config import EngineConfig
+from dynamo_tpu.engine.engine import TpuEngine
+from dynamo_tpu.engine.offload import DiskOffloadTier, HostOffloadTier
+from dynamo_tpu.kv_integrity import (
+    KV_INTEGRITY,
+    KvIntegrityError,
+    KvQuarantine,
+    page_checksum,
+    page_checksums,
+    verify_wire_payload,
+)
+from dynamo_tpu.kv_quant import QuantizedPages
+from dynamo_tpu.kv_transfer import (
+    BlockTransferServer,
+    encode_frame2,
+    read_frame2,
+    read_remote_pages,
+    write_pages_stream,
+    write_remote_pages,
+)
+from dynamo_tpu.models import llama
+from dynamo_tpu.models.config import ModelConfig
+from dynamo_tpu.parallel.mesh import MeshConfig
+from dynamo_tpu.protocols.common import PreprocessedRequest, StopConditions
+from dynamo_tpu.resilience.chaos import CHAOS
+from dynamo_tpu.tokens import TokenBlockSequence
+
+PS = 16
+SHAPE = (2, 2, 1, PS, 4)  # (2, L, kvh, ps, hd)
+
+
+@pytest.fixture(autouse=True)
+def _clean_chaos():
+    CHAOS.reset()
+    yield
+    CHAOS.reset()
+
+
+def _pages(n, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.standard_normal(
+        SHAPE[:3] + (n,) + SHAPE[3:]
+    ).astype(np.float32)
+
+
+# ---------------------------------------------------------------------------
+# checksum primitives
+
+
+def test_page_checksum_layout_invariant_and_sensitive():
+    batch = _pages(3)
+    # a strided pool slice and its dense copy must agree (tobytes is
+    # C-order regardless of strides)
+    assert page_checksum(batch[:, :, :, 1]) == page_checksum(
+        np.ascontiguousarray(batch[:, :, :, 1])
+    )
+    crcs = page_checksums(batch)
+    assert len(crcs) == 3 and len(set(crcs)) == 3
+    # one flipped bit anywhere changes the page's checksum
+    dirty = batch.copy()
+    dirty.view(np.uint8).reshape(-1)[123] ^= 1
+    assert page_checksums(dirty) != crcs
+
+
+def test_page_checksums_cover_int8_scales():
+    data = np.arange(2 * 2 * 1 * 2 * PS * 4, dtype=np.int8).reshape(
+        2, 2, 1, 2, PS, 4
+    )
+    scales = np.ones((2, 2, 2), np.float32)
+    q = QuantizedPages(data=data, scales=scales)
+    crcs = page_checksums(q)
+    # a flipped SCALE must fail verification exactly like a payload bit
+    bad = QuantizedPages(data=data, scales=scales.copy())
+    bad.scales[0, 0, 1] = 2.0
+    crcs2 = page_checksums(bad)
+    assert crcs2[0] == crcs[0] and crcs2[1] != crcs[1]
+
+
+def test_verify_wire_payload_typed_error():
+    batch = _pages(2, seed=1)
+    header = {"kv_crc": page_checksums(batch)}
+    verify_wire_payload(header, batch)  # clean: no raise
+    verify_wire_payload({}, batch)  # pre-integrity peer: passes
+    dirty = batch.copy()
+    dirty[:, :, :, 1] += 1.0
+    with pytest.raises(KvIntegrityError) as ei:
+        verify_wire_payload(header, dirty, context="test")
+    assert ei.value.bad_pages == (1,)
+
+
+def test_quarantine_ttl_and_cap():
+    q = KvQuarantine(ttl_s=0.05, max_entries=4)
+    assert q.add(7) is True
+    assert q.add(7) is False  # no double count
+    assert 7 in q and len(q) == 1
+    time.sleep(0.06)
+    assert 7 not in q and len(q) == 0  # TTL lapsed: readmittable
+    # capacity cap bounds memory under a corruption storm
+    assert q.add_all(range(10)) == 10
+    assert len(q) <= 4
+
+
+# ---------------------------------------------------------------------------
+# chaos injection points
+
+
+def test_chaos_grammar_parses_integrity_points():
+    CHAOS.configure("flip_kv_bits:p=0.5,corrupt_frame:once,truncate_g3")
+    assert CHAOS.points["flip_kv_bits"].armed
+    assert CHAOS.points["flip_kv_bits"].probability == 0.5
+    assert CHAOS.points["corrupt_frame"].once
+    assert CHAOS.points["truncate_g3"].armed
+
+
+def test_flip_kv_bits_mutates_each_page():
+    CHAOS.arm("flip_kv_bits", probability=1.0)
+    batch = _pages(3, seed=2)
+    clean = batch.copy()
+    assert CHAOS.maybe_flip_bits(batch) == 3
+    for i in range(3):
+        assert not np.array_equal(batch[:, :, :, i], clean[:, :, :, i])
+
+
+def test_corrupt_frame_hits_copy_not_source():
+    CHAOS.arm("corrupt_frame", once=True)
+    payload = _pages(1, seed=3)
+    clean = payload.copy()
+    dirty = CHAOS.maybe_corrupt_frame(payload)
+    assert not np.array_equal(dirty, clean)
+    np.testing.assert_array_equal(payload, clean)  # source untouched
+    # once-fuse consumed: next call passes through
+    assert CHAOS.maybe_corrupt_frame(payload) is payload
+
+
+# ---------------------------------------------------------------------------
+# tier verify + quarantine
+
+
+def test_tier_verify_detects_corruption_and_quarantine_refuses():
+    q = KvQuarantine()
+    t = HostOffloadTier(4, SHAPE, np.float32, quarantine=q)
+    batch = _pages(3, seed=4)
+    assert t.put_batch([1, 2, 3], [0, 1, 2], batch) == 3
+    got = t.gather([1, 2, 3])
+    assert t.verify_pages([1, 2, 3], got) == []
+    got[:, :, :, 1] += 1.0  # in-flight rot on the gathered copy
+    assert t.verify_pages([1, 2, 3], got) == [1]
+    # quarantined hashes are refused re-admission and dropped everywhere
+    q.add(2)
+    t.drop_everywhere(2)
+    assert 2 not in t
+    assert t.put_one(2, 1, batch[:, :, :, 1]) is False
+    assert t.lookup_run([1, 2, 3]) == [(1, 0)]
+
+
+def test_checksum_travels_down_the_spill(tmp_path):
+    disk = DiskOffloadTier(4, SHAPE, np.float32,
+                           path=str(tmp_path / "g3.mmap"))
+    t = HostOffloadTier(1, SHAPE, np.float32, spill=disk)
+    batch = _pages(2, seed=5)
+    t.put_batch([1], [0], batch[:, :, :, :1])
+    crc = t.checksum_of(1)
+    assert crc is not None
+    t.put_batch([2], [1], batch[:, :, :, 1:])  # capacity 1: spills 1
+    assert 1 in disk
+    # G3 inherits G2's seal-time crc (no re-mint over DRAM bytes)
+    assert disk.checksum_of(1) == crc
+    assert t.checksum_of(1) == crc  # falls through the tier walk
+    disk.close()
+
+
+# ---------------------------------------------------------------------------
+# G3 crash consistency: manifest journal + startup scrub
+
+
+def test_g3_manifest_restart_survival(tmp_path):
+    path = str(tmp_path / "g3.mmap")
+    disk = DiskOffloadTier(4, SHAPE, np.float32, path=path)
+    batch = _pages(3, seed=6)
+    disk.put_batch([11, 12, 13], [0, 11, 12], batch)
+    crcs = [disk.checksum_of(h) for h in (11, 12, 13)]
+    # crash: abandon the tier without close() — the journal was flushed
+    # per record, the pool through the OS page cache
+    del disk
+
+    disk2 = DiskOffloadTier(4, SHAPE, np.float32, path=path,
+                            scrub_on_start=True)
+    assert disk2.scrub_recovered == 3 and disk2.scrub_dropped == 0
+    assert disk2.lookup_run([11, 12, 13]) == [(11, 0), (12, 11), (13, 12)]
+    np.testing.assert_array_equal(disk2.gather([11, 12, 13]), batch)
+    assert [disk2.checksum_of(h) for h in (11, 12, 13)] == crcs
+    disk2.close()
+
+
+def test_g3_scrub_drops_torn_and_corrupt_entries(tmp_path):
+    path = str(tmp_path / "g3.mmap")
+    disk = DiskOffloadTier(4, SHAPE, np.float32, path=path)
+    batch = _pages(3, seed=7)
+    disk.put_batch([21, 22, 23], [0, 21, 22], batch)
+    slot_22 = disk._index[22][0]
+    del disk  # crash without close
+
+    # journal damage: a torn tail (partial write) + an out-of-range slot
+    with open(path + ".manifest", "a") as f:
+        f.write(json.dumps({"put": 99, "parent": 0, "slot": 77,
+                            "crc": 1, "scale": None}) + "\n")
+        f.write('{"put": 100, "par')  # torn mid-record
+    # at-rest rot: flip a byte inside 22's page region
+    pool = np.memmap(path, dtype=np.float32, mode="r+",
+                     shape=(2, 2, 1, 4, PS, 4))
+    pool[0, 0, 0, slot_22, 0, 0] += 1.0
+    pool.flush()
+    del pool
+
+    disk2 = DiskOffloadTier(4, SHAPE, np.float32, path=path,
+                            scrub_on_start=True)
+    # 21 and 23 come back; 22 (rotted), 99 (bad slot) and the torn line
+    # are dropped as misses — never served
+    assert 21 in disk2 and 23 in disk2 and 22 not in disk2
+    assert 99 not in disk2
+    assert disk2.scrub_recovered == 2 and disk2.scrub_dropped >= 3
+    np.testing.assert_array_equal(disk2.read_page(21), batch[:, :, :, 0])
+    disk2.close()
+
+
+def test_g3_truncated_file_extends_and_drops_tail(tmp_path):
+    """A file truncated mid-growth (crash) reattaches: sparse-extended
+    to full size, entries whose bytes were lost fail crc -> misses."""
+    path = str(tmp_path / "g3.mmap")
+    disk = DiskOffloadTier(4, SHAPE, np.float32, path=path)
+    batch = _pages(4, seed=8)
+    disk.put_batch([1, 2, 3, 4], [0, 1, 2, 3], batch)
+    nbytes = os.path.getsize(path)
+    del disk
+    # lose the file's tail: in the pool's C layout that zeroes the last
+    # page-slot's final rows (a torn last write), leaving earlier slots
+    # byte-complete
+    os.truncate(path, nbytes - 100)
+
+    disk2 = DiskOffloadTier(4, SHAPE, np.float32, path=path,
+                            scrub_on_start=True)
+    assert os.path.getsize(path) == nbytes  # sparse re-extended
+    # some slots survived, the zeroed tail was dropped — and nothing
+    # that IS served mismatches its crc
+    assert 1 <= disk2.scrub_recovered < 4
+    for h in (1, 2, 3, 4):
+        if h in disk2:
+            i = h - 1
+            np.testing.assert_array_equal(
+                disk2.read_page(h), batch[:, :, :, i]
+            )
+    disk2.close()
+
+
+def test_stale_manifest_without_pool_starts_clean(tmp_path):
+    path = str(tmp_path / "g3.mmap")
+    with open(path + ".manifest", "w") as f:
+        f.write(json.dumps({"g3_manifest": 1}) + "\n")
+    disk = DiskOffloadTier(4, SHAPE, np.float32, path=path)
+    assert len(disk) == 0
+    assert not os.path.exists(path + ".manifest")
+    disk.close()
+
+
+# ---------------------------------------------------------------------------
+# wire: receiver verify, typed nacks, retry-once, frame hardening
+
+
+def _mk_pool_server():
+    pool = {"data": np.zeros(SHAPE[:3] + (8,) + SHAPE[3:], np.float32)}
+
+    def read_fn(pages):
+        return pool["data"][:, :, :, pages]
+
+    def write_fn(pages, data):
+        pool["data"][:, :, :, pages] = data
+
+    return pool, BlockTransferServer(read_fn=read_fn, write_fn=write_fn)
+
+
+async def test_wire_corruption_nacked_then_retried_once():
+    pool, srv = _mk_pool_server()
+    host, port = await srv.start()
+    try:
+        payload = _pages(2, seed=9)
+        before = KV_INTEGRITY.get("dynamo_kv_integrity_retries_total")
+        # one-shot wire corruption: first send nacked (bytes never reach
+        # the pool), automatic retry lands clean
+        CHAOS.arm("corrupt_frame", once=True)
+        await write_remote_pages(host, port, [0, 1], payload)
+        np.testing.assert_array_equal(pool["data"][:, :, :, [0, 1]],
+                                      payload)
+        assert KV_INTEGRITY.get(
+            "dynamo_kv_integrity_retries_total"
+        ) == before + 1
+
+        # persistent corruption: the retry fails too and the typed error
+        # reaches the caller's fallback path — the pool stays clean
+        CHAOS.arm("corrupt_frame", probability=1.0)
+        with pytest.raises(KvIntegrityError):
+            await write_remote_pages(host, port, [2, 3], payload)
+        assert not pool["data"][:, :, :, [2, 3]].any()
+    finally:
+        await srv.stop()
+
+
+async def test_wire_read_verified_client_side():
+    pool, srv = _mk_pool_server()
+    host, port = await srv.start()
+    try:
+        payload = _pages(2, seed=10)
+        await write_remote_pages(host, port, [4, 5], payload)
+        got = await read_remote_pages(host, port, [4, 5])
+        np.testing.assert_array_equal(got, payload)
+        # corruption on the read direction is caught by the client
+        CHAOS.arm("corrupt_frame", probability=1.0)
+        with pytest.raises(KvIntegrityError):
+            await read_remote_pages(host, port, [4, 5])
+    finally:
+        await srv.stop()
+
+
+async def test_stream_integrity_nack_replays_once():
+    pool, srv = _mk_pool_server()
+    host, port = await srv.start()
+    try:
+        payload = _pages(4, seed=11)
+        chunks = [([0, 1], payload[:, :, :, :2]),
+                  ([2, 3], payload[:, :, :, 2:])]
+        before = KV_INTEGRITY.get("dynamo_kv_integrity_retries_total")
+        CHAOS.arm("corrupt_frame", once=True)
+        # the corrupted chunk is rejected BEFORE its scatter, the eof ack
+        # carries the typed nack, and the whole stream replays clean
+        assert await write_pages_stream(host, port, chunks) == 2
+        np.testing.assert_array_equal(pool["data"][:, :, :, :4], payload)
+        assert KV_INTEGRITY.get(
+            "dynamo_kv_integrity_retries_total"
+        ) == before + 1
+    finally:
+        await srv.stop()
+
+
+async def test_malformed_frame_typed_nack_connection_survives():
+    """A header whose geometry doesn't match the payload byte count is
+    rejected with a typed error frame — not an unhandled ValueError that
+    kills the connection: the SAME connection then serves a clean op."""
+    pool, srv = _mk_pool_server()
+    host, port = await srv.start()
+    reader, writer = await asyncio.open_connection(host, port)
+    try:
+        payload = _pages(1, seed=12)
+        raw = np.ascontiguousarray(payload).view(np.uint8).reshape(-1)
+        # claim 2 pages but ship 1 page of bytes
+        writer.write(encode_frame2(
+            {"op": "write_pages", "pages": [0, 1], "dtype": "float32",
+             "shape": [2, 2, 1, 2, PS, 4]}, raw.tobytes(),
+        ))
+        await writer.drain()
+        header, _ = await read_frame2(reader)
+        assert header.get("ok") is False
+        assert header.get("kind") == "frame"
+        # connection survived: a well-formed write on the same socket
+        writer.write(encode_frame2(
+            {"op": "write_pages", "pages": [6], "dtype": "float32",
+             "shape": [2, 2, 1, 1, PS, 4]}, raw.tobytes(),
+        ))
+        await writer.drain()
+        header, _ = await read_frame2(reader)
+        assert header.get("ok") is True
+        np.testing.assert_array_equal(pool["data"][:, :, :, 6],
+                                      payload[:, :, :, 0])
+    finally:
+        writer.close()
+        await srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# engine integration: quarantine-and-recompute, token-identical output
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = ModelConfig.tiny(dtype="float32")
+    # SMALL HBM pool (12 usable pages) + host tier: pressure evicts fast
+    ecfg = EngineConfig(
+        num_pages=13, page_size=PS, max_pages_per_seq=8,
+        max_decode_slots=2, prefill_buckets=(32, 64),
+        cache_dtype="float32", host_offload_pages=16, offload_batch=8,
+    )
+    params = llama.init_params(cfg, 0)
+    return cfg, ecfg, params
+
+
+def mk_engine(setup, **kw):
+    cfg, ecfg, params = setup
+    if kw:
+        ecfg = replace(ecfg, **kw)
+    return TpuEngine(cfg, ecfg, params=params, mesh_config=MeshConfig(tp=1))
+
+
+async def collect(engine, req):
+    toks = []
+    async for out in engine.generate(req):
+        toks.extend(out.token_ids)
+    return toks
+
+
+def req_for(prompt, n_new=6):
+    return PreprocessedRequest(
+        token_ids=list(prompt),
+        stop_conditions=StopConditions(max_tokens=n_new, ignore_eos=True),
+    )
+
+
+async def _evict_to_host(eng, prompt_a):
+    """Run prompt_a, then pressure the 12-page HBM pool until its prefix
+    blocks live only in the host tiers. Returns A's 3 block hashes."""
+    await collect(eng, req_for(prompt_a))
+    for _ in range(200):
+        spill = getattr(eng.offload, "spill", None)
+        if len(eng.offload) + (len(spill) if spill else 0) >= 3:
+            break
+        await asyncio.sleep(0.02)
+    for base in (100, 200, 300, 400):
+        await collect(eng, req_for(list(range(base, base + 49))))
+        await asyncio.sleep(0.05)
+    seq = TokenBlockSequence.from_tokens(prompt_a, PS, salt="")
+    hashes = seq.block_hashes()[:3]
+    assert eng.allocator.cached_prefix_len(hashes) == 0, \
+        "test premise: A's blocks must be evicted from HBM"
+    return hashes
+
+
+async def test_g2_bitflip_quarantined_and_token_identical(setup):
+    """The tier-1 chaos smoke: a bit-flip in a G2-resident page is caught
+    at onboard admission, the block is quarantined, the affected prefix
+    recomputes as prefill — and the stream is token-identical."""
+    eng = mk_engine(setup)
+    prompt_a = list(range(1, 50))  # 3 complete blocks + tail
+    ref = await collect(mk_engine(setup, host_offload_pages=0),
+                        req_for(prompt_a))
+    hashes = await _evict_to_host(eng, prompt_a)
+    assert all(h in eng.offload for h in hashes), \
+        "test premise: A's blocks must sit in G2"
+
+    # silent DRAM rot: flip one byte of the MIDDLE block's pool bytes
+    slot = eng.offload._index[hashes[1]][0]
+    eng.offload._pool[:, :, :, slot].view(np.uint8)[0, 0, 0, 0, 1] ^= 1
+
+    before = KV_INTEGRITY.snapshot()
+    out_a2 = await collect(eng, req_for(prompt_a))
+    assert out_a2 == ref  # corruption costs latency, never wrong tokens
+    after = KV_INTEGRITY.snapshot()
+    assert after["dynamo_kv_integrity_failed_total"] > \
+        before["dynamo_kv_integrity_failed_total"]
+    assert after["dynamo_kv_integrity_quarantined_total"] == \
+        before["dynamo_kv_integrity_quarantined_total"] + 1
+    # block 1 AND everything behind it recomputed (the run is truncated
+    # at the first bad block — later blocks hang off a corrupt prefix)
+    assert after["dynamo_kv_integrity_recomputed_total"] >= \
+        before["dynamo_kv_integrity_recomputed_total"] + 2
+    assert hashes[1] in eng.kv_quarantine
+    assert hashes[1] not in eng.offload  # dropped from every tier
+    await eng.stop()
+
+
+async def test_chaos_flip_storm_token_identical(setup):
+    """flip_kv_bits armed at p=1: EVERY onboard gather is corrupted, so
+    every prefix hit degrades to recompute — output still identical."""
+    eng = mk_engine(setup)
+    prompt_a = list(range(1, 50))
+    ref = await collect(mk_engine(setup, host_offload_pages=0),
+                        req_for(prompt_a))
+    await _evict_to_host(eng, prompt_a)
+    CHAOS.arm("flip_kv_bits", probability=1.0)
+    out = await collect(eng, req_for(prompt_a))
+    assert out == ref
+    assert CHAOS.points["flip_kv_bits"].injected_total >= 1
+    CHAOS.reset()
+    # quarantine TTL'd entries flush; a later clean re-send still works
+    out2 = await collect(eng, req_for(prompt_a))
+    assert out2 == ref
+    await eng.stop()
+
+
+async def test_g3_engine_crash_restart_scrub_token_identical(
+    setup, tmp_path
+):
+    """Acceptance pin: 'kill' the engine mid-life (snapshot the G3 pool +
+    journal as they are on disk, no clean close), restart against the
+    snapshot with --scrub-on-start: fully-written blocks are recovered
+    and served, a torn journal tail is dropped as a miss, and the re-sent
+    prompt is token-identical."""
+    path = str(tmp_path / "g3.mmap")
+    eng = mk_engine(setup, host_offload_pages=2, disk_offload_pages=16,
+                    disk_offload_path=path)
+    prompt_a = list(range(1, 50))
+    ref = await collect(mk_engine(setup, host_offload_pages=0),
+                        req_for(prompt_a))
+    hashes = await _evict_to_host(eng, prompt_a)
+    assert sum(h in eng.offload.spill for h in hashes) >= 1, \
+        "test premise: G2 pressure must spill A to disk"
+
+    # crash snapshot: the on-disk state at kill time, BEFORE the clean
+    # close's compaction — journal puts/drops as they were flushed
+    crash_path = str(tmp_path / "g3-crash.mmap")
+    shutil.copy(path, crash_path)
+    shutil.copy(path + ".manifest", crash_path + ".manifest")
+    with open(crash_path + ".manifest", "a") as f:
+        f.write('{"put": 424242, "sl')  # torn mid-write record
+    await eng.stop()
+
+    before = KV_INTEGRITY.snapshot()
+    eng2 = mk_engine(setup, host_offload_pages=2, disk_offload_pages=16,
+                     disk_offload_path=crash_path, scrub_on_start=True)
+    spill = eng2.offload.spill
+    assert spill.scrub_recovered >= 1
+    assert spill.scrub_dropped >= 1  # the torn line
+    assert 424242 not in spill
+    after = KV_INTEGRITY.snapshot()
+    assert after["dynamo_kv_integrity_g3_scrub_recovered_total"] > \
+        before["dynamo_kv_integrity_g3_scrub_recovered_total"]
+    assert after["dynamo_kv_integrity_g3_scrub_dropped_total"] > \
+        before["dynamo_kv_integrity_g3_scrub_dropped_total"]
+
+    out = await collect(eng2, req_for(prompt_a))
+    assert out == ref
+    await eng2.stop()
+
+
+async def test_g3_truncation_chaos_token_identical(setup, tmp_path):
+    """truncate_g3 fired before an onboard gather: blocks in the zeroed
+    tail fail admission, quarantine + recompute keep tokens identical."""
+    eng = mk_engine(setup, host_offload_pages=2, disk_offload_pages=16,
+                    disk_offload_path=str(tmp_path / "g3.mmap"))
+    prompt_a = list(range(1, 50))
+    ref = await collect(mk_engine(setup, host_offload_pages=0),
+                        req_for(prompt_a))
+    hashes = await _evict_to_host(eng, prompt_a)
+    assert sum(h in eng.offload.spill for h in hashes) >= 1, \
+        "test premise: G2 pressure must spill A to disk"
+    CHAOS.arm("truncate_g3", once=True)
+    out = await collect(eng, req_for(prompt_a))
+    assert out == ref
+    await eng.stop()
+
+
+# ---------------------------------------------------------------------------
+# config plumbing + offline scrub tool
+
+
+def test_scrub_on_start_env_plumbing():
+    cfg = load_config(env={"DYNTPU_SCRUB_ON_START": "1"})
+    assert cfg.scrub_on_start is True
+    assert load_config(env={}).scrub_on_start is False
+    assert EngineConfig(num_pages=8, page_size=PS).scrub_on_start is False
+
+
+def _load_scrub_tool():
+    spec = importlib.util.spec_from_file_location(
+        "scrub_kv", os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "tools", "scrub_kv.py",
+        ),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_scrub_tool_clean_and_corrupt_exit_codes(tmp_path, capsys):
+    scrub_kv = _load_scrub_tool()
+    path = str(tmp_path / "g3.mmap")
+    disk = DiskOffloadTier(4, SHAPE, np.float32, path=path)
+    batch = _pages(2, seed=13)
+    disk.put_batch([1, 2], [0, 1], batch)
+    slot = disk._index[2][0]
+    disk.close()
+
+    assert scrub_kv.main([path]) == 0
+    report = scrub_kv.scrub(path, path + ".manifest")
+    assert report["verified"] == 2 and report["corrupt"] == 0
+
+    pool = np.memmap(path, dtype=np.float32, mode="r+",
+                     shape=(2, 2, 1, 4, PS, 4))
+    pool[1, 0, 0, slot, 3, 1] += 0.5
+    pool.flush()
+    del pool
+    assert scrub_kv.main([path]) == 1
+    report = scrub_kv.scrub(path, path + ".manifest")
+    assert report["verified"] == 1 and report["corrupt"] == 1
+
+    assert scrub_kv.main([str(tmp_path / "missing.mmap")]) == 2

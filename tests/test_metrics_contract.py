@@ -160,6 +160,14 @@ def test_readme_documents_canonical_series():
         "dynamo_kv_quant_scale_bytes_total",
         "dynamo_kv_quant_dequant_seconds",
         "dynamo_kv_pool_capacity_blocks",
+        # KV data-integrity plane (dynamo_tpu/kv_integrity.py)
+        "dynamo_kv_integrity_verified_total",
+        "dynamo_kv_integrity_failed_total",
+        "dynamo_kv_integrity_quarantined_total",
+        "dynamo_kv_integrity_recomputed_total",
+        "dynamo_kv_integrity_retries_total",
+        "dynamo_kv_integrity_g3_scrub_recovered_total",
+        "dynamo_kv_integrity_g3_scrub_dropped_total",
         # overload-protection plane (dynamo_tpu/overload/)
         "dynamo_overload_rejected_total",
         "dynamo_overload_shed_total",

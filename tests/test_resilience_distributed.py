@@ -144,7 +144,8 @@ async def test_system_server_chaos_control():
         resp = await c.get("/chaos")
         names = {p["name"] for p in (await resp.json())["points"]}
         assert names == {"kill_worker", "stall_stream", "drop_response",
-                         "delay", "storm"}
+                         "delay", "storm", "flip_kv_bits",
+                         "corrupt_frame", "truncate_g3"}
         resp = await c.post("/chaos", json={
             "point": "kill_worker", "probability": 0.5,
             "after_outputs": 3, "once": True,
