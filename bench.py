@@ -184,6 +184,7 @@ async def run_bench() -> dict:
     eng.telemetry.reset()
     steps0 = eng.step_count
     disp0 = dict(eng.dispatch_counts)
+    prof0 = eng.prof.totals()
     t0 = time.monotonic()
     results = await asyncio.gather(
         *[drive(make_req(max_tokens), t0) for _ in range(n_requests)]
@@ -201,6 +202,22 @@ async def run_bench() -> dict:
     itl_p50 = h_itl.percentile(0.50)
     itl_p95 = h_itl.percentile(0.95)
     itl_p99 = h_itl.percentile(0.99)
+    # performance attribution: where phase B's host milliseconds went
+    # (per-segment prof delta over the measured window, ms per step)
+    # plus the SLO burn-rate gauges over this phase's TTFT/ITL
+    from dynamo_tpu.telemetry.prof import PROF
+
+    proft = eng.prof.totals()
+    host_breakdown = None
+    if steps and proft["rounds"] > prof0["rounds"]:
+        host_breakdown = {
+            s: round(
+                (proft["segments"][s] - prof0["segments"].get(s, 0.0))
+                / steps * 1e3, 5)
+            for s in proft["segments"]
+        }
+    PROF.fold_burn_rates(h_ttft.snapshot(), h_itl.snapshot())
+    slo_burn = PROF.burn_rates()
     await eng.stop()
 
     total_tokens = sum(n for _, n in results)
@@ -288,6 +305,9 @@ async def run_bench() -> dict:
         "device_ms_per_step": device_ms_per_step,
         "host_ms_per_step": host_ms_per_step,
         "dispatches_per_round": dispatches_per_round,
+        "host_breakdown": host_breakdown,
+        "slo_ttft_burn_rate": slo_burn.get("ttft"),
+        "slo_itl_burn_rate": slo_burn.get("itl"),
         "mfu": mfu,
         "roofline_frac": roofline_frac,
         "chip": chip,
@@ -680,7 +700,8 @@ def main():
               "ttft_p99_s", "itl_p50_s", "itl_p95_s", "itl_p99_s",
               "ttft_isolated_s", "decode_ms_per_step",
               "device_ms_per_step", "host_ms_per_step",
-              "dispatches_per_round", "mfu",
+              "dispatches_per_round", "host_breakdown",
+              "slo_ttft_burn_rate", "slo_itl_burn_rate", "mfu",
               "roofline_frac", "chip", "params_m", "batch",
               "core_error", "routing_error",
               "routing_kv_ttft_ms", "routing_random_ttft_ms",
@@ -705,6 +726,7 @@ def main():
               "disagg_ttft_speedup", "transfer_overlap_ratio",
               "disagg_chunks_streamed", "disagg_token_equal",
               "disagg_commit_wakeups", "disagg_poll_wakeups_saved",
+              "disagg_timeline_events", "disagg_timeline_stream_events",
               "disagg_error",
               # kv_quant phase (bench_modes.kv_quant_experiment):
               # int8-vs-bf16 pool A/B through the disagg relay —

@@ -570,6 +570,9 @@ async def disagg_experiment(
             "fallbacks": decode.remote_fallbacks,
             "chunks": pworker.chunks_streamed,
             "overlap": pworker.transfer_overlap_ratio,
+            # recent host-round attribution records, captured before the
+            # engine stops — the timeline validation below merges them
+            "rounds": decode_inner.prof.recent(16),
         }
         await pworker.stop()
         await relay.stop()
@@ -582,6 +585,45 @@ async def disagg_experiment(
 
     chunk_ttfts, chunk_outs, chunk_stats = await run_mode(
         "chunked", chunk_pages)
+
+    # timeline-exporter validation: build the merged Chrome trace for one
+    # chunked remote-prefill request (span tree + host-round segments +
+    # kv_transfer stream events — the same assembly tools/trace_export.py
+    # drives) and prove it round-trips through json.dumps/loads
+    tl_events = tl_stream = 0
+    try:
+        from dynamo_tpu.telemetry.timeline import (
+            COMMIT_WAKEUP,
+            EOF_ACK_WAIT,
+            FRAME_RECV,
+            FRAME_SEND,
+            STREAM_EVENTS,
+            to_chrome_trace,
+        )
+        from dynamo_tpu.telemetry.trace import TRACES
+
+        tr = None
+        for rid in reversed(TRACES.recent_ids(50)):
+            t = TRACES.get(rid)
+            if t is not None and t.spans:
+                tr = t.to_dict()
+                break
+        chrome = to_chrome_trace(
+            spans=list((tr or {}).get("spans") or []),
+            round_records=chunk_stats.get("rounds") or [],
+            stream_events=STREAM_EVENTS.snapshot(),
+            label=str((tr or {}).get("trace_id", "disagg")),
+        )
+        parsed = json.loads(json.dumps(chrome))
+        kinds = {FRAME_SEND, FRAME_RECV, EOF_ACK_WAIT, COMMIT_WAKEUP}
+        tl_events = len(parsed["traceEvents"])
+        tl_stream = sum(
+            1 for ev in parsed["traceEvents"]
+            if ev.get("ph") == "X" and ev.get("name") in kinds
+        )
+    except Exception:  # noqa: BLE001 — validation is best-effort
+        pass
+
     mono_ttfts, mono_outs, mono_stats = await run_mode("mono", 0)
     server.close()
 
@@ -608,6 +650,8 @@ async def disagg_experiment(
             if chunk_stats["overlap"] is not None else None
         ),
         "disagg_chunks_streamed": chunk_stats["chunks"],
+        "disagg_timeline_events": tl_events,
+        "disagg_timeline_stream_events": tl_stream,
         "disagg_remote_prefills": (
             chunk_stats["remote"] + mono_stats["remote"]
         ),

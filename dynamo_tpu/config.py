@@ -73,6 +73,13 @@ class RuntimeConfig:
     max_waiting_requests: int = 0
     max_waiting_prefill_tokens: int = 0
     preempt_running: bool = False
+    # performance-attribution plane (telemetry/prof.py): per-round
+    # host-segment timers + the SLO burn-rate gauges
+    # dynamo_slo_{ttft,itl}_burn_rate over these targets
+    prof_attribution: bool = True
+    slo_ttft_target_s: float = 0.5
+    slo_itl_target_s: float = 0.05
+    slo_objective: float = 0.99
 
     @property
     def store_host_port(self) -> tuple[str, int]:

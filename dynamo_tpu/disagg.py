@@ -39,6 +39,7 @@ from dynamo_tpu.kv_transfer import (
 )
 from dynamo_tpu.kv_transfer_metrics import KV_TRANSFER
 from dynamo_tpu.protocols.common import LLMEngineOutput, PreprocessedRequest
+from dynamo_tpu.telemetry import timeline as tl
 from dynamo_tpu.runtime.client import KvClient
 from dynamo_tpu.runtime.component import DistributedRuntime
 from dynamo_tpu.tokens import TokenBlockSequence
@@ -264,13 +265,17 @@ class PrefillWorker:
         if evt_task in done:
             self.commit_wakeups += 1
             self._commit_evt.clear()
+            wake = "commit"
         else:
             # leave the latch alone: a commit that fired while we woke
             # for a task completion must wake the NEXT wait immediately
             evt_task.cancel()
+            wake = "task"
             if not done:
                 self.timeout_wakeups += 1
+                wake = "timeout"
         waited = time.monotonic() - t0
+        tl.STREAM_EVENTS.record(tl.COMMIT_WAKEUP, waited, wake=wake)
         self.poll_wakeups_saved += max(
             0, int(waited / self.stream_poll_s) - 1
         )

@@ -178,6 +178,18 @@ class EngineConfig:
     # when an engine round fails
     flight_recorder_events: int = 256
 
+    # performance-attribution plane (telemetry/prof.py): per-round
+    # host-segment timers feeding dynamo_host_round_seconds{segment} and
+    # /debug/prof. Always-on by design (near-zero overhead, pinned by
+    # tests/test_prof.py); the switch exists for A/B measurement.
+    prof_attribution: bool = True
+    # SLO targets backing the dynamo_slo_{ttft,itl}_burn_rate gauges:
+    # burn rate = frac-of-observations-over-target / (1 - objective),
+    # recomputed from the live histograms at the metrics-publish cadence
+    slo_ttft_target_s: float = 0.5
+    slo_itl_target_s: float = 0.05
+    slo_objective: float = 0.99
+
     # model memory
     cache_dtype: str = "bfloat16"
     # paged-pool KV quantization: "none" (pool stores cache_dtype, the

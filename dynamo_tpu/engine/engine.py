@@ -91,7 +91,9 @@ from dynamo_tpu.telemetry import (
     request_histograms,
 )
 from dynamo_tpu.telemetry import metrics as tmetrics
-from dynamo_tpu.telemetry.trace import span_now
+from dynamo_tpu.telemetry import prof as tprof
+from dynamo_tpu.telemetry.prof import PROF, RoundProf
+from dynamo_tpu.telemetry.trace import Span, span_now
 from dynamo_tpu.tokens import TokenBlockSequence
 
 log = logging.getLogger(__name__)
@@ -108,6 +110,23 @@ def _span_dict(name: str, t0_monotonic: float, **attrs) -> dict:
     """Span ending now that began at monotonic ``t0_monotonic`` — the
     annotation-ready wire form (telemetry.trace.span_now)."""
     return span_now(name, t0_monotonic, **attrs).to_dict()
+
+
+# attribution-segment indices (telemetry/prof.py SEGMENTS), bound once so
+# the round loop's enter() calls pass ints, not strings
+_SEG_INTAKE = tprof.SEGMENTS.index("intake")
+_SEG_SLOT_SCAN = tprof.SEGMENTS.index("slot_scan")
+_SEG_FETCH = tprof.SEGMENTS.index("fetch")
+_SEG_ANNOTATE = tprof.SEGMENTS.index("annotate")
+_SEG_RELEASES = tprof.SEGMENTS.index("releases")
+_SEG_TRANSFER = tprof.SEGMENTS.index("transfer")
+_SEG_OFFLOAD = tprof.SEGMENTS.index("offload")
+_SEG_ADMIT = tprof.SEGMENTS.index("admit")
+_SEG_SEAL_ASM = tprof.SEGMENTS.index("seal_assembly")
+_SEG_DISPATCH = tprof.SEGMENTS.index("dispatch")
+_SEG_SPEC = tprof.SEGMENTS.index("spec_dispatch")
+_SEG_SEAL_FLUSH = tprof.SEGMENTS.index("seal_flush")
+_SEG_METRICS = tprof.SEGMENTS.index("metrics_fold")
 
 
 
@@ -215,6 +234,9 @@ class _Entry:
     aux: Any = None
     # telemetry: dispatch time, for dynamo_engine_round_seconds
     t_dispatch: float = 0.0
+    # spec verify: (draft_s, verify_s) host dispatch walls — become the
+    # spec_draft / spec_verify child spans under the round span
+    spec_host: Any = None
 
 
 # sentinel closing an export stream's chunk queue (engine loop -> consumer)
@@ -405,6 +427,13 @@ class TpuEngine:
         # the per-round cost is a timestamp compare, not 5 locked walks
         self._hist_snap: tuple[float, dict] = (0.0, {})
         self.flight = FlightRecorder(e.flight_recorder_events)
+        # performance-attribution plane (telemetry/prof.py): per-round
+        # host-segment switch timers, folded into the process-global
+        # PROF registry at the metrics-publish cadence and served at
+        # /debug/prof
+        self.prof = RoundProf(enabled=e.prof_attribution)
+        PROF.configure(e.slo_ttft_target_s, e.slo_itl_target_s,
+                       e.slo_objective)
 
         B = e.max_decode_slots
         self._B = B
@@ -1397,20 +1426,30 @@ class TpuEngine:
         """One scheduling round: process ready results, flush seal copies,
         apply patches (releases, admissions), dispatch a round of steps."""
         e = self.ecfg
+        prof = self.prof
+        prof.begin_round()
+        prof.enter(_SEG_INTAKE)
         self._drain_intake()
+        prof.enter(_SEG_SLOT_SCAN)
         self._enforce_bounds()
         rounds_in_flight = sum(1 for en in self._entries if en.kind == "round")
+        prof.enter(_SEG_FETCH)
         self._process_entries(block=rounds_in_flight > e.max_inflight_rounds)
         # seals queued by result processing are NOT flushed here: they
         # ride this round's fused dispatch (_dispatch_round). Pool
         # readers below (transfers, streams, offload, prefill_begin)
         # flush standalone first themselves.
+        prof.enter(_SEG_RELEASES)
         self._apply_releases()
+        prof.enter(_SEG_TRANSFER)
         self._process_transfers()
         stream_work = self._service_export_streams()
+        prof.enter(_SEG_OFFLOAD)
         self._dispatch_offloads()
         self._drain_host_ingest()  # G4 pages land before admission
+        prof.enter(_SEG_ADMIT)
         self._admit()
+        prof.enter(_SEG_SLOT_SCAN)
 
         # dispatch only for LIVE requests: a round for finished-awaiting-
         # release slots is pure garbage work that also queues ahead of the
@@ -1425,34 +1464,48 @@ class TpuEngine:
         rounds_in_flight = sum(1 for en in self._entries if en.kind == "round")
         dispatched = False
         if active and rounds_in_flight <= e.max_inflight_rounds:
+            prof.enter(_SEG_DISPATCH)
             self._dispatch_round(active)
             did_work = dispatched = True
-        if self.spec is not None and self._dispatch_spec():
-            did_work = dispatched = True
+        if self.spec is not None:
+            prof.enter(_SEG_SPEC)
+            if self._dispatch_spec():
+                did_work = dispatched = True
         if self._seal_queue:
             # no round rode them this time (pipeline full / all-spec):
             # dispatch standalone rather than letting commits sit
+            prof.enter(_SEG_SEAL_FLUSH)
             self._flush_seals()
             did_work = True
-        if self.on_metrics is not None:
-            # publish at the subscriber cadence, not once per round —
-            # building ForwardPassMetrics every round was measurable
-            # host tax and the pub/sub plane throttles to ~4 Hz anyway
-            now = time.monotonic()
-            if now - self._last_metrics_pub >= 0.1:
-                self._last_metrics_pub = now
+        # fold prof + refresh the SLO burn-rate gauges at the publish
+        # cadence, not once per round — building ForwardPassMetrics every
+        # round was measurable host tax and the pub/sub plane throttles
+        # to ~4 Hz anyway
+        now = time.monotonic()
+        if now - self._last_metrics_pub >= 0.1:
+            self._last_metrics_pub = now
+            prof.enter(_SEG_METRICS)
+            PROF.fold(prof)
+            PROF.fold_burn_rates(
+                self._h_ttft.snapshot(), self._h_itl.snapshot(),
+                e.slo_ttft_target_s, e.slo_itl_target_s,
+                e.slo_objective,
+            )
+            if self.on_metrics is not None:
                 self.on_metrics(self.metrics())
         if (not dispatched and self._entries
                 and self._intake.empty() and not self._waiting):
             # nothing to overlap with the in-flight fetches (e.g. every
             # live slot is waiting on its verify result) — block on the
             # head entry instead of spinning the loop
+            prof.enter(_SEG_FETCH)
             self._process_entries(block=True)
         if (self._draining
                 and not self._entries and not self._waiting
                 and not self._prefilling and self._intake.empty()
                 and all(s is None for s in self._slots)):
             self._drained_evt.set()
+        prof.end_round(record=did_work)
         return did_work
 
     def _drain_intake(self) -> None:
@@ -1623,7 +1676,9 @@ class TpuEngine:
         # straggler dispatch). Fixed width = one compiled variant;
         # admission-burst overflow drains via the standalone flush at
         # the end of _round.
+        prev_seg = self.prof.push(_SEG_SEAL_ASM)
         seal = self._take_seal_batch(width=self._seal_fuse_w)
+        self.prof.enter(prev_seg)
         if self.on_dispatch is not None:
             # followers must replay the identical (fused) program, so
             # the seal arrays always travel — zeros for seal-less rounds
@@ -1666,6 +1721,13 @@ class TpuEngine:
             self._notify_commits()
         self.flight.record(
             "round", slots=list(active), n_steps=n,
+            # post-PR 7 round shape: seals ride the fused program
+            # (seal_w = real seal-batch width, 0 on seal-less rounds)
+            # and token fetches are packed (1 stacked + 1 packed-logprob
+            # pipeline, never 3) — recorded so /debug/flight matches
+            # dispatch_counts
+            seal_w=int(seal[3]) if seal is not None else 0,
+            fetches=1 + (1 if lp_stacked is not None else 0),
             spec_slots=[
                 i for i, s in enumerate(self._slots)
                 if s is not None and s.spec
@@ -1839,6 +1901,7 @@ class TpuEngine:
                     if drafted is None:
                         drafted = jnp.zeros((B, K), jnp.int32)
                     drafted = drafted.at[j].set(proposal)
+        t_draft_end = time.monotonic()
         self.dispatch_counts["spec_verify"] += 1
         self.ctx, out_toks, n_out, new_keys = self.spec.verify(
             self.params, self.ctx, jnp.asarray(toks), drafted, slots_a,
@@ -1848,9 +1911,11 @@ class TpuEngine:
         for arr in (out_toks, n_out, new_keys):
             arr.copy_to_host_async()
             self.dispatch_counts["fetch"] += 1
+        t_verify_end = time.monotonic()
         self.flight.record(
             "spec_verify", slots=[slot for slot, *_ in rows], k=K,
-            dispatch_ms=round((time.monotonic() - t_disp) * 1e3, 3),
+            fetches=3,
+            dispatch_ms=round((t_verify_end - t_disp) * 1e3, 3),
         )
         for slot, r, _, _ in rows:
             r.spec_ready = False
@@ -1858,6 +1923,7 @@ class TpuEngine:
         self._entries.append(_Entry(
             kind="spec", handle=out_toks, rows=rows,
             aux=(n_out, new_keys), n_steps=K, t_dispatch=t_disp,
+            spec_host=(t_draft_end - t_disp, t_verify_end - t_draft_end),
         ))
         return True
 
@@ -1975,9 +2041,19 @@ class TpuEngine:
         r.t_last_emit = now
         r.decode_rounds += 1
         if len(r.trace_spans) < _MAX_ROUND_SPANS and entry.t_dispatch:
-            r.trace_spans.append(
-                _span_dict(kind, entry.t_dispatch, tokens=n_tokens)
-            )
+            sp = _span_dict(kind, entry.t_dispatch, tokens=n_tokens)
+            if entry.spec_host is not None:
+                # spec rounds carry draft/verify child spans so the
+                # speculation cost shows up inside timelines, not just
+                # as one opaque round span
+                draft_s, verify_s = entry.spec_host
+                t0 = sp["start_s"]
+                sp["children"] = [
+                    Span("spec_draft", t0, draft_s).to_dict(),
+                    Span("spec_verify", t0 + draft_s,
+                         verify_s).to_dict(),
+                ]
+            r.trace_spans.append(sp)
 
     def _final_annotations(self, r: _Request) -> dict:
         """Annotations for the FINISHING output: speculation counters,
@@ -1987,6 +2063,13 @@ class TpuEngine:
         normally-finished request; also registers the spans in the
         worker-local trace store when no frontend owns the trace in this
         process (remote-worker mode)."""
+        prev_seg = self.prof.push(_SEG_ANNOTATE)
+        try:
+            return self._final_annotations_inner(r)
+        finally:
+            self.prof.enter(prev_seg)
+
+    def _final_annotations_inner(self, r: _Request) -> dict:
         ann = self._spec_annotations(r)
         now = time.monotonic()
         e2e = now - r.enqueue_time

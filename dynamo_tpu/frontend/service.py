@@ -277,13 +277,23 @@ class HttpService:
         from dynamo_tpu.kv_quant import KV_QUANT
         from dynamo_tpu.kv_transfer_metrics import KV_TRANSFER
         from dynamo_tpu.resilience.metrics import RESILIENCE
+        from dynamo_tpu.telemetry.prof import PROF
 
+        # SLO burn-rate gauges refresh at scrape time from the frontend's
+        # own end-to-end latency histograms (an in-process engine also
+        # folds its view at the publish cadence; either way the gauges
+        # track live data)
+        if self._h_ttft.count or self._h_itl.count:
+            PROF.fold_burn_rates(
+                self._h_ttft.snapshot(), self._h_itl.snapshot()
+            )
         body = (self.metrics.render() + self.telemetry.render().encode()
                 + RESILIENCE.render().encode()
                 + KV_TRANSFER.render().encode()
                 + KV_QUANT.render().encode()
                 + KV_INTEGRITY.render().encode()
-                + OVERLOAD.render().encode())
+                + OVERLOAD.render().encode()
+                + PROF.render().encode())
         return web.Response(
             body=body, content_type=CONTENT_TYPE_LATEST.split(";")[0]
         )

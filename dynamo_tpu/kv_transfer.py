@@ -64,6 +64,7 @@ from dynamo_tpu.kv_quant import (
 )
 from dynamo_tpu.kv_transfer_metrics import KV_TRANSFER
 from dynamo_tpu.runtime.client import KvClient
+from dynamo_tpu.telemetry import timeline as tl
 from dynamo_tpu.runtime.protocol import (
     encode_frame2,
     encode_frame2_header,
@@ -360,9 +361,19 @@ class BlockTransferServer:
                                     "dynamo_kv_transfer_rx_bytes_total",
                                     len(payload),
                                 )
+                                dt = time.monotonic() - t0
                                 KV_TRANSFER.observe(
                                     "dynamo_kv_transfer_chunk_seconds",
-                                    time.monotonic() - t0,
+                                    dt,
+                                )
+                                ev_job = header.get("job")
+                                tl.STREAM_EVENTS.record(
+                                    tl.FRAME_RECV, dt,
+                                    seq=header.get("seq"),
+                                    pages=len(pages),
+                                    bytes=len(payload),
+                                    **({"job": ev_job}
+                                       if ev_job else {}),
                                 )
                             continue  # no per-chunk reply
                         try:
@@ -636,8 +647,12 @@ class PageStreamWriter:
         self.bytes_sent += data.nbytes
         KV_TRANSFER.inc("dynamo_kv_transfer_tx_chunks_total")
         KV_TRANSFER.inc("dynamo_kv_transfer_tx_bytes_total", data.nbytes)
-        KV_TRANSFER.observe(
-            "dynamo_kv_transfer_chunk_seconds", time.monotonic() - t0
+        dt = time.monotonic() - t0
+        KV_TRANSFER.observe("dynamo_kv_transfer_chunk_seconds", dt)
+        tl.STREAM_EVENTS.record(
+            tl.FRAME_SEND, dt, seq=self.chunks_sent - 1,
+            pages=len(pages), bytes=int(data.nbytes),
+            **({"job": self.job_id} if self.job_id else {}),
         )
 
     async def commit(self) -> int:
@@ -649,7 +664,13 @@ class PageStreamWriter:
              **({"job": self.job_id} if self.job_id else {})}, b"",
         ))
         await self._writer.drain()
+        t_ack = time.monotonic()
         header, _ = await read_frame2(self._reader)
+        tl.STREAM_EVENTS.record(
+            tl.EOF_ACK_WAIT, time.monotonic() - t_ack,
+            chunks=self.chunks_sent,
+            **({"job": self.job_id} if self.job_id else {}),
+        )
         if not header.get("ok"):
             KV_TRANSFER.inc("dynamo_kv_transfer_errors_total")
             _raise_nack(header, "chunk stream failed")

@@ -128,6 +128,27 @@ def build_parser() -> argparse.ArgumentParser:
                         "stream (preemption-as-migration via the "
                         "resilience plane; exactly-once, greedy "
                         "token-identical)")
+    # performance-attribution plane (telemetry/prof.py)
+    p.add_argument("--prof-attribution",
+                   default="on" if cfg.prof_attribution else "off",
+                   choices=["on", "off"],
+                   help="per-round host-segment attribution "
+                        "(dynamo_host_round_seconds{segment} + "
+                        "/debug/prof); near-zero overhead, off only "
+                        "for A/B measurement")
+    p.add_argument("--slo-ttft-target", type=float,
+                   default=cfg.slo_ttft_target_s,
+                   help="TTFT SLO target in seconds backing the "
+                        "dynamo_slo_ttft_burn_rate gauge")
+    p.add_argument("--slo-itl-target", type=float,
+                   default=cfg.slo_itl_target_s,
+                   help="ITL SLO target in seconds backing the "
+                        "dynamo_slo_itl_burn_rate gauge")
+    p.add_argument("--slo-objective", type=float,
+                   default=cfg.slo_objective,
+                   help="SLO objective (fraction of observations that "
+                        "must meet the target, e.g. 0.99); burn rate = "
+                        "frac-over-target / (1 - objective)")
     # speculative decoding (dynamo_tpu/spec/)
     p.add_argument("--speculative", default=cfg.speculative,
                    choices=["off", "ngram", "draft"],
@@ -514,6 +535,10 @@ def build_chain(args) -> "Any":
             max_waiting_requests=args.max_waiting_requests,
             max_waiting_prefill_tokens=args.max_waiting_prefill_tokens,
             preempt_running=args.preempt_running == "on",
+            prof_attribution=args.prof_attribution == "on",
+            slo_ttft_target_s=args.slo_ttft_target,
+            slo_itl_target_s=args.slo_itl_target,
+            slo_objective=args.slo_objective,
         )
         draft_cfg = None
         if args.speculative == "draft":

@@ -9,6 +9,8 @@ renders the engine's latency histograms (telemetry/metrics.py) and serves
 the debug plane:
 
   /debug/flight               recent engine-round events (flight ring)
+  /debug/prof                 host-round attribution summary (top
+                              segments, coverage ratio — telemetry/prof)
   /debug/trace/{request_id}   this worker's span tree for a request
   /debug/trace                recent completed trace ids
 
@@ -63,6 +65,7 @@ class SystemServer:
             web.get("/health", self.handle_health),
             web.get("/live", self.handle_health),
             web.get("/debug/flight", self.handle_flight),
+            web.get("/debug/prof", self.handle_prof),
             web.get("/debug/trace", self.handle_trace_index),
             web.get("/debug/trace/{request_id}", self.handle_trace),
             web.get("/drain", self.handle_drain_status),
@@ -157,10 +160,12 @@ class SystemServer:
         from dynamo_tpu.kv_quant import KV_QUANT
         from dynamo_tpu.kv_transfer_metrics import KV_TRANSFER
         from dynamo_tpu.overload import OVERLOAD
+        from dynamo_tpu.telemetry.prof import PROF
 
         return ("\n".join(lines) + "\n" + RESILIENCE.render()
                 + KV_TRANSFER.render() + KV_QUANT.render()
-                + KV_INTEGRITY.render() + OVERLOAD.render())
+                + KV_INTEGRITY.render() + OVERLOAD.render()
+                + PROF.render())
 
     async def handle_metrics(self, request: web.Request) -> web.Response:
         return web.Response(text=self.render(), content_type="text/plain")
@@ -183,6 +188,26 @@ class SystemServer:
             "recorded_total": flight.recorded_total,
             "events": flight.snapshot(),
         })
+
+    async def handle_prof(self, request: web.Request) -> web.Response:
+        """GET /debug/prof[?top=N] — host-round attribution: per-segment
+        totals/shares, recent-window per-round means, coverage ratio, and
+        the live SLO burn rates."""
+        prof = getattr(self.engine, "prof", None)
+        if prof is None:
+            return web.json_response(
+                {"error": "engine exposes no round profiler"}, status=404
+            )
+        from dynamo_tpu.telemetry.prof import PROF
+
+        try:
+            top = int(request.query.get("top", 0))
+        except ValueError:
+            top = 0
+        body = prof.summary(top=top)
+        body["worker_id"] = self.worker_id
+        body["slo_burn_rates"] = PROF.burn_rates()
+        return web.json_response(body)
 
     # ---- resilience controls ----
 

@@ -1,10 +1,10 @@
-"""Request-lifecycle telemetry: trace spans, latency histograms, and the
-per-worker flight recorder.
+"""Request-lifecycle telemetry: trace spans, latency histograms, the
+per-worker flight recorder, and the performance-attribution plane.
 
 Parity: the reference Dynamo stack's observability plane (Prometheus +
 Grafana dashboards fed by per-worker ForwardPassMetrics, request
 annotations carrying per-request timings, and the planner consuming the
-resulting distributions). Three pieces:
+resulting distributions). Five pieces:
 
   trace.py    trace context minted at the frontend, spans recorded at
               every pipeline stage, worker spans returned in-band via
@@ -15,6 +15,12 @@ resulting distributions). Three pieces:
               per-worker system server, and the aggregating exporter
   flight.py   fixed-size ring of recent engine-round events served at
               ``/debug/flight`` and dumped to the log on engine failure
+  prof.py     per-round host-segment attribution (where the host
+              milliseconds go): ``dynamo_host_round_seconds{segment}``
+              histograms, the SLO burn-rate gauges, ``/debug/prof``
+  timeline.py Perfetto/Chrome-trace assembly merging spans, round
+              segments, flight events, and kv-transfer stream events
+              (tools/trace_export.py is the CLI)
 """
 from dynamo_tpu.telemetry.flight import FlightRecorder
 from dynamo_tpu.telemetry.metrics import (
@@ -24,17 +30,39 @@ from dynamo_tpu.telemetry.metrics import (
     percentile_from_snapshot,
     request_histograms,
 )
+from dynamo_tpu.telemetry.prof import (
+    HOST_BUCKETS,
+    PROF,
+    SEGMENTS,
+    ProfRegistry,
+    RoundProf,
+)
+from dynamo_tpu.telemetry.timeline import (
+    STREAM_EVENTS,
+    StreamEventRing,
+    to_chrome_trace,
+    trace_to_chrome,
+)
 from dynamo_tpu.telemetry.trace import TRACES, Span, Trace, TraceStore
 
 __all__ = [
     "DEFAULT_TIME_BUCKETS",
     "FlightRecorder",
     "Histogram",
+    "HOST_BUCKETS",
+    "PROF",
+    "ProfRegistry",
+    "RoundProf",
+    "SEGMENTS",
     "Span",
+    "STREAM_EVENTS",
+    "StreamEventRing",
     "TelemetryRegistry",
     "Trace",
     "TraceStore",
     "TRACES",
     "percentile_from_snapshot",
     "request_histograms",
+    "to_chrome_trace",
+    "trace_to_chrome",
 ]

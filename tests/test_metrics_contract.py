@@ -180,7 +180,47 @@ def test_readme_documents_canonical_series():
         "dynamo_worker_waiting_prefill_tokens",
         "dynamo_worker_max_waiting_requests",
         "dynamo_worker_max_waiting_prefill_tokens",
+        # performance-attribution plane (dynamo_tpu/telemetry/prof.py)
+        "dynamo_host_round_seconds",
+        "dynamo_host_round_coverage_ratio",
+        "dynamo_slo_ttft_burn_rate",
+        "dynamo_slo_itl_burn_rate",
     ):
         assert name in readme, f"{name} missing from README"
-    for endpoint in ("/debug/trace", "/debug/flight"):
+    for endpoint in ("/debug/trace", "/debug/flight", "/debug/prof"):
         assert endpoint in readme
+
+
+def test_prof_families_on_all_three_surfaces():
+    """The attribution plane's families render — with HELP/TYPE and the
+    per-segment label — on every scrape surface."""
+    from dynamo_tpu.metrics_exporter import MetricsExporter
+    from dynamo_tpu.runtime.system_server import SystemServer
+    from dynamo_tpu.telemetry.prof import PROF, SEGMENTS, RoundProf
+
+    prof = RoundProf()
+    prof.begin_round()
+    prof.enter(SEGMENTS.index("dispatch"))
+    prof.end_round()
+    PROF.fold(prof)
+    try:
+        for text in (
+            SystemServer(_StubEngine(), worker_id="w0").render(),
+            MetricsExporter(kv=None).render(),
+        ):
+            assert "# TYPE dynamo_host_round_seconds histogram" in text
+            assert text.count(
+                "# TYPE dynamo_host_round_seconds histogram") == 1
+            assert 'dynamo_host_round_seconds_bucket{segment=' in text
+            assert "# TYPE dynamo_host_round_coverage_ratio gauge" in text
+            assert "# TYPE dynamo_slo_ttft_burn_rate gauge" in text
+            assert "# TYPE dynamo_slo_itl_burn_rate gauge" in text
+            _assert_contract(text, _readme_text())
+        from dynamo_tpu.frontend.service import HttpService
+
+        svc = HttpService()
+        text = svc.telemetry.render() + PROF.render()
+        assert "# TYPE dynamo_host_round_seconds histogram" in text
+        _assert_contract(text, _readme_text())
+    finally:
+        PROF.reset()

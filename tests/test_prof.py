@@ -1,0 +1,448 @@
+"""Performance-attribution plane tests (telemetry/prof.py + timeline).
+
+Pins the tentpole invariants: the flat switch model attributes host
+round time with self-coverage ~1.0 by construction; the always-on
+instrumentation costs within 5% of the disabled engine's steady-decode
+wall; the SLO burn-rate math interpolates histogram CDFs correctly; the
+``--dispatch-budget`` tool emits a ``host_breakdown`` keyed by the full
+segment enum; and the timeline exporter turns a real disagg request
+(span tree + host rounds + kv_transfer stream events) into parseable
+Chrome Trace Event Format JSON.
+"""
+import asyncio
+import importlib.util
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from dynamo_tpu.engine.config import EngineConfig
+from dynamo_tpu.engine.engine import TpuEngine
+from dynamo_tpu.models.config import ModelConfig
+from dynamo_tpu.parallel.mesh import MeshConfig
+from dynamo_tpu.protocols.common import (
+    PreprocessedRequest,
+    StopConditions,
+)
+from dynamo_tpu.telemetry.metrics import Histogram
+from dynamo_tpu.telemetry.prof import (
+    PROF,
+    SEGMENTS,
+    ProfRegistry,
+    RoundProf,
+    frac_over_target,
+)
+
+PS = 16
+TOOLS = os.path.join(os.path.dirname(__file__), "..", "tools")
+
+
+def _engine(**kw) -> TpuEngine:
+    base = dict(
+        num_pages=128, page_size=PS, max_pages_per_seq=16,
+        max_decode_slots=4, prefill_buckets=(64,),
+        cache_dtype="float32",
+    )
+    base.update(kw)
+    return TpuEngine(ModelConfig.tiny(dtype="float32"),
+                     EngineConfig(**base),
+                     mesh_config=MeshConfig(tp=1))
+
+
+# ---- RoundProf: the flat switch model --------------------------------
+
+
+def test_roundprof_segment_sums_equal_wall():
+    p = RoundProf()
+    p.begin_round()
+    p.enter(SEGMENTS.index("intake"))
+    time.sleep(0.002)
+    p.enter(SEGMENTS.index("dispatch"))
+    time.sleep(0.003)
+    p.end_round()
+    assert p.rounds == 1
+    t = p.totals()
+    # self-coverage == 1.0 by construction: every elapsed slice is
+    # charged to exactly one segment
+    assert sum(t["segments"].values()) == pytest.approx(t["wall_s"])
+    assert p.coverage() == pytest.approx(1.0)
+    assert t["segments"]["intake"] >= 0.002
+    assert t["segments"]["dispatch"] >= 0.003
+    assert set(t["segments"]) == set(SEGMENTS)
+
+
+def test_roundprof_push_restores_nested_segment():
+    p = RoundProf()
+    p.begin_round()
+    p.enter(SEGMENTS.index("fetch"))
+    time.sleep(0.001)
+    prev = p.push(SEGMENTS.index("annotate"))
+    time.sleep(0.002)
+    p.enter(prev)
+    time.sleep(0.001)
+    p.end_round()
+    t = p.totals()["segments"]
+    assert t["annotate"] >= 0.002
+    assert t["fetch"] >= 0.002  # both slices around the nested push
+
+
+def test_roundprof_disabled_is_noop():
+    p = RoundProf(enabled=False)
+    p.begin_round()
+    p.enter(SEGMENTS.index("dispatch"))
+    p.end_round()
+    assert p.rounds == 0
+    assert p.wall_total == 0.0
+    assert p.recent() == [] and p.drain() == []
+    # summary still renders (the /debug/prof payload for an off engine)
+    assert p.summary()["enabled"] is False
+
+
+def test_roundprof_idle_rounds_not_recorded():
+    p = RoundProf()
+    p.begin_round()
+    p.enter(SEGMENTS.index("intake"))
+    p.end_round(record=False)
+    assert p.rounds == 0 and p.recent() == [] and p.drain() == []
+    p.begin_round()
+    p.enter(SEGMENTS.index("intake"))
+    p.end_round(record=True)
+    assert p.rounds == 1 and len(p.recent()) == 1
+
+
+def test_roundprof_ring_and_drain_bounded():
+    p = RoundProf()
+    for _ in range(p.RING + 50):
+        p.begin_round()
+        p.end_round()
+    assert len(p.recent(10_000)) == p.RING
+    drained = p.drain()
+    assert len(drained) == p.RING
+    assert p.drain() == []  # drain empties the unfolded buffer
+    assert p.rounds == p.RING + 50  # cumulative counters keep counting
+
+
+# ---- SLO burn-rate math ----------------------------------------------
+
+
+def _snap(values, buckets):
+    h = Histogram("x", "x", buckets)
+    for v in values:
+        h.observe(v)
+    return h.snapshot()
+
+
+def test_frac_over_target_edges_and_interpolation():
+    assert frac_over_target(None, 0.5) == 0.0
+    assert frac_over_target({}, 0.5) == 0.0
+    b = (0.5, 1.0)
+    assert frac_over_target(_snap([0.1] * 10, b), 0.5) == 0.0
+    assert frac_over_target(_snap([2.0] * 10, b), 1.5) == \
+        pytest.approx(1.0)
+    # 10 observations in the (1.0, 2.0] bucket of buckets (1, 2); a
+    # 1.5 target linearly splits the bucket: half the mass is over
+    assert frac_over_target(_snap([1.2] * 10, (1.0, 2.0)), 1.5) == \
+        pytest.approx(0.5)
+    # mixed: 98 under, 2 over a target sitting exactly on an edge
+    snap = _snap([0.1] * 98 + [0.9] * 2, (0.5, 1.0))
+    assert frac_over_target(snap, 0.5) == pytest.approx(0.02)
+
+
+def test_burn_rate_gauges_fold_and_render():
+    reg = ProfRegistry()
+    reg.configure(ttft_target_s=0.5, itl_target_s=0.05, objective=0.99)
+    ttft = _snap([0.1] * 98 + [0.9] * 2, (0.5, 1.0))
+    itl = _snap([0.01] * 100, (0.05, 0.1))
+    burn = reg.fold_burn_rates(ttft, itl)
+    # 2% over target / 1% error budget = burning 2x the sustainable rate
+    assert burn["ttft"] == pytest.approx(2.0)
+    assert burn["itl"] == pytest.approx(0.0)
+    assert reg.burn_rates() == burn
+    text = reg.render()
+    assert "# TYPE dynamo_slo_ttft_burn_rate gauge" in text
+    assert "dynamo_slo_ttft_burn_rate 2.0" in text
+    # one family head, one labelled series per segment
+    assert text.count("# TYPE dynamo_host_round_seconds histogram") == 1
+    for s in SEGMENTS:
+        assert f'segment="{s}"' in text
+
+
+def test_registry_fold_observes_per_segment():
+    reg = ProfRegistry()
+    p = RoundProf()
+    p.begin_round()
+    p.enter(SEGMENTS.index("dispatch"))
+    time.sleep(0.001)
+    p.end_round()
+    reg.fold(p)
+    snap = reg.snapshot()
+    assert snap["dispatch"]["count"] == 1
+    assert snap["dispatch"]["sum"] >= 0.001
+    assert snap["intake"]["count"] == 0
+    assert reg.coverage_ratio() == pytest.approx(1.0)
+    reg.fold(p)  # second fold: nothing new to drain
+    assert reg.snapshot()["dispatch"]["count"] == 1
+
+
+# ---- engine integration ----------------------------------------------
+
+
+async def _run_wave(eng, prompts, osl):
+    async def one(p):
+        async for _ in eng.generate(PreprocessedRequest(
+            token_ids=list(p),
+            stop_conditions=StopConditions(max_tokens=osl,
+                                           ignore_eos=True),
+        )):
+            pass
+
+    await asyncio.gather(*[one(p) for p in prompts])
+
+
+async def test_engine_attribution_coverage_and_host_budget():
+    """Tier-1 pins: a served workload attributes its host time across
+    the real segments with self-coverage >= 0.9, folds into the global
+    PROF registry at the publish cadence, and the steady-decode host
+    budget stays under a (generous, tiny-harness) per-round ceiling."""
+    PROF.reset()
+    eng = _engine()
+    eng.start()
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(1, 256, 48).tolist() for _ in range(4)]
+    await _run_wave(eng, prompts, 8)       # warmup: compiles
+    t0 = eng.prof.totals()
+    await _run_wave(eng, prompts, 48)
+    await eng.stop()
+
+    t1 = eng.prof.totals()
+    rounds = t1["rounds"] - t0["rounds"]
+    wall = t1["wall_s"] - t0["wall_s"]
+    assert rounds >= 10
+    assert eng.prof.coverage() >= 0.9
+    seg = {s: t1["segments"][s] - t0["segments"][s] for s in SEGMENTS}
+    # the hot segments of a decode-heavy workload actually got charged
+    for s in ("dispatch", "fetch", "admit", "slot_scan"):
+        assert seg[s] > 0.0, seg
+    # whole-run host tripwire: on the CPU harness the admit segment
+    # carries the blocking prefill compute itself, so exclude it here
+    # (the steady-decode budget is pinned in the A/B test below);
+    # 50 ms/round is the "something pathological landed in the host
+    # loop" ceiling, not a perf target
+    assert (wall - seg["admit"]) / rounds <= 0.050, (wall, rounds, seg)
+    # /debug/prof payload shape
+    s = eng.prof.summary(top=3)
+    assert len(s["segments"]) == 3
+    assert s["coverage_ratio"] >= 0.9
+    assert s["segments"][0]["total_s"] >= s["segments"][1]["total_s"]
+    # the publish-cadence fold populated the process-global registry
+    snap = PROF.snapshot()
+    assert sum(h["count"] for h in snap.values()) > 0
+    assert set(PROF.burn_rates()) == {"ttft", "itl"}
+    PROF.reset()
+
+
+async def _steady_round_wall_ms(eng, repeats=2) -> float:
+    """Min per-round wall (ms) over ``repeats`` steady-decode windows,
+    same window mechanics as tests/test_dispatch_budget.py."""
+    rng = np.random.RandomState(0)
+    n_req, osl = 4, 64
+    prompts = [rng.randint(1, 256, 48).tolist() for _ in range(n_req)]
+    await _run_wave(eng, prompts, 8)  # warmup: compiles
+    best = None
+    for _ in range(repeats):
+        progress = [0] * n_req
+
+        async def one(i):
+            async for out in eng.generate(PreprocessedRequest(
+                token_ids=list(prompts[i]),
+                stop_conditions=StopConditions(max_tokens=osl,
+                                               ignore_eos=True),
+            )):
+                progress[i] += len(out.token_ids)
+
+        tasks = [asyncio.ensure_future(one(i)) for i in range(n_req)]
+        while not all(p >= 4 for p in progress):
+            await asyncio.sleep(0.005)
+        d0 = dict(eng.dispatch_counts)
+        t0 = time.monotonic()
+        while not any(p >= osl - 20 for p in progress):
+            await asyncio.sleep(0.005)
+        dt = time.monotonic() - t0
+        d1 = dict(eng.dispatch_counts)
+        await asyncio.gather(*tasks)
+        rounds = (d1.get("round", 0) + d1.get("round_seal", 0)
+                  - d0.get("round", 0) - d0.get("round_seal", 0))
+        if rounds > 0:
+            w = dt / rounds * 1e3
+            best = w if best is None else min(best, w)
+    return best
+
+
+async def test_attribution_overhead_within_5pct():
+    """The always-on claim: attribution ON vs OFF steady-decode
+    per-round wall within 5% (plus a small absolute allowance for
+    shared-CI scheduling noise — the instrumentation itself is ~15
+    monotonic() calls, single-digit µs, per round)."""
+    walls = {}
+    for mode in (True, False):
+        eng = _engine(prof_attribution=mode)
+        eng.start()
+        walls[mode] = await _steady_round_wall_ms(eng)
+        await eng.stop()
+    assert walls[True] is not None and walls[False] is not None
+    assert walls[True] <= walls[False] * 1.05 + 0.3, walls
+    # steady-decode host budget pin: the generous tiny-harness ceiling
+    # (typical ~1-5 ms/round on CPU; regressions land well above)
+    assert walls[True] <= 50.0, walls
+
+
+def test_disabled_engine_records_nothing():
+    eng = _engine(prof_attribution=False)
+    assert eng.prof.enabled is False
+    assert eng.prof.totals()["rounds"] == 0
+
+
+# ---- profile_round --dispatch-budget tool contract -------------------
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(TOOLS, f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_profile_round_dispatch_budget_host_breakdown(capsys):
+    """The tool's JSON line carries a host_breakdown keyed by the FULL
+    segment enum (the contract bench.py and /debug/prof share) and a
+    self-coverage >= 0.9."""
+    mod = _load_tool("profile_round")
+    assert mod._dispatch_budget_mode(2, 16, "none") == 0
+    line = capsys.readouterr().out.strip().splitlines()[-1]
+    out = json.loads(line)
+    assert out["mode"] == "dispatch-budget"
+    assert set(out["host_breakdown"]) == set(SEGMENTS)
+    assert out["host_prof_rounds"] >= 1
+    assert out["host_prof_coverage"] >= 0.9
+    assert all(v >= 0.0 for v in out["host_breakdown"].values())
+
+
+# ---- timeline export: disagg request -> Chrome trace JSON ------------
+
+
+async def test_disagg_request_timeline_chrome_trace():
+    """The exporter acceptance: one chunk-streamed disagg request's
+    span tree + host-round records + kv_transfer stream events build a
+    json-round-trippable Chrome trace with span events, round segments,
+    and >= 1 kv_transfer stream event."""
+    from dataclasses import replace
+
+    from dynamo_tpu.disagg import (
+        DisaggConfig,
+        DisaggConfigWatcher,
+        DisaggDecodeEngine,
+        PrefillWorker,
+    )
+    from dynamo_tpu.kv_transfer import (
+        BlocksetDescriptor,
+        BlockTransferServer,
+        KvCacheLayout,
+        publish_descriptor,
+    )
+    from dynamo_tpu.models import llama
+    from dynamo_tpu.runtime.component import DistributedRuntime
+    from dynamo_tpu.runtime.store import serve_store
+    from dynamo_tpu.telemetry.timeline import FRAME_SEND, STREAM_EVENTS
+
+    STREAM_EVENTS.clear()
+    cfg = ModelConfig.tiny(dtype="float32")
+    params = llama.init_params(cfg, 0)
+    ecfg = EngineConfig(
+        num_pages=64, page_size=PS, max_pages_per_seq=8,
+        max_decode_slots=4, prefill_buckets=(32, 64),
+        cache_dtype="float32",
+    )
+
+    server, store = await serve_store(port=0, sweep_interval_s=0.05)
+    port = server.sockets[0].getsockname()[1]
+    rt = await DistributedRuntime.connect(port=port)
+    decode_inner = TpuEngine(cfg, replace(ecfg, worker_id="dec_tl"),
+                             params=params, mesh_config=MeshConfig(tp=1))
+    conf = await DisaggConfigWatcher(
+        rt.kv, "tl",
+        default=DisaggConfig(max_local_prefill_length=PS,
+                             max_prefill_queue_size=4),
+    ).start()
+    decode = DisaggDecodeEngine(
+        decode_inner, rt, namespace="tl", worker_id="dec_tl", conf=conf,
+        prefill_timeout_s=30.0,
+    )
+    srv = BlockTransferServer(
+        read_fn=decode_inner.export_pages, write_fn=decode.guarded_import,
+    )
+    host, xport = await srv.start()
+    await publish_descriptor(rt.kv, "tl", BlocksetDescriptor(
+        worker_id="dec_tl", host=host, port=xport,
+        layout=KvCacheLayout(cfg.num_layers, cfg.num_kv_heads, PS,
+                             cfg.head_dim, "float32"),
+    ))
+    pre_eng = TpuEngine(
+        cfg, replace(ecfg, worker_id="pre_tl", kv_transfer_chunk_pages=2),
+        params=params, mesh_config=MeshConfig(tp=1),
+    )
+    pworker = await PrefillWorker(
+        rt, pre_eng, namespace="tl", poll_timeout_s=0.2,
+    ).start()
+    try:
+        finishing = None
+        async for out in decode.generate(PreprocessedRequest(
+            token_ids=list(range(1, 114)),  # 7 blocks -> >= 3 frames
+            stop_conditions=StopConditions(max_tokens=8, ignore_eos=True),
+        )):
+            if out.finish_reason is not None:
+                finishing = out
+        assert decode.remote_prefills == 1
+        spans = (finishing.annotations.get("trace") or {}).get("spans", [])
+        assert spans
+        stream = STREAM_EVENTS.snapshot()
+        assert any(e["kind"] == FRAME_SEND for e in stream)
+
+        # the same assembly tools/trace_export.py drives: a pre-merged
+        # bundle document -> Chrome trace
+        te = _load_tool("trace_export")
+        doc = {
+            "trace": {"trace_id": "req-tl", "spans": spans},
+            "flight": decode_inner.flight.snapshot(),
+            "stream": stream,
+            "rounds": [[r[0], r[1], list(r[2])]
+                       for r in decode_inner.prof.recent(16)],
+        }
+        chrome = json.loads(json.dumps(te.build(doc)))
+
+        assert chrome["displayTimeUnit"] == "ms"
+        evs = chrome["traceEvents"]
+        for ev in evs:
+            assert ev["ph"] in ("X", "i", "M"), ev
+            assert isinstance(ev["pid"], int)
+            assert "name" in ev
+            if ev["ph"] == "X":
+                assert isinstance(ev["ts"], int) and ev["dur"] >= 1, ev
+        names = {e["name"] for e in evs if e.get("cat") == "span"}
+        assert "disagg_kv_transfer" in names
+        assert any(e["name"] == "host_round" for e in evs)
+        assert any(e.get("cat") == "round_segment" for e in evs)
+        kv = [e for e in evs if e.get("cat") == "kv_stream"]
+        assert len(kv) >= 1
+        assert any(e["name"] == FRAME_SEND for e in kv)
+    finally:
+        await pworker.stop()
+        await srv.stop()
+        await conf.stop()
+        await decode.stop()
+        await pre_eng.stop()
+        await rt.close()
+        server.close()
+        STREAM_EVENTS.clear()

@@ -263,14 +263,24 @@ def _dispatch_budget_mode(n_req: int, osl: int, kv_quant: str) -> int:
         # warmup: compile prefill/round/seal/patch before the window
         await asyncio.gather(*[one(p, 8) for p in prompts])
         d0 = dict(eng.dispatch_counts)
+        p0 = eng.prof.totals()
         steps0 = eng.step_count
         t0 = time.monotonic()
         tokens = sum(await asyncio.gather(*[one(p, osl) for p in prompts]))
         wall = time.monotonic() - t0
         steps = eng.step_count - steps0
         delta = {k: v - d0.get(k, 0) for k, v in eng.dispatch_counts.items()}
+        p1 = eng.prof.totals()
+        prof = {
+            "rounds": p1["rounds"] - p0["rounds"],
+            "wall_s": p1["wall_s"] - p0["wall_s"],
+            "segments": {
+                s: p1["segments"][s] - p0["segments"][s]
+                for s in p1["segments"]
+            },
+        }
         return {"tokens": tokens, "wall_s": wall, "steps": steps,
-                "delta": delta}
+                "delta": delta, "prof": prof}
 
     stats = asyncio.run(run())
     asyncio.run(eng.stop())  # quiesce: the loop must not patch _dev
@@ -310,6 +320,15 @@ def _dispatch_budget_mode(n_req: int, osl: int, kv_quant: str) -> int:
     delta = stats["delta"]
     rounds = delta.get("round", 0) + delta.get("round_seal", 0)
     wall_ms_per_step = stats["wall_s"] / max(stats["steps"], 1) * 1e3
+    # performance-attribution view (telemetry/prof.py): ms/step of each
+    # host-round segment over the same window — names the slices inside
+    # host_ms_per_step so the next perf PR attacks segments, not a blob
+    prof = stats["prof"]
+    steps = max(stats["steps"], 1)
+    host_breakdown = {
+        s: round(v / steps * 1e3, 5) for s, v in prof["segments"].items()
+    }
+    attributed = sum(prof["segments"].values())
     print(json.dumps({
         "mode": "dispatch-budget",
         "kv_quant": kv_quant,
@@ -325,6 +344,10 @@ def _dispatch_budget_mode(n_req: int, osl: int, kv_quant: str) -> int:
         "device_ms_per_step": round(device_ms_per_step, 4),
         "host_ms_per_step": round(
             wall_ms_per_step - device_ms_per_step, 4),
+        "host_breakdown": host_breakdown,
+        "host_prof_rounds": prof["rounds"],
+        "host_prof_coverage": round(
+            attributed / prof["wall_s"], 4) if prof["wall_s"] > 0 else 1.0,
     }))
     return 0
 

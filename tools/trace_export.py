@@ -1,0 +1,123 @@
+#!/usr/bin/env python3
+"""Export a request's observability data as a Perfetto/Chrome trace.
+
+Merges up to four sources into one Trace Event Format JSON
+(telemetry/timeline.py) loadable at https://ui.perfetto.dev or
+chrome://tracing:
+
+  - a /debug/trace/{request_id} span tree (frontend + worker spans,
+    disagg kv chunks, spec draft/verify children)
+  - a /debug/flight dump (recent engine dispatches, as instants)
+  - kv_transfer stream events captured in a bench/debug JSON payload
+  - host-round segment records (same payload shape bench.py emits)
+
+Usage:
+    python tools/trace_export.py http://HOST:PORT/debug/trace/REQ_ID \
+        [--flight http://HOST:PORT/debug/flight] [-o trace.json]
+    python tools/trace_export.py trace_debug.json -o trace.json
+    curl -s .../debug/trace/ID | python tools/trace_export.py - -o out.json
+
+A file/stdin source may be either a raw trace dict ({"trace_id", "spans"})
+or a pre-merged bundle {"trace": ..., "flight": [...], "stream": [...],
+"rounds": [[end_s, wall_s, [seg_s, ...]], ...]}.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Any, Optional
+
+# tools/ runs standalone (no package install): make the repo importable
+if __package__ in (None, ""):
+    import os
+
+    sys.path.insert(
+        0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+
+from dynamo_tpu.telemetry.timeline import to_chrome_trace  # noqa: E402
+
+
+def load(source: str) -> dict[str, Any]:
+    if source == "-":
+        return json.load(sys.stdin)
+    if source.startswith(("http://", "https://")):
+        from urllib.request import urlopen
+
+        with urlopen(source, timeout=10) as resp:  # noqa: S310 — operator URL
+            return json.load(resp)
+    with open(source) as f:
+        return json.load(f)
+
+
+def build(
+    doc: dict[str, Any],
+    flight: Optional[list[dict[str, Any]]] = None,
+) -> dict[str, Any]:
+    """One source document (+ optional flight events) -> Chrome trace."""
+    if "trace" in doc or "stream" in doc or "rounds" in doc:
+        # pre-merged bundle
+        trace = doc.get("trace") or {}
+        spans = list(trace.get("spans") or [])
+        label = str(trace.get("trace_id", ""))
+        stream = list(doc.get("stream") or [])
+        rounds = [
+            (float(r[0]), float(r[1]), tuple(float(x) for x in r[2]))
+            for r in doc.get("rounds") or []
+        ]
+        fl = list(doc.get("flight") or []) + list(flight or [])
+    else:
+        spans = list(doc.get("spans") or [])
+        label = str(doc.get("trace_id", ""))
+        stream, rounds, fl = [], [], list(flight or [])
+    return to_chrome_trace(
+        spans=spans, round_records=rounds, flight_events=fl,
+        stream_events=stream, label=label,
+    )
+
+
+def main(argv: list[str]) -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    ap.add_argument("source",
+                    help="/debug/trace URL, JSON file, or - for stdin")
+    ap.add_argument("--flight", default=None,
+                    help="optional /debug/flight URL or JSON file to "
+                         "merge as instant events")
+    ap.add_argument("-o", "--output", default="trace.json",
+                    help="output path (default trace.json); - for stdout")
+    args = ap.parse_args(argv)
+
+    doc = load(args.source)
+    if "error" in doc:
+        print(f"error: {doc['error']}", file=sys.stderr)
+        return 1
+    flight = None
+    if args.flight:
+        fdoc = load(args.flight)
+        if isinstance(fdoc, dict):
+            # worker system server: {"events": [...]};
+            # frontend: {"engines": {name: {"events": [...]}}}
+            flight = list(fdoc.get("events") or [])
+            for eng in (fdoc.get("engines") or {}).values():
+                flight.extend(eng.get("events") or [])
+        else:
+            flight = fdoc
+    chrome = build(doc, flight=flight)
+    out = json.dumps(chrome)
+    if args.output == "-":
+        print(out)
+    else:
+        with open(args.output, "w") as f:
+            f.write(out)
+        n = len(chrome["traceEvents"])
+        print(f"wrote {args.output} ({n} events) — open at "
+              f"https://ui.perfetto.dev", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))
