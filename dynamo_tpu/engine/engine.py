@@ -513,7 +513,7 @@ class TpuEngine:
         self.dispatch_counts: dict[str, int] = {
             "round": 0, "round_seal": 0, "seal": 0, "patch": 0,
             "prefill": 0, "prefill_batch": 0, "sp_prefill": 0,
-            "load_ctx": 0, "sample_first": 0, "fetch": 0,
+            "load_ctx": 0, "sample_first": 0, "fetch": 0, "encode": 0,
             "offload_gather": 0, "xfer_gather": 0, "xfer_scatter": 0,
             # speculative path: the fused batch-draft and verify
             # programs (the legacy PER-SLOT draft loop's dispatches are
@@ -1265,6 +1265,7 @@ class TpuEngine:
         T = pow2_cover(max(len(token_ids), 8))
         toks = np.zeros(T, np.int32)
         toks[: len(token_ids)] = token_ids
+        self.dispatch_counts["encode"] += 1
         out = llama.encode(
             self.config, self.params, jnp.asarray(toks),
             jnp.int32(len(token_ids)),

@@ -217,10 +217,24 @@ class Follower:
                 self.apply(cmd)
                 self.commands_applied += 1
 
+    # replayed op -> the follower's dispatch_counts bucket (parity with
+    # the leader's accounting at its own dispatch sites; "round" picks
+    # round/round_seal below and "patch" counts inside _dispatch_patch)
+    _OP_BUCKETS = {
+        "prefill": "prefill", "prefill_batch": "prefill_batch",
+        "sample_first": "sample_first", "sp_prefill": "sp_prefill",
+        "load_ctx": "load_ctx", "seal": "seal",
+    }
+
     def apply(self, cmd: dict) -> None:
         eng = self.engine
         op = cmd["op"]
+        bucket = self._OP_BUCKETS.get(op)
+        if bucket is not None:
+            eng.dispatch_counts[bucket] += 1
         if op == "round":
+            eng.dispatch_counts[
+                "round_seal" if cmd.get("seal") else "round"] += 1
             seal = cmd.get("seal")
             if seal:
                 # leader fused the round's seal batch into the program

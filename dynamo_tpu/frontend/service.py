@@ -450,7 +450,8 @@ class HttpService:
                         try:
                             await close()
                         except Exception:  # noqa: BLE001
-                            pass
+                            log.debug("stream close failed",
+                                      exc_info=True)
                 incomplete = (finish == FinishReason.LENGTH)
                 return web.json_response(responses_response(
                     rid=rid, model=rreq.model, text=text,
@@ -587,7 +588,7 @@ class HttpService:
                 try:
                     await close()
                 except Exception:  # noqa: BLE001
-                    pass
+                    log.debug("stream close failed", exc_info=True)
         await resp.write_eof()
         return resp
 
@@ -821,7 +822,7 @@ class HttpService:
                     try:
                         await close()
                     except Exception:  # noqa: BLE001
-                        pass
+                        log.debug("stream close failed", exc_info=True)
 
         # overload plane: probe for ADMISSION before preparing the SSE
         # stream. If every choice bounces with EngineOverloadedError
@@ -957,7 +958,7 @@ class HttpService:
                     try:
                         await close()
                     except Exception:  # noqa: BLE001
-                        pass
+                        log.debug("stream close failed", exc_info=True)
         await resp.write_eof()
         return resp
 

@@ -167,6 +167,8 @@ class KvPushRouter:
             try:
                 total += await invoke_clear(clear)
             except Exception:  # noqa: BLE001 — best-effort per worker
+                log.warning("clear_kv_blocks failed on worker %s",
+                            wid, exc_info=True)
                 continue
             self.router.indexer.remove_worker(wid)
         return total

@@ -1,0 +1,44 @@
+"""dynlint: project-native static analysis for the engine's invariants.
+
+The reference Dynamo gets its correctness dividend from the Rust
+toolchain (borrow checker + clippy); this Python/JAX rebuild encodes its
+load-bearing invariants — jit-tracing purity, event-loop discipline,
+lock guards, dispatch accounting, the metrics contract, typed wire
+errors, exception hygiene — as AST rules that run over *every* path at
+check time, not just the paths the runtime tests exercise.
+
+Usage (library):
+
+    from dynamo_tpu.lint import lint_paths, lint_source
+    findings = lint_paths(["dynamo_tpu", "tools"], root=".")
+
+CLI: ``python tools/dynlint.py dynamo_tpu tools`` (``--format json`` for
+machine-readable output; exit 0 = clean, 1 = unsuppressed findings).
+
+Suppression: ``# dynlint: disable=DTL003 — <why>`` on the finding's
+line (or alone on the line above) suppresses that rule there; every
+suppression should carry a one-line justification after the rule list.
+"""
+from __future__ import annotations
+
+from dynamo_tpu.lint.core import (
+    Finding,
+    Module,
+    ProjectIndex,
+    all_rules,
+    lint_paths,
+    lint_source,
+    render_json,
+    render_text,
+)
+
+__all__ = [
+    "Finding",
+    "Module",
+    "ProjectIndex",
+    "all_rules",
+    "lint_paths",
+    "lint_source",
+    "render_json",
+    "render_text",
+]

@@ -1,0 +1,298 @@
+"""dynlint core: the file index, suppression pragmas, and the runner.
+
+Rules are plain objects with an ``ID``, a one-line ``WHAT``, and a
+``check(index) -> list[Finding]``. They receive the whole
+:class:`ProjectIndex` (every scanned module, parsed once) because
+several invariants are cross-file by nature: dispatch accounting needs
+the jitted names defined in ``models/``, the metrics contract needs the
+three scrape surfaces and README, wire-error typing needs the class
+hierarchy.
+
+Suppression contract (mirrors the rule IDs it guards):
+
+* ``# dynlint: disable=DTL003`` on a line suppresses findings of that
+  rule anchored to that line;
+* the same pragma alone on a line suppresses the next code line
+  (for findings on lines too dense to carry a trailing comment);
+* ``# dynlint: disable-file=DTL001,DTL002`` anywhere in the first 20
+  lines suppresses those rules for the whole file.
+
+Anything after the rule list in the comment is the justification and is
+carried into the finding record (JSON output includes it), so "why is
+this suppressed" is greppable.
+"""
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from dataclasses import dataclass
+from typing import Iterable, Optional
+
+_PRAGMA = re.compile(
+    r"#\s*dynlint:\s*(disable|disable-file)\s*=\s*"
+    r"(?P<rules>DTL\d{3}(?:\s*,\s*DTL\d{3})*)"
+    r"(?P<why>[^\n]*)"
+)
+_FILE_PRAGMA_WINDOW = 20  # lines scanned for disable-file pragmas
+
+
+@dataclass
+class Finding:
+    rule: str
+    path: str            # repo-relative, forward slashes
+    line: int
+    col: int
+    message: str
+    suppressed: bool = False
+    justification: str = ""
+
+    def as_dict(self) -> dict:
+        d = {
+            "rule": self.rule, "path": self.path, "line": self.line,
+            "col": self.col, "message": self.message,
+            "suppressed": self.suppressed,
+        }
+        if self.justification:
+            d["justification"] = self.justification
+        return d
+
+
+@dataclass
+class _Suppression:
+    rules: frozenset
+    justification: str
+
+
+class Module:
+    """One parsed source file: AST + raw lines + suppression pragmas."""
+
+    def __init__(self, path: str, source: str):
+        self.path = path.replace(os.sep, "/")
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        # line -> _Suppression; 0 -> file-wide
+        self.suppressions: dict[int, _Suppression] = {}
+        self._scan_pragmas()
+
+    def _scan_pragmas(self) -> None:
+        for i, raw in enumerate(self.lines, start=1):
+            m = _PRAGMA.search(raw)
+            if not m:
+                continue
+            rules = frozenset(
+                r.strip() for r in m.group("rules").split(","))
+            why = m.group("why").strip(" -—:\t")
+            sup = _Suppression(rules, why)
+            if m.group(1) == "disable-file":
+                if i <= _FILE_PRAGMA_WINDOW:
+                    prior = self.suppressions.get(0)
+                    if prior is not None:
+                        sup = _Suppression(prior.rules | rules,
+                                           prior.justification or why)
+                    self.suppressions[0] = sup
+                continue
+            # pragma alone on its line (modulo the comment) guards the
+            # next line; trailing pragma guards its own line
+            code = raw[: m.start()].strip()
+            self.suppressions[i if code else i + 1] = sup
+
+    def suppression_for(self, rule: str, line: int) -> Optional[_Suppression]:
+        for key in (line, 0):
+            sup = self.suppressions.get(key)
+            if sup is not None and rule in sup.rules:
+                return sup
+        return None
+
+    def segments(self) -> list[str]:
+        return self.path.split("/")
+
+
+class ProjectIndex:
+    """Every scanned module plus the scan root (for README lookups)."""
+
+    def __init__(self, root: str = "."):
+        self.root = root
+        self.modules: dict[str, Module] = {}
+        self.parse_errors: list[Finding] = []
+
+    def add_file(self, relpath: str) -> None:
+        abspath = os.path.join(self.root, relpath)
+        with open(abspath, encoding="utf-8") as f:
+            source = f.read()
+        self.add_source(relpath, source)
+
+    def add_source(self, relpath: str, source: str) -> None:
+        rel = relpath.replace(os.sep, "/")
+        try:
+            self.modules[rel] = Module(rel, source)
+        except SyntaxError as e:
+            self.parse_errors.append(Finding(
+                "DTL000", rel, e.lineno or 1, e.offset or 0,
+                f"syntax error: {e.msg}",
+            ))
+
+    def get(self, suffix: str) -> Optional[Module]:
+        """Module whose path ends with ``suffix`` (e.g. a surface file)."""
+        for path, mod in self.modules.items():
+            if path == suffix or path.endswith("/" + suffix):
+                return mod
+        return None
+
+    def readme_text(self) -> Optional[str]:
+        p = os.path.join(self.root, "README.md")
+        if not os.path.exists(p):
+            return None
+        with open(p, encoding="utf-8") as f:
+            return f.read()
+
+
+# ---------------------------------------------------------------------------
+# shared AST helpers (used by most rules)
+
+def dotted(node: ast.AST) -> str:
+    """'a.b.c' for Name/Attribute chains, '' for anything else."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def walk_scope(fn: ast.AST, *, into_sync: bool = True,
+               into_async: bool = True) -> Iterable[ast.AST]:
+    """Walk a function body without (optionally) descending into nested
+    function definitions — the unit most rules reason about."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, ast.FunctionDef) and not into_sync:
+            continue
+        if isinstance(node, ast.AsyncFunctionDef) and not into_async:
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def functions_of(tree: ast.AST) -> list[ast.AST]:
+    """Every (async) function definition in the module, at any depth."""
+    return [n for n in ast.walk(tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+
+
+# ---------------------------------------------------------------------------
+# runner
+
+def all_rules() -> list:
+    # local import: the rule modules import helpers from this module
+    from dynamo_tpu.lint import (  # noqa: F401 (re-export side effect)
+        dispatch,
+        excepts,
+        loopblock,
+        locks,
+        metrics_contract,
+        purity,
+        wire_errors,
+    )
+
+    return [
+        purity.JitPurityRule(),
+        loopblock.EventLoopBlockingRule(),
+        locks.LockDisciplineRule(),
+        dispatch.DispatchAccountingRule(),
+        metrics_contract.MetricsContractRule(),
+        wire_errors.TypedWireErrorRule(),
+        excepts.SwallowedExceptionRule(),
+    ]
+
+
+def _collect_files(paths: Iterable[str], root: str) -> list[str]:
+    out: list[str] = []
+    for p in paths:
+        ap = os.path.join(root, p)
+        if os.path.isfile(ap):
+            out.append(p)
+            continue
+        for dirpath, dirnames, filenames in os.walk(ap):
+            dirnames[:] = [d for d in dirnames
+                           if d not in ("__pycache__", ".git")]
+            for f in sorted(filenames):
+                if f.endswith(".py"):
+                    out.append(os.path.relpath(
+                        os.path.join(dirpath, f), root))
+    return sorted(set(out))
+
+
+def _run(index: ProjectIndex, rules: Optional[list] = None) -> list[Finding]:
+    findings: list[Finding] = list(index.parse_errors)
+    for rule in (rules if rules is not None else all_rules()):
+        findings.extend(rule.check(index))
+    for f in findings:
+        mod = index.modules.get(f.path)
+        if mod is None:
+            continue
+        sup = mod.suppression_for(f.rule, f.line)
+        if sup is not None:
+            f.suppressed = True
+            f.justification = sup.justification
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings
+
+
+def lint_paths(paths: Iterable[str], root: str = ".",
+               rules: Optional[list] = None) -> list[Finding]:
+    index = ProjectIndex(root)
+    for rel in _collect_files(paths, root):
+        index.add_file(rel)
+    return _run(index, rules)
+
+
+def lint_source(source: str, path: str, root: str = ".",
+                rules: Optional[list] = None) -> list[Finding]:
+    """Lint one in-memory module (the self-test fixture entry point)."""
+    index = ProjectIndex(root)
+    index.add_source(path, source)
+    return _run(index, rules)
+
+
+# ---------------------------------------------------------------------------
+# output
+
+def render_text(findings: list[Finding], show_suppressed: bool = False) -> str:
+    lines = []
+    shown = 0
+    for f in findings:
+        if f.suppressed and not show_suppressed:
+            continue
+        tag = " (suppressed)" if f.suppressed else ""
+        lines.append(
+            f"{f.path}:{f.line}:{f.col}: {f.rule} {f.message}{tag}")
+        shown += 1
+    active = sum(1 for f in findings if not f.suppressed)
+    suppressed = len(findings) - active
+    lines.append(
+        f"dynlint: {active} finding(s), {suppressed} suppressed")
+    return "\n".join(lines)
+
+
+def render_json(findings: list[Finding], show_suppressed: bool = True) -> str:
+    shown = [f for f in findings if show_suppressed or not f.suppressed]
+    active = sum(1 for f in findings if not f.suppressed)
+    by_rule: dict[str, int] = {}
+    for f in findings:
+        if not f.suppressed:
+            by_rule[f.rule] = by_rule.get(f.rule, 0) + 1
+    return json.dumps({
+        "findings": [f.as_dict() for f in shown],
+        "counts": {
+            "active": active,
+            "suppressed": len(findings) - active,
+            "by_rule": by_rule,
+        },
+        "exit_code": 1 if active else 0,
+    }, indent=2)

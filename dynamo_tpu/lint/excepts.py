@@ -1,0 +1,89 @@
+"""DTL007 — swallowed exceptions.
+
+A broad ``except``/``except Exception`` whose handler neither
+re-raises, logs, records, nor reports leaves no trace at all — in the
+engine round loop or a serving task that means a dead stream with an
+empty log, the single worst class of production bug to debug. The rule
+flags broad handlers whose body is pure swallowing (only ``pass`` /
+``continue`` / ``...`` / plain assignments); handlers that log
+(``log.*``/``logging.*``), raise, return an error value, increment a
+metric, or call any reporting function are fine — broad catches at
+loop boundaries are *policy* here, silent ones are the bug.
+"""
+from __future__ import annotations
+
+import ast
+
+from dynamo_tpu.lint.core import Finding, ProjectIndex, dotted
+
+_BROAD = {"Exception", "BaseException"}
+_LOGGER_HEADS = {"log", "logger", "logging", "warnings"}
+_REPORTING_ATTRS = {
+    "exception", "error", "warning", "info", "debug", "critical",
+    "warn", "inc", "record", "dump", "observe", "put", "put_nowait",
+    "set", "append", "add", "discard", "cancel", "close", "set_result",
+    "set_exception", "call_soon_threadsafe", "send", "fail",
+}
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True
+    if isinstance(t, ast.Name) and t.id in _BROAD:
+        return True
+    if isinstance(t, ast.Tuple):
+        return any(isinstance(e, ast.Name) and e.id in _BROAD
+                   for e in t.elts)
+    return False
+
+
+def _handler_reports(handler: ast.ExceptHandler) -> bool:
+    for node in ast.walk(handler):
+        if isinstance(node, (ast.Raise, ast.Return, ast.Yield,
+                             ast.Await)):
+            return True
+        # `except Exception as e:` followed by any use of `e` (stashing
+        # it in a result dict, wrapping it, formatting it) is recording,
+        # not swallowing
+        if (handler.name and isinstance(node, ast.Name)
+                and node.id == handler.name
+                and isinstance(node.ctx, ast.Load)):
+            return True
+        if isinstance(node, ast.Call):
+            name = dotted(node.func)
+            head = name.split(".")[0] if name else ""
+            leaf = name.split(".")[-1] if name else ""
+            if head in _LOGGER_HEADS:
+                return True
+            if leaf in _REPORTING_ATTRS:
+                return True
+            if not name:
+                continue
+    return False
+
+
+class SwallowedExceptionRule:
+    ID = "DTL007"
+    WHAT = ("broad except handlers must re-raise, log, or report — "
+            "silent swallowing loses the only evidence of the failure")
+
+    def check(self, index: ProjectIndex) -> list[Finding]:
+        findings: list[Finding] = []
+        for mod in index.modules.values():
+            if "/tests/" in mod.path or mod.path.startswith("tests/"):
+                continue
+            for node in ast.walk(mod.tree):
+                if not isinstance(node, ast.ExceptHandler):
+                    continue
+                if not _is_broad(node):
+                    continue
+                if _handler_reports(node):
+                    continue
+                findings.append(Finding(
+                    self.ID, mod.path, node.lineno, node.col_offset,
+                    "broad except swallows the exception silently — "
+                    "narrow the type, re-raise, or log it (even "
+                    "log.debug) so the failure leaves evidence",
+                ))
+        return findings

@@ -1,0 +1,93 @@
+"""DTL002 — event-loop blocking.
+
+The serving plane (runtime endpoints, the frontend, disagg, the KV
+transfer wire) is one asyncio loop per process; a single synchronous
+sleep, subprocess wait, or blocking file/network read inside an
+``async def`` stalls every in-flight stream on that loop — exactly the
+tail-latency bug the asyncio-debug smoke test catches only when a slow
+path happens to run. The rule flags blocking calls lexically inside
+``async def`` bodies; nested *sync* ``def``s are skipped (they may
+legitimately run in an executor — the call-site that schedules them is
+what must be async-clean).
+
+Scope: ``runtime/``, ``frontend/``, ``disagg.py``, ``kv_transfer.py``.
+"""
+from __future__ import annotations
+
+import ast
+
+from dynamo_tpu.lint.core import Finding, ProjectIndex, dotted, walk_scope
+
+_SCOPE_DIRS = ("runtime", "frontend")
+_SCOPE_FILES = ("disagg.py", "kv_transfer.py")
+
+_BLOCKING_CALLS = {
+    "time.sleep": "use `await asyncio.sleep(...)`",
+    "subprocess.run": "use `asyncio.create_subprocess_exec` or an executor",
+    "subprocess.call": "use `asyncio.create_subprocess_exec` or an executor",
+    "subprocess.check_call": "use asyncio subprocess or an executor",
+    "subprocess.check_output": "use asyncio subprocess or an executor",
+    "subprocess.Popen": "use `asyncio.create_subprocess_exec`",
+    "subprocess.getoutput": "use asyncio subprocess or an executor",
+    "os.system": "use `asyncio.create_subprocess_shell`",
+    "os.popen": "use asyncio subprocess",
+    "os.wait": "use asyncio subprocess",
+    "socket.create_connection": "use `asyncio.open_connection`",
+    "urllib.request.urlopen": "use aiohttp on the shared session",
+    "requests.get": "use aiohttp on the shared session",
+    "requests.post": "use aiohttp on the shared session",
+    "requests.request": "use aiohttp on the shared session",
+}
+
+# blocking waits on thread-synchronization objects: .wait()/.get() with a
+# timeout is still a loop stall; these are method names, so only flag the
+# combinations that are unambiguous in this codebase
+_BLOCKING_METHODS = {
+    "join": "thread/process join blocks the loop — wrap in an executor",
+}
+_BLOCKING_METHOD_RECEIVERS = ("thread", "_thread", "proc", "process")
+
+
+def _in_scope(segments: list[str]) -> bool:
+    return (any(seg in _SCOPE_DIRS for seg in segments[:-1])
+            or segments[-1] in _SCOPE_FILES)
+
+
+class EventLoopBlockingRule:
+    ID = "DTL002"
+    WHAT = ("no blocking calls (time.sleep, subprocess, sync sockets/IO) "
+            "inside async def bodies on the serving plane")
+
+    def check(self, index: ProjectIndex) -> list[Finding]:
+        findings: list[Finding] = []
+        for mod in index.modules.values():
+            if not _in_scope(mod.segments()):
+                continue
+            for fn in ast.walk(mod.tree):
+                if not isinstance(fn, ast.AsyncFunctionDef):
+                    continue
+                # direct body only: nested async defs are themselves
+                # walked by the outer loop; nested sync defs may run in
+                # executors and are out of scope
+                for node in walk_scope(fn, into_sync=False,
+                                       into_async=False):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    name = dotted(node.func)
+                    hint = _BLOCKING_CALLS.get(name)
+                    if hint is None and isinstance(node.func, ast.Attribute):
+                        meth = node.func.attr
+                        recv = dotted(node.func.value)
+                        if (meth in _BLOCKING_METHODS
+                                and recv.split(".")[-1]
+                                in _BLOCKING_METHOD_RECEIVERS):
+                            name = f"{recv}.{meth}"
+                            hint = _BLOCKING_METHODS[meth]
+                    if hint is None:
+                        continue
+                    findings.append(Finding(
+                        self.ID, mod.path, node.lineno, node.col_offset,
+                        f"blocking call {name}() inside async def "
+                        f"'{fn.name}' stalls the event loop — {hint}",
+                    ))
+        return findings

@@ -1,0 +1,215 @@
+"""DTL001 — jit-tracing purity.
+
+Functions reachable from a ``jax.jit``/``pjit`` entry point or a
+``lax.scan``/``fori_loop``/``while_loop``/``cond``/``switch`` body trace
+to a device program: host-side effects inside them either silently bake
+a constant into the compiled program (``time.time()``, ``np.random``)
+or crash at trace time on real inputs (``.item()``, ``float()`` on a
+tracer) — and the tiny-CPU test harness, which retraces eagerly, hides
+both. The rule builds the traced-function set per module (decorators,
+``x = jax.jit(fn)`` wrappers, control-flow body arguments, nested defs)
+and propagates it through direct calls, including ``module.fn`` calls
+into other scanned modules, then flags impure calls inside any traced
+body.
+
+Scope: ``models/``, ``ops/``, ``spec/`` (the modules that define traced
+programs; the engine's jits are built from these).
+"""
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from dynamo_tpu.lint.core import Finding, Module, ProjectIndex, dotted
+
+_SCOPE_DIRS = ("models", "ops", "spec")
+
+# call targets that are host-side effects inside a traced body
+_IMPURE_CALLS = {
+    "time.time", "time.monotonic", "time.perf_counter", "time.time_ns",
+    "time.monotonic_ns", "time.perf_counter_ns", "time.sleep",
+    "datetime.now", "datetime.datetime.now", "datetime.utcnow",
+    "random.random", "random.randint", "random.randrange",
+    "random.choice", "random.shuffle", "random.uniform", "random.seed",
+    "print",
+}
+_IMPURE_PREFIXES = ("np.random.", "numpy.random.", "jnp.random.")
+# method calls that force a tracer onto the host
+_CONCRETIZING_METHODS = {"item", "tolist"}
+
+_JIT_NAMES = {"jax.jit", "jit", "pjit", "jax.pjit", "jax.experimental.pjit"}
+# (dotted call, index of the traced-function argument(s))
+_BODY_ARGS = {
+    "jax.lax.scan": (0,), "lax.scan": (0,),
+    "jax.lax.fori_loop": (2,), "lax.fori_loop": (2,),
+    "jax.lax.while_loop": (0, 1), "lax.while_loop": (0, 1),
+    "jax.lax.cond": (1, 2), "lax.cond": (1, 2),
+    "jax.lax.map": (0,), "lax.map": (0,),
+    "jax.lax.associative_scan": (0,), "lax.associative_scan": (0,),
+    "jax.vmap": (0,), "vmap": (0,), "jax.checkpoint": (0,),
+}
+
+
+def _is_jit_call(call: ast.Call) -> bool:
+    """``jax.jit(...)`` or ``[functools.]partial(jax.jit, ...)``."""
+    name = dotted(call.func)
+    if name in _JIT_NAMES:
+        return True
+    if name in ("partial", "functools.partial") and call.args:
+        return dotted(call.args[0]) in _JIT_NAMES
+    return False
+
+
+class _ModuleFns:
+    """Function defs of one module, keyed for traced-set propagation."""
+
+    def __init__(self, mod: Module):
+        self.mod = mod
+        self.by_name: dict[str, ast.AST] = {}
+        self.parents: dict[ast.AST, Optional[ast.AST]] = {}
+        self.imports: dict[str, str] = {}  # local alias -> dotted module
+        self.from_imports: dict[str, tuple[str, str]] = {}
+        self._index(mod.tree, None)
+
+    def _index(self, node: ast.AST, parent: Optional[ast.AST]) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.by_name.setdefault(child.name, child)
+                self.parents[child] = parent
+                self._index(child, child)
+            elif isinstance(child, ast.Import):
+                for a in child.names:
+                    self.imports[a.asname or a.name.split(".")[0]] = a.name
+                self._index(child, parent)
+            elif isinstance(child, ast.ImportFrom):
+                for a in child.names:
+                    self.from_imports[a.asname or a.name] = (
+                        child.module or "", a.name)
+                self._index(child, parent)
+            else:
+                self._index(child, parent)
+
+
+class JitPurityRule:
+    ID = "DTL001"
+    WHAT = ("no host-side effects (time, np.random, print, .item()) in "
+            "functions reachable from jax.jit/pjit/lax control-flow bodies")
+
+    def check(self, index: ProjectIndex) -> list[Finding]:
+        findings: list[Finding] = []
+        mods = {
+            path: _ModuleFns(mod)
+            for path, mod in index.modules.items()
+            if any(seg in _SCOPE_DIRS for seg in mod.segments()[:-1])
+        }
+        traced: set[tuple[str, str]] = set()   # (path, fn name)
+        for path, mf in mods.items():
+            for name in self._roots(mf):
+                traced.add((path, name))
+        # propagate through direct calls until a fixed point
+        work = list(traced)
+        while work:
+            path, name = work.pop()
+            mf = mods.get(path)
+            fn = mf.by_name.get(name) if mf else None
+            if fn is None:
+                continue
+            for callee in self._callees(mf, fn, mods):
+                if callee not in traced:
+                    traced.add(callee)
+                    work.append(callee)
+        for path, name in sorted(traced):
+            mf = mods[path]
+            fn = mf.by_name[name]
+            findings.extend(self._check_body(mf, fn))
+        return findings
+
+    # -- traced-set construction ------------------------------------------
+
+    def _roots(self, mf: _ModuleFns) -> set[str]:
+        roots: set[str] = set()
+        for fn in mf.by_name.values():
+            for dec in getattr(fn, "decorator_list", []):
+                if dotted(dec) in _JIT_NAMES or (
+                        isinstance(dec, ast.Call) and _is_jit_call(dec)):
+                    roots.add(fn.name)
+        for node in ast.walk(mf.mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if _is_jit_call(node) and node.args:
+                tgt = node.args[0]
+                if isinstance(tgt, ast.Name) and tgt.id in mf.by_name:
+                    roots.add(tgt.id)
+            body_ix = _BODY_ARGS.get(dotted(node.func))
+            if body_ix:
+                for i in body_ix:
+                    if i < len(node.args):
+                        tgt = node.args[i]
+                        if (isinstance(tgt, ast.Name)
+                                and tgt.id in mf.by_name):
+                            roots.add(tgt.id)
+        return roots
+
+    def _callees(self, mf: _ModuleFns, fn: ast.AST,
+                 mods: dict[str, _ModuleFns]) -> set[tuple[str, str]]:
+        out: set[tuple[str, str]] = set()
+        for node in ast.walk(fn):
+            # nested defs run inside the trace
+            if node is not fn and isinstance(
+                    node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                out.add((mf.mod.path, node.name))
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted(node.func)
+            if not name:
+                continue
+            head, _, tail = name.partition(".")
+            if not tail and head in mf.by_name:
+                out.add((mf.mod.path, head))
+            elif tail and "." not in tail and head in mf.imports:
+                target = self._resolve(mf.imports[head], tail, mods)
+                if target:
+                    out.add(target)
+            elif not tail and head in mf.from_imports:
+                from_mod, orig = mf.from_imports[head]
+                target = self._resolve(from_mod, orig, mods)
+                if target:
+                    out.add(target)
+        return out
+
+    def _resolve(self, module_name: str, fn_name: str,
+                 mods: dict[str, _ModuleFns]
+                 ) -> Optional[tuple[str, str]]:
+        suffix = module_name.replace(".", "/") + ".py"
+        for path, mf in mods.items():
+            if (path.endswith(suffix) and fn_name in mf.by_name):
+                return (path, fn_name)
+        return None
+
+    # -- body check -------------------------------------------------------
+
+    def _check_body(self, mf: _ModuleFns, fn: ast.AST) -> list[Finding]:
+        findings: list[Finding] = []
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted(node.func)
+            bad = None
+            if name in _IMPURE_CALLS:
+                bad = f"call to {name}()"
+            elif name and name.startswith(_IMPURE_PREFIXES):
+                bad = f"call to {name}() (host-side RNG)"
+            elif (isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _CONCRETIZING_METHODS
+                    and not node.args):
+                bad = (f".{node.func.attr}() concretizes a tracer "
+                       "inside the trace")
+            if bad:
+                findings.append(Finding(
+                    self.ID, mf.mod.path, node.lineno, node.col_offset,
+                    f"{bad} inside jit-traced function "
+                    f"'{getattr(fn, 'name', '?')}' — traced code must be "
+                    "pure (the value bakes into the compiled program or "
+                    "crashes on a tracer)",
+                ))
+        return findings

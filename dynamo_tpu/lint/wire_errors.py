@@ -1,0 +1,114 @@
+"""DTL006 — typed wire errors.
+
+Two wires carry errors between processes: the endpoint data plane
+(``runtime/endpoint.py`` — frames marked ``retriable`` for
+``ConnectionError`` subclasses, ``overloaded`` + ``retry_after_s`` for
+``EngineOverloadedError``) and the block-transfer plane
+(``kv_transfer.py`` — nack frames with a ``kind`` the client maps back
+to a typed exception in ``_raise_nack``). Both contracts live in the
+registries below; the rule enforces:
+
+* every ``ConnectionError``-family exception class defined in the tree
+  must be registered here (a new retriable error type crosses the wire
+  the moment somebody raises it from a handler — registering it forces
+  the author to decide its frame mapping and the client-side re-raise);
+* every ``kind`` string written into or compared against a transfer
+  nack frame must be a registered kind.
+"""
+from __future__ import annotations
+
+import ast
+
+from dynamo_tpu.lint.core import Finding, ProjectIndex
+
+# exception class -> the endpoint-wire frame marker it maps to.
+# runtime/endpoint.py writes the frame server-side and call_endpoint
+# re-raises the class client-side; tests/test_overload.py and
+# tests/test_resilience.py pin the end-to-end behavior.
+WIRE_EXCEPTIONS: dict[str, str] = {
+    "EngineOverloadedError": "overloaded (+ retry_after_s)",
+    "PreemptedError": "retriable",
+    "WorkerDrainingError": "retriable",
+    "EndpointConnectionError": "retriable",
+    "ChaosInjectedError": "retriable",
+}
+
+# block-transfer nack kinds (kv_transfer.py `_err_kind`/`_raise_nack`):
+# integrity -> KvIntegrityError (retriable, quarantine + recompute),
+# frame/scatter -> BlockTransferError.
+WIRE_KINDS = frozenset({"integrity", "frame", "scatter"})
+
+# bases that make a class part of the retriable wire-error family
+_CONNECTION_BASES = {
+    "ConnectionError", "ConnectionResetError", "ConnectionAbortedError",
+    "ConnectionRefusedError", "BrokenPipeError",
+} | set(WIRE_EXCEPTIONS)
+
+_TRANSFER_MODULES = ("kv_transfer.py",)
+
+
+class TypedWireErrorRule:
+    ID = "DTL006"
+    WHAT = ("exceptions crossing the endpoint/transfer wire must map to "
+            "registered typed frames (WIRE_EXCEPTIONS / WIRE_KINDS)")
+
+    def check(self, index: ProjectIndex) -> list[Finding]:
+        findings: list[Finding] = []
+        for mod in index.modules.values():
+            if "/tests/" in mod.path or mod.path.startswith("tests/"):
+                continue
+            self._check_classes(mod, findings)
+            if any(mod.path.endswith(t) for t in _TRANSFER_MODULES):
+                self._check_kinds(mod, findings)
+        return findings
+
+    def _check_classes(self, mod, findings) -> None:
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            bases = {
+                b.id if isinstance(b, ast.Name) else
+                b.attr if isinstance(b, ast.Attribute) else ""
+                for b in node.bases
+            }
+            if not (bases & _CONNECTION_BASES):
+                continue
+            if node.name not in WIRE_EXCEPTIONS:
+                findings.append(Finding(
+                    self.ID, mod.path, node.lineno, node.col_offset,
+                    f"exception class '{node.name}' is in the retriable "
+                    "ConnectionError family but is not registered in "
+                    "dynamo_tpu/lint/wire_errors.py WIRE_EXCEPTIONS — "
+                    "decide its endpoint-wire frame mapping and register "
+                    "it",
+                ))
+
+    def _check_kinds(self, mod, findings) -> None:
+        for node in ast.walk(mod.tree):
+            kind_val, line, col = None, 0, 0
+            if isinstance(node, ast.Dict):
+                for k, v in zip(node.keys, node.values):
+                    if (isinstance(k, ast.Constant) and k.value == "kind"
+                            and isinstance(v, ast.Constant)):
+                        kind_val, line, col = v.value, v.lineno, v.col_offset
+            elif isinstance(node, ast.Compare):
+                # header.get("kind") == "x" client-side dispatch
+                left = node.left
+                if (isinstance(left, ast.Call)
+                        and isinstance(left.func, ast.Attribute)
+                        and left.func.attr == "get"
+                        and left.args
+                        and isinstance(left.args[0], ast.Constant)
+                        and left.args[0].value == "kind"
+                        and node.comparators
+                        and isinstance(node.comparators[0], ast.Constant)):
+                    c = node.comparators[0]
+                    kind_val, line, col = c.value, c.lineno, c.col_offset
+            if kind_val is not None and kind_val not in WIRE_KINDS:
+                findings.append(Finding(
+                    self.ID, mod.path, line, col,
+                    f"transfer nack kind {kind_val!r} is not a "
+                    "registered wire kind (WIRE_KINDS in "
+                    "dynamo_tpu/lint/wire_errors.py) — the client cannot "
+                    "map it back to a typed exception",
+                ))

@@ -18,9 +18,12 @@ says "come back in ten".
 """
 from __future__ import annotations
 
+import logging
 from typing import Callable, Optional
 
 from dynamo_tpu.overload.errors import EngineOverloadedError
+
+log = logging.getLogger(__name__)
 
 # Retry-After clamp: never tell a client to hammer faster than this,
 # never park it longer than that (the fleet may recover any moment).
@@ -72,6 +75,7 @@ class AdmissionController:
             try:
                 per_req = self._queue_wait_s()
             except Exception:  # noqa: BLE001 — a hint, never a failure
+                log.debug("queue-wait hint probe failed", exc_info=True)
                 per_req = None
         if per_req is None or per_req <= 0:
             per_req = DEFAULT_QUEUE_WAIT_S

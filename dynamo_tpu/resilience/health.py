@@ -12,11 +12,14 @@ the lease to expire would feed it traffic the whole time.
 """
 from __future__ import annotations
 
+import logging
 import time
 from typing import Callable, Iterable, Optional
 
 from dynamo_tpu.resilience.metrics import RESILIENCE
 from dynamo_tpu.resilience.policy import BreakerState, CircuitBreaker
+
+log = logging.getLogger(__name__)
 
 
 class WorkerHealthTracker:
@@ -152,7 +155,8 @@ class WorkerHealthTracker:
         try:
             self.on_state_change(worker_id, state, window_s)
         except Exception:  # noqa: BLE001 — publishing is best-effort
-            pass
+            log.warning("breaker state-change publish failed for %s",
+                        worker_id, exc_info=True)
 
     def forget(self, worker_id: str) -> None:
         """Worker left the fleet: drop its breaker + lease state."""

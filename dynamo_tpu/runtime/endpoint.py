@@ -94,7 +94,8 @@ class EndpointServer:
                     try:
                         await close()
                     except Exception:  # noqa: BLE001
-                        pass
+                        log.debug("handler stream close failed",
+                                  exc_info=True)
             writer.close()
 
 

@@ -9,10 +9,13 @@ PreprocessedRequest/LLMEngineOutput dicts (protocols/common.py to_dict).
 """
 from __future__ import annotations
 
+import logging
 from typing import Any, AsyncIterator, Optional
 
 from dynamo_tpu.runtime.component import Endpoint, EndpointClient, ServedEndpoint
 from dynamo_tpu.protocols.common import LLMEngineOutput, PreprocessedRequest
+
+log = logging.getLogger(__name__)
 
 
 async def invoke_clear(clear) -> int:
@@ -124,6 +127,8 @@ class RemoteEngine:
                 ):
                     total += int(item.get("cleared", 0))
             except Exception:  # noqa: BLE001 — best-effort per worker
+                log.warning("clear_kv broadcast failed on instance %s",
+                            iid, exc_info=True)
                 continue
         return total
 

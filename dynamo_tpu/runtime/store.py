@@ -145,6 +145,8 @@ class KvStore:
                       "req_id": rid})
                 return len(self._queues.get(queue, ()))
             except Exception:  # noqa: BLE001 — dead waiter; try the next
+                log.debug("queue waiter delivery failed; trying next",
+                          exc_info=True)
                 continue
         self._queues.setdefault(queue, deque()).append(value)
         return len(self._queues[queue])
@@ -187,7 +189,8 @@ class KvStore:
                             sink({"ok": True, "queue": queue, "empty": True,
                                   "req_id": rid})
                         except Exception:  # noqa: BLE001
-                            pass
+                            log.debug("expired-waiter notify failed",
+                                      exc_info=True)
                 else:
                     keep.append((sink, rid, deadline, alive))
             if keep:
@@ -215,6 +218,8 @@ class KvStore:
                     sink({"sub": sid, "topic": topic, "value": value})
                     n += 1
                 except Exception:  # noqa: BLE001
+                    log.debug("dropping dead subscriber %s", sid,
+                              exc_info=True)
                     self._subs.pop(sid, None)
         return n
 
@@ -237,6 +242,8 @@ class KvStore:
                 try:
                     w.sink(msg)
                 except Exception:  # noqa: BLE001 — one dead watcher can't stop others
+                    log.debug("dropping dead watcher %s", w.watch_id,
+                              exc_info=True)
                     self._watches.pop(w.watch_id, None)
 
 

@@ -59,8 +59,11 @@ class CriticalTask:
             self._task.cancel()
             try:
                 await self._task
-            except (asyncio.CancelledError, Exception):  # noqa: BLE001
+            except asyncio.CancelledError:
                 pass
+            except Exception:  # noqa: BLE001
+                log.debug("task %s raised during stop", self.name,
+                          exc_info=True)
             self._task = None
 
     @property

@@ -39,7 +39,8 @@ class FlightRecorder:
 
     @property
     def recorded_total(self) -> int:
-        return self._seq
+        with self._lock:
+            return self._seq
 
     def snapshot(self) -> list[dict[str, Any]]:
         """Events oldest -> newest."""
@@ -59,7 +60,8 @@ class FlightRecorder:
         events = self.snapshot()
         log.error(
             "flight recorder dump (%d of %d events)%s",
-            len(events), self._seq, f": {reason}" if reason else "",
+            len(events), self.recorded_total,
+            f": {reason}" if reason else "",
         )
         for ev in events:
             log.error("  flight %s", ev)

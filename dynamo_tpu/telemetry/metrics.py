@@ -66,11 +66,13 @@ class Histogram:
 
     @property
     def count(self) -> int:
-        return self._count
+        with self._lock:
+            return self._count
 
     @property
     def sum(self) -> float:
-        return self._sum
+        with self._lock:
+            return self._sum
 
     def snapshot(self) -> dict[str, Any]:
         """Wire form: cumulative counts aligned with ``buckets`` + +Inf."""

@@ -1,0 +1,65 @@
+"""Event-loop hygiene smoke test (slow): drive a representative
+control-plane workload with asyncio debug mode on and fail if any
+callback holds the loop for more than 100 ms.
+
+Asyncio's debug mode logs "Executing <Handle ...> took X seconds" on the
+``asyncio`` logger for every callback slower than
+``loop.slow_callback_duration`` — exactly the class of regression DTL002
+catches statically (a ``time.sleep``/blocking read smuggled into an
+async path) but measured, so it also catches blocking work the linter
+cannot see (C extensions, accidental O(n^2) handlers).
+"""
+import asyncio
+import logging
+
+import pytest
+
+from dynamo_tpu.runtime.client import KvClient
+from dynamo_tpu.runtime.store import serve_store
+
+
+class _SlowCallbackCatcher(logging.Handler):
+    def __init__(self):
+        super().__init__(level=logging.WARNING)
+        self.slow: list[str] = []
+
+    def emit(self, record):
+        msg = record.getMessage()
+        if "Executing" in msg and "took" in msg:
+            self.slow.append(msg)
+
+
+@pytest.mark.slow
+async def test_control_plane_has_no_slow_loop_callbacks():
+    loop = asyncio.get_running_loop()
+    catcher = _SlowCallbackCatcher()
+    alog = logging.getLogger("asyncio")
+    alog.addHandler(catcher)
+    prev_level = alog.level
+    alog.setLevel(logging.WARNING)
+    loop.set_debug(True)
+    loop.slow_callback_duration = 0.1
+    try:
+        server, store = await serve_store(port=0, sweep_interval_s=0.05)
+        port = server.sockets[0].getsockname()[1]
+        clients = [await KvClient(port=port).connect() for _ in range(4)]
+        try:
+            for round_ in range(25):
+                for i, c in enumerate(clients):
+                    await c.put(f"k/{i}/{round_}", "v" * 256)
+                    assert await c.get(f"k/{i}/{round_}") == "v" * 256
+                    await c.qpush(f"q/{i}", f"round-{round_}")
+                await asyncio.sleep(0)
+        finally:
+            for c in clients:
+                await c.close()
+            server.close()
+            await server.wait_closed()
+        # give debug-mode bookkeeping a tick to flush its warnings
+        await asyncio.sleep(0.05)
+    finally:
+        loop.set_debug(False)
+        alog.removeHandler(catcher)
+        alog.setLevel(prev_level)
+    assert not catcher.slow, (
+        "event-loop callbacks exceeded 100 ms:\n" + "\n".join(catcher.slow))

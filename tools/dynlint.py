@@ -1,0 +1,84 @@
+#!/usr/bin/env python
+"""dynlint CLI: run the project's static-analysis suite.
+
+  python tools/dynlint.py dynamo_tpu tools
+  python tools/dynlint.py --format json dynamo_tpu
+  python tools/dynlint.py --rules DTL003,DTL007 dynamo_tpu/engine
+
+Exit-status contract (pinned by tests/test_lint.py so CI can gate on
+it): 0 = no unsuppressed findings, 1 = at least one unsuppressed
+finding, 2 = usage/IO error. Suppressed findings never affect the exit
+code; ``--format json`` always includes them (with justifications) so a
+gate can also budget suppressions.
+
+Rules (one line each; full docs in README "Static analysis"):
+  DTL001  jit-tracing purity (no host effects in traced functions)
+  DTL002  event-loop blocking (no sync sleep/subprocess/IO in async def)
+  DTL003  lock discipline (guarded-by table for cross-thread fields)
+  DTL004  dispatch accounting (device work flows through dispatch_counts)
+  DTL005  metrics contract (HELP/TYPE, README row, 3 scrape surfaces)
+  DTL006  typed wire errors (registered error frames only)
+  DTL007  swallowed exceptions (broad except must leave evidence)
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+# repo-root invocation (python tools/dynlint.py) without install
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from dynamo_tpu.lint import (  # noqa: E402
+    all_rules,
+    lint_paths,
+    render_json,
+    render_text,
+)
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="dynlint", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("paths", nargs="+",
+                    help="files or directories to lint (relative to --root)")
+    ap.add_argument("--root", default=".",
+                    help="repo root (README.md lives here; default: cwd)")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--rules", default="",
+                    help="comma-separated rule IDs to run (default: all)")
+    ap.add_argument("--show-suppressed", action="store_true",
+                    help="include suppressed findings in text output")
+    args = ap.parse_args(argv)
+
+    rules = all_rules()
+    if args.rules:
+        wanted = {r.strip().upper() for r in args.rules.split(",")}
+        unknown = wanted - {r.ID for r in rules}
+        if unknown:
+            print(f"dynlint: unknown rule(s): {', '.join(sorted(unknown))}",
+                  file=sys.stderr)
+            return 2
+        rules = [r for r in rules if r.ID in wanted]
+
+    for p in args.paths:
+        if not os.path.exists(os.path.join(args.root, p)):
+            print(f"dynlint: no such path: {p}", file=sys.stderr)
+            return 2
+
+    try:
+        findings = lint_paths(args.paths, root=args.root, rules=rules)
+    except OSError as e:
+        print(f"dynlint: {e}", file=sys.stderr)
+        return 2
+
+    if args.format == "json":
+        print(render_json(findings))
+    else:
+        print(render_text(findings, show_suppressed=args.show_suppressed))
+    return 1 if any(not f.suppressed for f in findings) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
