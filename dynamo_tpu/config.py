@@ -73,6 +73,10 @@ class RuntimeConfig:
     max_waiting_requests: int = 0
     max_waiting_prefill_tokens: int = 0
     preempt_running: bool = False
+    # double-buffered round pipelining (engine/engine.py _round): hide
+    # host bookkeeping under device execution; off = legacy serialized
+    # round order (the differential-test baseline)
+    round_pipeline: bool = True
     # performance-attribution plane (telemetry/prof.py): per-round
     # host-segment timers + the SLO burn-rate gauges
     # dynamo_slo_{ttft,itl}_burn_rate over these targets

@@ -258,8 +258,17 @@ class PrefillWorker:
         for t in (gen_task, pending_task):
             if t is not None and not t.done():
                 wait_set.add(t)
+        # The safety timeout is a FALLBACK for a commit notification
+        # lost between waits, not the expected wake path — but when the
+        # engine batches several blocks into one seal the event can
+        # legitimately lag a full fused round, and at the old
+        # max(25x, 50 ms) every missed edge stalled the export stream
+        # long enough to erase the chunked-streaming TTFT win entirely
+        # (BENCH_r07's 0.9x regression). 5x the poll cadence floors at
+        # 10 ms: late commits still coalesce, a lost edge costs at most
+        # one round-ish of extra latency.
         done, _ = await asyncio.wait(
-            wait_set, timeout=max(self.stream_poll_s * 25, 0.05),
+            wait_set, timeout=max(self.stream_poll_s * 5, 0.01),
             return_when=asyncio.FIRST_COMPLETED,
         )
         if evt_task in done:

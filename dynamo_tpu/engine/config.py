@@ -47,6 +47,18 @@ class EngineConfig:
     # these only when D2H latency is high relative to step time.
     flush_every: int = 4
     max_inflight_rounds: int = 2
+    # double-buffered round pipelining: dispatch round N+1's fused
+    # program BEFORE consuming round N's packed fetch, so round N's
+    # host-side bookkeeping (emit, releases, transfers, offload) runs
+    # while round N+1 executes on device and steady-state wall-clock
+    # approaches max(host, device) instead of host + device. The
+    # pipeline flushes (falls back to the strict process-then-dispatch
+    # order) whenever slot state is about to change under it:
+    # admissions/prefills, pending release patches, seal-queue overflow
+    # past the fused width, speculating slots, and drain. `off` restores
+    # the pre-pipelining round order exactly (the differential tests
+    # compare the two).
+    round_pipeline: bool = True
     # prefill chunks dispatched per scheduling round: bounds how long a
     # round can stall decode behind prompt processing (the ITL-interference
     # problem disagg solves globally; this bounds it locally)

@@ -165,6 +165,12 @@ class _Request:
     # telemetry/trace.py), round-batched inter-token gaps as (gap_s, n),
     # and timestamps backing them
     trace_spans: list[dict] = field(default_factory=list)
+    # per-round decode/spec spans accumulate as raw tuples
+    # (kind, t0_monotonic, duration_s, n_tokens, spec_host) and are
+    # materialized into span dicts ONCE at finish (_final_annotations) —
+    # the per-round dict/round() churn was measurable annotate tax on
+    # the hot loop, paid even for requests whose trace nobody reads
+    round_spans: list[tuple] = field(default_factory=list)
     itl_gaps: list[tuple] = field(default_factory=list)
     t_prefill_start: Optional[float] = None
     t_last_emit: Optional[float] = None
@@ -444,6 +450,22 @@ class TpuEngine:
         self._prefilling: dict[int, _Request] = {}
         # host mirror of dispatch-time context lengths
         self._ctx_disp = np.ones(B, np.int32)
+        # numpy-backed slot-state mirrors (the slot_scan diet): updated
+        # incrementally at every slot transition (_slot_on/_slot_off —
+        # admission, despeculation, finish, release, fail_all) so the
+        # per-round scheduling decisions are O(1) numpy reductions over
+        # these instead of per-slot Python attribute walks.
+        #   _slot_active: occupied AND not finished AND not speculating
+        #   _slot_spec:   speculating (lane parked, verify-driven)
+        #   _slot_lp / _slot_sampler: the slot's contribution to the
+        #       round's want_lp / want_sample flags when active
+        self._slot_active = np.zeros(B, bool)
+        self._slot_spec = np.zeros(B, bool)
+        self._slot_lp = np.zeros(B, bool)
+        self._slot_sampler = np.zeros(B, bool)
+        # cached (active list, want_lp, want_sample); invalidated on any
+        # slot transition — steady decode recomputes it zero times/round
+        self._active_cache: Optional[tuple[list[int], bool, bool]] = None
 
         # device state dict
         self._dev = {
@@ -466,6 +488,11 @@ class TpuEngine:
 
         self._intake: queue_mod.Queue = queue_mod.Queue()
         self._xfer: queue_mod.Queue = queue_mod.Queue()  # page export/import
+        # idle-loop doorbell: producers (submit intake, _xfer_op page
+        # ops) set it after enqueueing so the idle sleep in _run_loop
+        # wakes immediately instead of finishing its 20 ms nap — the
+        # decode-side import latency that capped disagg chunk streaming
+        self._wake_evt = threading.Event()
         # chunked page exports in flight (kv_transfer chunk pipeline):
         # advanced a little every round, never blocking the loop
         self._xfer_streams: list[_ExportStream] = []
@@ -528,6 +555,21 @@ class TpuEngine:
         self._commit_cbs: list[Callable[[], None]] = []
         self._commit_lock = threading.Lock()
         self._last_metrics_pub = 0.0
+        # round-pipeline accounting (ecfg.round_pipeline): early-dispatch
+        # counters behind pipeline_stats() — pipeline_depth is the mean
+        # rounds in flight right after an early dispatch, overlap_ratio
+        # the fraction of pipelined-round host time spent in the
+        # completion half (i.e. running WHILE the early dispatch executes
+        # on device). pipe_flushes counts why the pipeline fell back to
+        # the strict order, per flush point.
+        self._pipe_dispatches = 0
+        self._pipe_depth_sum = 0
+        self._pipe_hidden_s = 0.0
+        self._pipe_host_s = 0.0
+        self.pipe_flushes: dict[str, int] = {
+            "drain": 0, "admission": 0, "release": 0,
+            "seal_overflow": 0, "spec": 0,
+        }
 
     # ------------------------------------------------------------------
     # jitted programs
@@ -764,12 +806,15 @@ class TpuEngine:
     # ---- prefix-commit event plane ----
 
     def subscribe_commits(self, cb: Callable[[], None]) -> None:
-        """Register a callback fired (from the engine thread) whenever a
-        batch of sealed blocks' pool copies has been DISPATCHED — the
-        committed prefix grew and exporting it is device-order safe.
-        Replaces fixed-cadence allocator polling for streaming export /
-        offload candidacy / replication consumers; callbacks must be
-        cheap and non-blocking (bounce to your own loop/queue)."""
+        """Register a callback fired (from the engine thread) whenever
+        the committed prefix grew: sealed blocks became MATCHABLE
+        (_queue_seal) or a seal batch's pool copies were dispatched.
+        Exporting on this signal is device-order safe because every
+        engine-loop export path flushes queued seal copies before its
+        pool read. Replaces fixed-cadence allocator polling for
+        streaming export / offload candidacy / replication consumers;
+        callbacks must be cheap and non-blocking (bounce to your own
+        loop/queue)."""
         with self._commit_lock:
             if cb not in self._commit_cbs:
                 self._commit_cbs.append(cb)
@@ -861,6 +906,7 @@ class TpuEngine:
         with self._wt_lock:
             self._waiting_tokens += len(r.tokens)
         self._intake.put(r)
+        self._wake_evt.set()
         try:
             while True:
                 item = await r.out.get()
@@ -1013,6 +1059,7 @@ class TpuEngine:
         out_q: queue_mod.Queue = queue_mod.Queue()
         self._xfer.put((kind, ids, (chunk_pages, inflight, out_q),
                         threading.Event(), {}))
+        self._wake_evt.set()
         return out_q
 
     def _next_stream_item(self, out_q: queue_mod.Queue) -> Any:
@@ -1022,7 +1069,12 @@ class TpuEngine:
         stop_grace: Optional[float] = None
         while True:
             try:
-                return out_q.get(timeout=1.0)
+                item = out_q.get(timeout=1.0)
+                # the consumer pull just freed an inflight slot — ring
+                # the doorbell so a throttled export stream dispatches
+                # its next chunk now, not after the idle sleep
+                self._wake_evt.set()
+                return item
             except queue_mod.Empty:
                 now = time.monotonic()
                 if self._stop.is_set():
@@ -1140,6 +1192,7 @@ class TpuEngine:
         done = threading.Event()
         box: dict[str, Any] = {}
         self._xfer.put((kind, list(page_ids), data, done, box))
+        self._wake_evt.set()
         # wait in slices. On stop, the loop-exit drain (or stop()'s final
         # drain) errors still-queued items; an in-flight op completes and
         # reports its real result — we only bound the wait, never clobber
@@ -1159,12 +1212,18 @@ class TpuEngine:
             raise box["error"]
         return box.get("result")
 
-    def _process_transfers(self) -> None:
+    def _process_transfers(self) -> bool:
+        """Service queued page-transfer ops. Returns True when at least
+        one op was processed — transfer traffic IS work, and counting it
+        keeps the loop hot while a disagg import stream is chunking
+        pages in (otherwise each chunk eats an idle-path sleep)."""
+        processed = False
         while True:
             try:
                 kind, ids, data, done, box = self._xfer.get_nowait()
             except queue_mod.Empty:
-                return
+                return processed
+            processed = True
             if kind != "import" and self._seal_queue:
                 # pool reads (exports, hash matches, clears) must see
                 # queued seal copies dispatched first — commits are
@@ -1335,10 +1394,9 @@ class TpuEngine:
                 # planner-facing signal for how deep speculation is
                 # actually running (0 when off / nothing speculates)
                 spec_effective_k=(
-                    self.spec.effective_k_mean([
-                        i for i, s in enumerate(self._slots)
-                        if s is not None and s.spec
-                    ]) if self.spec else 0.0
+                    self.spec.effective_k_mean(
+                        np.flatnonzero(self._slot_spec).tolist()
+                    ) if self.spec else 0.0
                 ),
             ),
             histograms=self._histograms_snapshot(),
@@ -1397,8 +1455,15 @@ class TpuEngine:
                         self.on_metrics(self.metrics())
                     except Exception:  # noqa: BLE001 — never kill the loop
                         log.exception("idle metrics publish failed")
+                # wait on the doorbell, not intake alone: _xfer_op page
+                # imports (disagg decode side) and intake both ring it,
+                # so either wakes the loop immediately. Clear BEFORE the
+                # non-blocking drain — a set racing the clear is seen on
+                # the next wait.
+                self._wake_evt.wait(timeout=0.02)
+                self._wake_evt.clear()
                 try:
-                    self._waiting.append(self._intake.get(timeout=0.02))
+                    self._waiting.append(self._intake.get_nowait())
                 except queue_mod.Empty:
                     pass
         self._drain_xfer_queue()
@@ -1425,25 +1490,56 @@ class TpuEngine:
 
     def _round(self) -> bool:
         """One scheduling round: process ready results, flush seal copies,
-        apply patches (releases, admissions), dispatch a round of steps."""
+        apply patches (releases, admissions), dispatch a round of steps.
+
+        With ``ecfg.round_pipeline`` the round runs double-buffered: when
+        the pipeline is clear (_pipeline_clear — nothing would mutate
+        slot state under an in-flight program) the NEXT fused program is
+        dispatched BEFORE this round's packed fetch is consumed, so the
+        completion half (fetch, emit, releases, transfer/offload
+        servicing) overlaps device execution and steady-state wall
+        approaches max(host, device) instead of host + device. Any flush
+        condition falls back to the exact pre-pipelining
+        process-then-dispatch order (counted in pipe_flushes)."""
         e = self.ecfg
         prof = self.prof
         prof.begin_round()
+        t_round = time.monotonic()
         prof.enter(_SEG_INTAKE)
         self._drain_intake()
         prof.enter(_SEG_SLOT_SCAN)
         self._enforce_bounds()
         rounds_in_flight = sum(1 for en in self._entries if en.kind == "round")
+        dispatched = False
+        t_pipe = 0.0
+        if (e.round_pipeline
+                and rounds_in_flight <= e.max_inflight_rounds
+                and self._pipeline_clear()):
+            # dispatch half FIRST (round pipelining): launch round N+1
+            # before consuming round N's fetch — everything below the
+            # dispatch runs while the device executes. The seal batch
+            # taken here is last round's (this round's completions queue
+            # theirs for the NEXT dispatch: one extra round of commit
+            # latency, still device-order safe).
+            active, want_lp, want_sample = self._active_slots()
+            if active:
+                prof.enter(_SEG_DISPATCH)
+                self._dispatch_round(active, want_lp, want_sample)
+                dispatched = True
+                rounds_in_flight += 1
+                self._pipe_dispatches += 1
+                self._pipe_depth_sum += rounds_in_flight
+                t_pipe = time.monotonic()
         prof.enter(_SEG_FETCH)
         self._process_entries(block=rounds_in_flight > e.max_inflight_rounds)
         # seals queued by result processing are NOT flushed here: they
-        # ride this round's fused dispatch (_dispatch_round). Pool
+        # ride the next fused dispatch (_dispatch_round). Pool
         # readers below (transfers, streams, offload, prefill_begin)
         # flush standalone first themselves.
         prof.enter(_SEG_RELEASES)
         self._apply_releases()
         prof.enter(_SEG_TRANSFER)
-        self._process_transfers()
+        xfer_work = self._process_transfers()
         stream_work = self._service_export_streams()
         prof.enter(_SEG_OFFLOAD)
         self._dispatch_offloads()
@@ -1452,32 +1548,48 @@ class TpuEngine:
         self._admit()
         prof.enter(_SEG_SLOT_SCAN)
 
-        # dispatch only for LIVE requests: a round for finished-awaiting-
-        # release slots is pure garbage work that also queues ahead of the
-        # next arrival's prefill (isolated-TTFT cost on an idling engine).
-        # Speculating slots are excluded — their device lanes are parked
-        # and they advance through verify dispatches instead.
-        active = [
-            i for i, s in enumerate(self._slots)
-            if s is not None and not s.finished and not s.spec
-        ]
-        did_work = bool(self._entries) or stream_work
+        # mid-flight prefills ARE work: without this a multi-chunk
+        # (disagg-shaped) prefill pays the idle-path intake sleep between
+        # every chunk — the r07 chunked-TTFT regression
+        did_work = (dispatched or bool(self._entries) or stream_work
+                    or xfer_work or bool(self._prefilling))
         rounds_in_flight = sum(1 for en in self._entries if en.kind == "round")
-        dispatched = False
-        if active and rounds_in_flight <= e.max_inflight_rounds:
-            prof.enter(_SEG_DISPATCH)
-            self._dispatch_round(active)
-            did_work = dispatched = True
-        if self.spec is not None:
+        if not dispatched and rounds_in_flight <= e.max_inflight_rounds:
+            # flushed / disabled pipeline: dispatch at the legacy
+            # position — after every patch above, the exact
+            # pre-pipelining order (what `round_pipeline=False` pins
+            # in the differential tests). Dispatch only for LIVE
+            # requests: a round for finished-awaiting-release slots is
+            # pure garbage work that also queues ahead of the next
+            # arrival's prefill. Speculating slots are excluded — their
+            # lanes are parked and they advance through verify
+            # dispatches instead.
+            active, want_lp, want_sample = self._active_slots()
+            if active:
+                prof.enter(_SEG_DISPATCH)
+                self._dispatch_round(active, want_lp, want_sample)
+                did_work = dispatched = True
+        if self.spec is not None and bool(self._slot_spec.any()):
             prof.enter(_SEG_SPEC)
             if self._dispatch_spec():
                 did_work = dispatched = True
-        if self._seal_queue:
-            # no round rode them this time (pipeline full / all-spec):
-            # dispatch standalone rather than letting commits sit
+        if self._seal_queue and (
+                not e.round_pipeline or not dispatched
+                or len(self._seal_queue) > self._seal_fuse_w):
+            # no fused ride is coming (nothing dispatched / pipelining
+            # off leaves no next-round ride guarantee) or the queue
+            # outgrew the fused width (admission burst): dispatch
+            # standalone rather than letting commits sit
             prof.enter(_SEG_SEAL_FLUSH)
             self._flush_seals()
             did_work = True
+        if t_pipe:
+            now = time.monotonic()
+            # completion-half host time that ran with the early dispatch
+            # in flight on device (the overlap_ratio numerator) vs the
+            # pipelined round's total host time
+            self._pipe_hidden_s += now - t_pipe
+            self._pipe_host_s += now - t_round
         # fold prof + refresh the SLO burn-rate gauges at the publish
         # cadence, not once per round — building ForwardPassMetrics every
         # round was measurable host tax and the pub/sub plane throttles
@@ -1509,7 +1621,93 @@ class TpuEngine:
         prof.end_round(record=did_work)
         return did_work
 
+    def _pipeline_clear(self) -> bool:
+        """True when the dispatch half may run BEFORE the completion half
+        (round pipelining): nothing pending may mutate slot state under
+        the in-flight program. Each False increments its pipe_flushes
+        bucket — the explicit flush points: drain, admissions
+        (waiting / mid-prefill / fresh intake), pending release patches,
+        seal-queue overflow past the fused width, and speculating slots
+        (their verify results re-shape the next round)."""
+        if self._draining:
+            self.pipe_flushes["drain"] += 1
+            return False
+        if self._waiting or self._prefilling or not self._intake.empty():
+            self.pipe_flushes["admission"] += 1
+            return False
+        if self._to_release:
+            self.pipe_flushes["release"] += 1
+            return False
+        if len(self._seal_queue) > self._seal_fuse_w:
+            self.pipe_flushes["seal_overflow"] += 1
+            return False
+        if self.spec is not None and bool(self._slot_spec.any()):
+            self.pipe_flushes["spec"] += 1
+            return False
+        return True
+
+    def _active_slots(self) -> tuple[list[int], bool, bool]:
+        """(active slot list, want_lp, want_sample) reduced from the
+        numpy slot-state mirrors; cached until the next slot transition
+        — steady decode pays zero per-slot Python scans per round."""
+        cached = self._active_cache
+        if cached is None:
+            idx = np.flatnonzero(self._slot_active)
+            cached = (
+                idx.tolist(),
+                bool(self._slot_lp[idx].any()),
+                bool(self._slot_sampler[idx].any()),
+            )
+            self._active_cache = cached
+        return cached
+
+    def _slot_on(self, slot: int, r: _Request) -> None:
+        """Mirror a slot becoming LIVE (fused-decode driven) into the
+        slot-state arrays. A slot needs the sampler if it samples OR
+        carries penalties — penalties apply to greedy decoding too, and
+        the counts histogram must advance for them to be correct."""
+        so = r.req.sampling_options
+        self._slot_active[slot] = True
+        self._slot_spec[slot] = False
+        self._slot_lp[slot] = r.req.output_options.logprobs is not None
+        self._slot_sampler[slot] = (
+            (so.temperature or 0.0) > 0.0
+            or (so.frequency_penalty or 0.0) != 0.0
+            or (so.presence_penalty or 0.0) != 0.0
+            or (so.repetition_penalty or 1.0) != 1.0
+        )
+        self._active_cache = None
+
+    def _slot_off(self, slot: int, spec: bool = False) -> None:
+        """Mirror a slot leaving the fused decode round (finish, release,
+        or — with ``spec`` — speculative admission/parking)."""
+        self._slot_active[slot] = False
+        self._slot_spec[slot] = spec
+        self._slot_lp[slot] = False
+        self._slot_sampler[slot] = False
+        self._active_cache = None
+
+    def pipeline_stats(self) -> dict:
+        """Round-pipeline effectiveness counters (profile_round
+        --dispatch-budget / bench): mean in-flight depth right after an
+        early dispatch, the fraction of pipelined-round host time spent
+        in the completion half (running under device execution), and the
+        per-reason flush counts."""
+        n = self._pipe_dispatches
+        return {
+            "round_pipeline": bool(self.ecfg.round_pipeline),
+            "pipelined_dispatches": n,
+            "pipeline_depth": round(self._pipe_depth_sum / n, 4) if n else 0.0,
+            "overlap_ratio": (
+                round(self._pipe_hidden_s / self._pipe_host_s, 4)
+                if self._pipe_host_s > 0 else 0.0
+            ),
+            "pipe_flushes": dict(self.pipe_flushes),
+        }
+
     def _drain_intake(self) -> None:
+        if self._intake.empty():
+            return  # steady decode: skip the Empty-exception round trip
         while True:
             try:
                 self._enqueue_waiting(self._intake.get_nowait())
@@ -1649,28 +1847,16 @@ class TpuEngine:
 
     # ---- dispatch side ----
 
-    def _dispatch_round(self, active: list[int]) -> None:
-        """Dispatch flush_every fused steps + one stacked-token fetch."""
+    def _dispatch_round(
+        self, active: list[int], want_lp: bool, want_sample: bool
+    ) -> None:
+        """Dispatch flush_every fused steps + one stacked-token fetch.
+        ``active``/``want_lp``/``want_sample`` come precomputed from the
+        slot-state mirrors (_active_slots) — plain-greedy rounds skip
+        the full sampler (argmax only), lp-free rounds skip the packed
+        logprob pipeline."""
         e = self.ecfg
         n = e.flush_every
-        # `active` is pre-filtered to live (non-finished) slots by _round
-        want_lp = any(
-            self._slots[i].req.output_options.logprobs is not None
-            for i in active
-        )
-
-        # plain-greedy rounds skip the full sampler (argmax only). A slot
-        # needs the sampler if it samples OR carries penalties — penalties
-        # apply to greedy decoding too, and the counts histogram must
-        # advance for them to be correct
-        def needs_sampler(i: int) -> bool:
-            so = self._slots[i].req.sampling_options
-            return ((so.temperature or 0.0) > 0.0
-                    or (so.frequency_penalty or 0.0) != 0.0
-                    or (so.presence_penalty or 0.0) != 0.0
-                    or (so.repetition_penalty or 1.0) != 1.0)
-
-        want_sample = any(needs_sampler(i) for i in active)
         # the round's pending seal batch rides the SAME program (the
         # dispatch diet: in steady decode a block completes nearly every
         # round, and the separate seal_blocks program was a per-round
@@ -1729,10 +1915,7 @@ class TpuEngine:
             # dispatch_counts
             seal_w=int(seal[3]) if seal is not None else 0,
             fetches=1 + (1 if lp_stacked is not None else 0),
-            spec_slots=[
-                i for i, s in enumerate(self._slots)
-                if s is not None and s.spec
-            ],
+            spec_slots=np.flatnonzero(self._slot_spec).tolist(),
             dispatch_ms=round((time.monotonic() - t_disp) * 1e3, 3),
         )
         # only dispatched lanes advance (spec slots track their own
@@ -1937,6 +2120,7 @@ class TpuEngine:
         r.spec = False
         r.spec_ready = False
         self.spec.on_despec(slot)
+        self._slot_on(slot, r)  # back into the fused round's active set
         self._ctx_disp[slot] = len(r.spec_tokens)
         self._dispatch_patch(admit=dict(
             slot=slot,
@@ -2041,20 +2225,15 @@ class TpuEngine:
                 r.itl_gaps.append((gap, n_tokens))
         r.t_last_emit = now
         r.decode_rounds += 1
-        if len(r.trace_spans) < _MAX_ROUND_SPANS and entry.t_dispatch:
-            sp = _span_dict(kind, entry.t_dispatch, tokens=n_tokens)
-            if entry.spec_host is not None:
-                # spec rounds carry draft/verify child spans so the
-                # speculation cost shows up inside timelines, not just
-                # as one opaque round span
-                draft_s, verify_s = entry.spec_host
-                t0 = sp["start_s"]
-                sp["children"] = [
-                    Span("spec_draft", t0, draft_s).to_dict(),
-                    Span("spec_verify", t0 + draft_s,
-                         verify_s).to_dict(),
-                ]
-            r.trace_spans.append(sp)
+        if (len(r.trace_spans) + len(r.round_spans) < _MAX_ROUND_SPANS
+                and entry.t_dispatch):
+            # annotate diet: the hot loop records one raw tuple; the
+            # span dicts (and spec draft/verify children) are built
+            # lazily at finish, when something actually reads the trace
+            r.round_spans.append((
+                kind, entry.t_dispatch, now - entry.t_dispatch,
+                n_tokens, entry.spec_host,
+            ))
 
     def _final_annotations(self, r: _Request) -> dict:
         """Annotations for the FINISHING output: speculation counters,
@@ -2093,6 +2272,32 @@ class TpuEngine:
             if v is not None:
                 timing[key] = round(v, 6)
         ann["timing"] = timing
+        if r.round_spans:
+            # materialize the lazily-accumulated round spans (same wire
+            # form _span_dict produced per round before the diet: the
+            # unix start is anchored off the shared monotonic clock)
+            wall_now = time.time()
+            mono_now = time.monotonic()
+            for kind, t0, dur, n_toks, spec_host in r.round_spans:
+                start = wall_now - (mono_now - t0)
+                sp: dict[str, Any] = {
+                    "name": kind, "start_s": round(start, 6),
+                    "duration_s": round(dur, 6),
+                    "attrs": {"tokens": n_toks},
+                }
+                if spec_host is not None:
+                    # spec rounds carry draft/verify child spans so the
+                    # speculation cost shows up inside timelines, not
+                    # just as one opaque round span
+                    draft_s, verify_s = spec_host
+                    t0_w = sp["start_s"]
+                    sp["children"] = [
+                        Span("spec_draft", t0_w, draft_s).to_dict(),
+                        Span("spec_verify", t0_w + draft_s,
+                             verify_s).to_dict(),
+                    ]
+                r.trace_spans.append(sp)
+            r.round_spans = []
         if r.trace_spans:
             ann["trace"] = {"spans": list(r.trace_spans)}
             rid = r.req.request_id
@@ -2146,6 +2351,16 @@ class TpuEngine:
         )
         for blk in r.seq.blocks[r.sealed_prefix:done_blocks]:
             self._queue_seal(r, blk.position, blk.block_hash, blk.parent_hash)
+        if done_blocks > r.sealed_prefix:
+            # blocks are MATCHABLE the moment _queue_seal commits them —
+            # notify now, not when their pool copy dispatches. On a
+            # prefill-only engine (disagg prefill worker) nothing else
+            # dispatches seals between export runs, so the deferred
+            # notification left the export stream riding its 10 ms
+            # safety timeout once per chunk; every engine-loop export
+            # path flushes queued seals before any pool read, so the
+            # earlier wake stays device-order safe.
+            self._notify_commits()
         r.sealed_prefix = max(r.sealed_prefix, done_blocks)
 
     def _take_seal_batch(self, width: Optional[int] = None):
@@ -2358,6 +2573,7 @@ class TpuEngine:
                 [b.parent_hash for b in sub],
                 payload,
             ))
+            self._wake_evt.set()
             chunk_spans.append(_span_dict(
                 "g4_chunk", t_prev, blocks=n, offset=offset,
             ))
@@ -2836,6 +3052,7 @@ class TpuEngine:
             # dispatches once the first token's fetch lands
             # (_process_first marks it spec-ready)
             r.spec = True
+            self._slot_off(slot, spec=True)
             r.spec_keys = np.asarray(step_keys, np.uint32)
             if self.spec.penalized(r.req):
                 # penalized slots carry the sampler's output-token
@@ -2845,6 +3062,7 @@ class TpuEngine:
                     self.config.vocab_size, np.int32
                 )
         else:
+            self._slot_on(slot, r)
             self._dispatch_patch(
                 admit=dict(
                     slot=slot,
@@ -3053,6 +3271,8 @@ class TpuEngine:
         if r.finished:
             return
         r.finished = True
+        if r.slot >= 0 and self._slots[r.slot] is r:
+            self._slot_off(r.slot)  # out of the dispatch set immediately
         if reason is not None:
             r.emit(LLMEngineOutput(
                 token_ids=[], finish_reason=reason,
@@ -3065,6 +3285,7 @@ class TpuEngine:
         for slot, r in enumerate(self._slots):
             if r is not None and r.cancelled and not r.finished:
                 r.finished = True
+                self._slot_off(slot)
                 self._to_release.append(r)
         if not self._to_release:
             return
@@ -3073,6 +3294,7 @@ class TpuEngine:
             if r.slot >= 0 and self._slots[r.slot] is r:
                 clear_slots.append(r.slot)
                 self._slots[r.slot] = None
+                self._slot_off(r.slot)
                 self._ctx_disp[r.slot] = 1
                 if self.spec is not None and r.spec:
                     self.spec.release(r.slot)  # drop stale draft KV state
@@ -3087,6 +3309,11 @@ class TpuEngine:
                 r.emit(err)
                 r.finished = True
         self._slots = [None] * self._B
+        self._slot_active[:] = False
+        self._slot_spec[:] = False
+        self._slot_lp[:] = False
+        self._slot_sampler[:] = False
+        self._active_cache = None
         if self.spec is not None:
             for i in range(self._B):
                 self.spec.release(i)

@@ -21,6 +21,16 @@ One logical worker backed by N host processes over a single
     never read device data (their shards' contribution flows through the
     collectives).
 
+Round pipelining (EngineConfig.round_pipeline) needs no follower-side
+change: the leader's _round may now EMIT round N+1's command before it
+has finished round N's host bookkeeping (fetch/emit), but commands are
+still broadcast in device-dispatch order — which is the only order a
+follower ever sees. The replay loop below is the completion-free
+"dispatch half" by construction (followers never fetch), so the
+pipelined leader simply narrows the host-side lag between itself and
+its followers; the lag bound stays flush_every * (max_inflight_rounds
++ 1) steps either way.
+
 Scope: the multihost engine serves the dense/MoE decode+prefill paths,
 batched prefill, and the sp ring prefill (its own broadcast command);
 host-offload tiers, the page transfer plane, and multimodal injection

@@ -128,6 +128,14 @@ def build_parser() -> argparse.ArgumentParser:
                         "stream (preemption-as-migration via the "
                         "resilience plane; exactly-once, greedy "
                         "token-identical)")
+    p.add_argument("--round-pipeline",
+                   default="on" if cfg.round_pipeline else "off",
+                   choices=["on", "off"],
+                   help="double-buffered round pipelining: dispatch "
+                        "round N+1 before blocking on round N's token "
+                        "fetch, hiding host bookkeeping under device "
+                        "execution; off restores the serialized round "
+                        "order (A/B + differential baseline)")
     # performance-attribution plane (telemetry/prof.py)
     p.add_argument("--prof-attribution",
                    default="on" if cfg.prof_attribution else "off",
@@ -535,6 +543,7 @@ def build_chain(args) -> "Any":
             max_waiting_requests=args.max_waiting_requests,
             max_waiting_prefill_tokens=args.max_waiting_prefill_tokens,
             preempt_running=args.preempt_running == "on",
+            round_pipeline=args.round_pipeline == "on",
             prof_attribution=args.prof_attribution == "on",
             slo_ttft_target_s=args.slo_ttft_target,
             slo_itl_target_s=args.slo_itl_target,

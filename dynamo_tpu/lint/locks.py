@@ -29,6 +29,18 @@ GUARDED_BY: dict[str, dict[str, str]] = {
         # commit-event subscribers: subscribe/unsubscribe on the disagg
         # thread, fired from the engine loop
         "_commit_cbs": "_commit_lock",
+        # Intentionally NOT listed (cross-thread but lock-free by
+        # design — keep this inventory honest when touching them):
+        #   _wake_evt          threading.Event doorbell: producers set()
+        #                      from serving/disagg threads, the engine
+        #                      loop wait()/clear()s; Event is internally
+        #                      synchronized.
+        #   _pipe_dispatches / _pipe_depth_sum / _pipe_hidden_s /
+        #   _pipe_host_s / pipe_flushes
+        #                      round-pipeline counters: written ONLY by
+        #                      the engine thread inside _round;
+        #                      pipeline_stats() performs advisory
+        #                      GIL-atomic reads for tools/bench.
     },
     "disagg.py": {
         # pending remote-prefill jobs: serving tasks add/discard, the

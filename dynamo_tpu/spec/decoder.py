@@ -72,29 +72,59 @@ class AdaptiveKController:
         self.despec_at = despec_at
         self.ewma = ewma
         self.min_obs = min_obs
-        self._k: dict[int, int] = {}
-        self._rate: dict[int, float] = {}
-        self._obs: dict[int, int] = {}
+        # slot-indexed state arrays (grown on demand — slots are engine
+        # lane indices, bounded by max_decode_slots in practice). NaN
+        # rate = never observed; arrays instead of per-slot dicts so the
+        # spec-round k lookups and the metrics-path effective-K mean are
+        # array reads, not dict traffic on the engine hot loop.
+        self._k = np.full(8, k_max, np.int32)
+        self._rate = np.full(8, np.nan, np.float64)
+        self._obs = np.zeros(8, np.int32)
         self.grow_total = 0
         self.shrink_total = 0
+
+    def _ensure(self, slot: int) -> None:
+        n = len(self._k)
+        if slot < n:
+            return
+        grow = max(slot + 1, 2 * n)
+        self._k = np.concatenate(
+            [self._k, np.full(grow - n, self.k_max, np.int32)])
+        self._rate = np.concatenate(
+            [self._rate, np.full(grow - n, np.nan, np.float64)])
+        self._obs = np.concatenate(
+            [self._obs, np.zeros(grow - n, np.int32)])
 
     def k_for(self, slot: int) -> int:
         # optimistic start at k_max: identical to static-K behavior until
         # evidence says otherwise
-        return self._k.get(slot, self.k_max)
+        if slot >= len(self._k):
+            return self.k_max
+        return int(self._k[slot])
+
+    def k_for_slots(self, slots) -> np.ndarray:
+        """Vectorized ``k_for`` over an index array (metrics path)."""
+        slots = np.asarray(slots, np.int64)
+        out = np.full(len(slots), self.k_max, np.int32)
+        mask = slots < len(self._k)
+        out[mask] = self._k[slots[mask]]
+        return out
 
     def rate_for(self, slot: int) -> Optional[float]:
-        return self._rate.get(slot)
+        if slot >= len(self._rate) or np.isnan(self._rate[slot]):
+            return None
+        return float(self._rate[slot])
 
     def observe(self, slot: int, accepted: int, k_used: int) -> None:
+        self._ensure(slot)
         step = accepted / max(k_used, 1)
-        prev = self._rate.get(slot)
-        rate = step if prev is None else (
+        prev = float(self._rate[slot])
+        rate = step if np.isnan(prev) else (
             self.ewma * prev + (1.0 - self.ewma) * step
         )
         self._rate[slot] = rate
-        self._obs[slot] = self._obs.get(slot, 0) + 1
-        k = self.k_for(slot)
+        self._obs[slot] += 1
+        k = int(self._k[slot])
         if rate >= self.grow_at and k < self.k_max:
             self._k[slot] = k + 1
             self.grow_total += 1
@@ -103,13 +133,17 @@ class AdaptiveKController:
             self.shrink_total += 1
 
     def should_despec(self, slot: int) -> bool:
-        return (self._obs.get(slot, 0) >= self.min_obs
-                and self._rate.get(slot, 1.0) <= self.despec_at)
+        # NaN (never observed) compares False against despec_at — the
+        # same "unknown slots are healthy" default as the old dict path
+        return (slot < len(self._obs)
+                and int(self._obs[slot]) >= self.min_obs
+                and bool(self._rate[slot] <= self.despec_at))
 
     def release(self, slot: int) -> None:
-        self._k.pop(slot, None)
-        self._rate.pop(slot, None)
-        self._obs.pop(slot, None)
+        if slot < len(self._k):
+            self._k[slot] = self.k_max
+            self._rate[slot] = np.nan
+            self._obs[slot] = 0
 
 
 class SpecDecoder:
@@ -292,12 +326,16 @@ class SpecDecoder:
     def acceptance_rate(self) -> float:
         return self.accepted_total / max(self.proposed_total, 1)
 
-    def effective_k_mean(self, slots: list[int]) -> float:
+    def effective_k_mean(self, slots) -> float:
         """Mean effective K over the given (speculating) slots — the
-        dynamo_spec_effective_k gauge; 0 when nothing speculates."""
-        if not slots:
+        dynamo_spec_effective_k gauge; 0 when nothing speculates.
+        Accepts a list or index array (the engine passes its
+        ``np.flatnonzero`` slot mask directly)."""
+        if len(slots) == 0:
             return 0.0
-        return sum(self.k_for(s) for s in slots) / len(slots)
+        if self.adaptive is None:
+            return float(self.k)
+        return float(self.adaptive.k_for_slots(slots).mean())
 
     def stats(self) -> dict[str, Any]:
         out = {

@@ -26,9 +26,13 @@ class FlightRecorder:
         self._lock = threading.Lock()
 
     def record(self, kind: str, **fields: Any) -> None:
-        ts = round(time.time(), 6)
+        # the dict is assembled OUTSIDE the lock (it's built from
+        # caller-local data; only the seq stamp and ring write need
+        # exclusion) — record() sits on the engine's dispatch hot path
+        ev = {"seq": 0, "ts": round(time.time(), 6), "kind": kind,
+              **fields}
         with self._lock:
-            ev = {"seq": self._seq, "ts": ts, "kind": kind, **fields}
+            ev["seq"] = self._seq
             self._seq += 1
             self._ring[self._next] = ev
             self._next = (self._next + 1) % self.capacity

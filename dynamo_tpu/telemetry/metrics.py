@@ -16,6 +16,8 @@ import math
 import threading
 from typing import Any, Optional
 
+import numpy as np
+
 # decode steps run ~1-100 ms, TTFT ~10 ms-10 s, E2E up to minutes: a
 # 1-2-3.5-5-7.5 per-decade ladder covers every request-latency series.
 # Resolution matters beyond dashboards — bench.py reports percentiles
@@ -62,6 +64,26 @@ class Histogram:
         with self._lock:
             self._counts[i] += n
             self._sum += value * n
+            self._count += n
+
+    def observe_many(self, values) -> None:
+        """Vectorized observe for a 1-D numpy batch: one searchsorted +
+        bincount and ONE lock acquisition instead of a Python bucket
+        scan per value (the prof-fold path observes up to 256 rounds x
+        14 segments per publish tick)."""
+        values = np.asarray(values, np.float64)
+        values = values[np.isfinite(values)]
+        n = int(values.size)
+        if not n:
+            return
+        # side="left": first edge with value <= edge, matching observe()
+        idx = np.searchsorted(np.asarray(self.buckets), values, side="left")
+        binc = np.bincount(idx, minlength=len(self.buckets) + 1)
+        total = float(values.sum())
+        with self._lock:
+            for i in np.flatnonzero(binc):
+                self._counts[i] += int(binc[i])
+            self._sum += total
             self._count += n
 
     @property

@@ -24,6 +24,8 @@ import threading
 import time
 from typing import Any, Optional
 
+import numpy as np
+
 from .metrics import Histogram, render_histogram
 
 # the host-round segment enum — the contract shared by the engine's
@@ -98,12 +100,20 @@ class RoundProf:
         self._in_round = False
         # cumulative since engine start (fold-independent, what
         # host_breakdown deltas read)
-        self.total = [0.0] * _N_SEG
+        self.total = np.zeros(_N_SEG)
         self.rounds = 0
         self.wall_total = 0.0
-        # recent rounds: (end_unix_s, wall_s, (per-seg seconds, ...))
-        self._ring: list[tuple] = []
-        self._unfolded: list[tuple] = []
+        # recent rounds live in PREALLOCATED numpy rings (metrics_fold
+        # diet: end_round writes one row, no per-round tuple/list churn,
+        # and the fold side reads whole columns vectorized). Record k
+        # occupies row k % RING; _rec_n counts records ever written and
+        # _fold_mark the count already drained — the unfolded window is
+        # the (at most RING) rows between them.
+        self._ring_ts = np.zeros(self.RING)       # end unix time
+        self._ring_wall = np.zeros(self.RING)     # round wall seconds
+        self._ring_acc = np.zeros((self.RING, _N_SEG))
+        self._rec_n = 0
+        self._fold_mark = 0
 
     # -- engine-thread hot path ----------------------------------------
 
@@ -142,39 +152,63 @@ class RoundProf:
         if not record:
             return  # idle spin — keep µs no-op rounds out of the stats
         wall = self._t - self._t_begin
-        acc = self._acc
-        total = self.total
-        for i in range(_N_SEG):
-            total[i] += acc[i]
+        row = self._rec_n % self.RING
+        self._ring_acc[row] = self._acc
+        self._ring_wall[row] = wall
+        self._ring_ts[row] = time.time()
+        self._rec_n += 1
+        self.total += self._ring_acc[row]
         self.rounds += 1
         self.wall_total += wall
-        rec = (time.time(), wall, tuple(acc))
-        self._ring.append(rec)
-        if len(self._ring) > self.RING:
-            del self._ring[: len(self._ring) - self.RING]
-        self._unfolded.append(rec)
-        if len(self._unfolded) > self.RING:
-            del self._unfolded[: len(self._unfolded) - self.RING]
 
     # -- fold / read side ----------------------------------------------
 
+    def _rows(self, n: int) -> np.ndarray:
+        """Ring rows of the newest ``n`` records, oldest first."""
+        return np.arange(self._rec_n - n, self._rec_n) % self.RING
+
+    def drain_arrays(self) -> Optional[np.ndarray]:
+        """Unfolded per-round segment matrix [n, N_SEG] (None if empty)
+        — the vectorized-fold feed. Advances the fold mark."""
+        n = min(self._rec_n - self._fold_mark, self.RING)
+        self._fold_mark = self._rec_n
+        if n <= 0:
+            return None
+        return self._ring_acc[self._rows(n)]
+
     def drain(self) -> list[tuple]:
-        out, self._unfolded = self._unfolded, []
-        return out
+        """Unfolded rounds as (end_unix_s, wall_s, (per-seg s, ...))
+        tuples — the legacy wire form (tests, ad-hoc tooling); the hot
+        fold path uses drain_arrays() and never builds these."""
+        n = min(self._rec_n - self._fold_mark, self.RING)
+        rows = self._rows(n)
+        self._fold_mark = self._rec_n
+        return [
+            (float(self._ring_ts[r]), float(self._ring_wall[r]),
+             tuple(self._ring_acc[r]))
+            for r in rows
+        ]
 
     def recent(self, n: int = 64) -> list[tuple]:
-        return list(self._ring[-n:])
+        n = min(n, self._rec_n, self.RING)
+        return [
+            (float(self._ring_ts[r]), float(self._ring_wall[r]),
+             tuple(self._ring_acc[r]))
+            for r in self._rows(n)
+        ]
 
     def totals(self) -> dict[str, Any]:
         """Cumulative attribution since engine start (seconds)."""
         return {
             "rounds": self.rounds,
             "wall_s": self.wall_total,
-            "segments": {s: self.total[i] for i, s in enumerate(SEGMENTS)},
+            "segments": {
+                s: float(self.total[i]) for i, s in enumerate(SEGMENTS)
+            },
         }
 
     def coverage(self) -> float:
-        return (sum(self.total) / self.wall_total
+        return (float(self.total.sum()) / self.wall_total
                 if self.wall_total > 0 else 1.0)
 
     def summary(self, top: int = 0) -> dict[str, Any]:
@@ -182,12 +216,10 @@ class RoundProf:
         recent-window (ring) per-round mean, sorted hottest first."""
         totals = self.totals()
         wall = totals["wall_s"]
-        recent = self.recent(self.RING)
-        r_wall = sum(w for _, w, _ in recent)
-        r_seg = [0.0] * _N_SEG
-        for _, _, acc in recent:
-            for i in range(_N_SEG):
-                r_seg[i] += acc[i]
+        n_recent = min(self._rec_n, self.RING)
+        rows_idx = self._rows(n_recent)
+        r_wall = float(self._ring_wall[rows_idx].sum())
+        r_seg = self._ring_acc[rows_idx].sum(axis=0)
         rows = []
         for i, s in enumerate(SEGMENTS):
             tot = totals["segments"][s]
@@ -196,7 +228,8 @@ class RoundProf:
                 "total_s": round(tot, 6),
                 "share": round(tot / wall, 4) if wall > 0 else 0.0,
                 "recent_mean_us": round(
-                    r_seg[i] / len(recent) * 1e6, 2) if recent else 0.0,
+                    float(r_seg[i]) / n_recent * 1e6, 2
+                ) if n_recent else 0.0,
             })
         rows.sort(key=lambda r: r["total_s"], reverse=True)
         if top:
@@ -205,9 +238,9 @@ class RoundProf:
             "enabled": self.enabled,
             "rounds": totals["rounds"],
             "wall_s": round(wall, 6),
-            "recent_rounds": len(recent),
+            "recent_rounds": n_recent,
             "recent_wall_ms_per_round": round(
-                r_wall / len(recent) * 1e3, 4) if recent else 0.0,
+                r_wall / n_recent * 1e3, 4) if n_recent else 0.0,
             "coverage_ratio": round(self.coverage(), 4),
             "segments": rows,
         }
@@ -249,15 +282,15 @@ class ProfRegistry:
     def fold(self, prof: RoundProf) -> None:
         """Drain a RoundProf's unfolded rounds into the histograms —
         called from the engine thread inside the metrics_fold segment, at
-        the publish cadence rather than per round."""
-        records = prof.drain()
-        if records:
+        the publish cadence rather than per round. Vectorized: one
+        observe_many per segment COLUMN of the drained [n, N_SEG] matrix
+        instead of a Python observe per (round, segment) cell."""
+        accs = prof.drain_arrays()
+        if accs is not None:
             hists = self._hists
-            for _, _, acc in records:
-                for i, s in enumerate(SEGMENTS):
-                    v = acc[i]
-                    if v > 0.0:
-                        hists[s].observe(v)
+            for i, s in enumerate(SEGMENTS):
+                col = accs[:, i]
+                hists[s].observe_many(col[col > 0.0])
         with self._lock:
             self._coverage = prof.coverage()
 

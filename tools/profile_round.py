@@ -220,13 +220,19 @@ def _spec_dispatch_mode(modes: list[str], n_req: int, osl: int) -> int:
     return 0
 
 
-def _dispatch_budget_mode(n_req: int, osl: int, kv_quant: str) -> int:
+def _dispatch_budget_mode(
+    n_req: int, osl: int, kv_quant: str,
+    round_pipeline: bool = True, baseline: str | None = None,
+) -> int:
     """Profile the PLAIN (non-spec) decode path's host tax: run a tiny
     engine through a steady-decode workload and report (one JSON line)
     the engine's dispatch_counts broken down per source, the
     dispatches-per-decode-round number the tier-1 regression test pins
     (tests/test_dispatch_budget.py), and host ms/step = wall − device —
     the exact gap BENCH_r06 showed as 6.53 ms wall vs 1.04 ms device.
+    Also reports the round-pipelining view (pipeline_depth,
+    overlap_ratio, flush counters) and, with --baseline <json of a
+    prior run>, the per-segment host_breakdown deltas against it.
     Run: python tools/profile_round.py --dispatch-budget"""
     import asyncio
 
@@ -243,6 +249,7 @@ def _dispatch_budget_mode(n_req: int, osl: int, kv_quant: str) -> int:
         num_pages=128, page_size=16, max_pages_per_seq=16,
         max_decode_slots=max(n_req, 2), prefill_buckets=(64,),
         cache_dtype="float32", kv_quant=kv_quant,
+        round_pipeline=round_pipeline,
     )
     eng = TpuEngine(cfg, ecfg, mesh_config=MeshConfig(tp=1))
     eng.start()
@@ -283,6 +290,7 @@ def _dispatch_budget_mode(n_req: int, osl: int, kv_quant: str) -> int:
                 "delta": delta, "prof": prof}
 
     stats = asyncio.run(run())
+    pipe = eng.pipeline_stats()
     asyncio.run(eng.stop())  # quiesce: the loop must not patch _dev
                              # while the blocking reps donate it
 
@@ -329,6 +337,25 @@ def _dispatch_budget_mode(n_req: int, osl: int, kv_quant: str) -> int:
         s: round(v / steps * 1e3, 5) for s, v in prof["segments"].items()
     }
     attributed = sum(prof["segments"].values())
+    extra: dict = {}
+    if baseline:
+        # per-segment deltas vs a prior --dispatch-budget JSON: negative
+        # = this run is cheaper. The diet's before/after in one field.
+        with open(baseline) as f:
+            base = json.load(f)
+        base_bd = base.get("host_breakdown") or {}
+        extra["baseline_deltas"] = {
+            "host_ms_per_step": round(
+                (wall_ms_per_step - device_ms_per_step)
+                - base.get("host_ms_per_step", 0.0), 4),
+            "device_ms_per_step": round(
+                device_ms_per_step - base.get("device_ms_per_step", 0.0),
+                4),
+            "host_breakdown": {
+                s: round(v - base_bd.get(s, 0.0), 5)
+                for s, v in host_breakdown.items()
+            },
+        }
     print(json.dumps({
         "mode": "dispatch-budget",
         "kv_quant": kv_quant,
@@ -348,6 +375,12 @@ def _dispatch_budget_mode(n_req: int, osl: int, kv_quant: str) -> int:
         "host_prof_rounds": prof["rounds"],
         "host_prof_coverage": round(
             attributed / prof["wall_s"], 4) if prof["wall_s"] > 0 else 1.0,
+        "round_pipeline": pipe["round_pipeline"],
+        "pipelined_dispatches": pipe["pipelined_dispatches"],
+        "pipeline_depth": round(pipe["pipeline_depth"], 4),
+        "overlap_ratio": round(pipe["overlap_ratio"], 4),
+        "pipe_flushes": pipe["pipe_flushes"],
+        **extra,
     }))
     return 0
 
@@ -366,6 +399,14 @@ if __name__ == "__main__":
     )
     ap.add_argument("--kv-quant", default="none", choices=["none", "int8"],
                     help="pool quantization for --dispatch-budget")
+    ap.add_argument("--round-pipeline", default="on",
+                    choices=["on", "off"],
+                    help="double-buffered round pipelining for "
+                         "--dispatch-budget (off = the serialized "
+                         "baseline to diff against)")
+    ap.add_argument("--baseline", default=None, metavar="JSON",
+                    help="a prior --dispatch-budget output file; adds "
+                         "per-segment host_breakdown deltas vs it")
     ap.add_argument("--requests", type=int, default=4,
                     help="concurrent requests (= speculating slots)")
     ap.add_argument("--osl", type=int, default=32,
@@ -374,7 +415,11 @@ if __name__ == "__main__":
     args = ap.parse_args()
     if args.dispatch_budget:
         raise SystemExit(
-            _dispatch_budget_mode(args.requests, args.osl, args.kv_quant)
+            _dispatch_budget_mode(
+                args.requests, args.osl, args.kv_quant,
+                round_pipeline=args.round_pipeline == "on",
+                baseline=args.baseline,
+            )
         )
     if args.spec:
         modes = (["off", "ngram", "draft", "draft-perslot"]
