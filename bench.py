@@ -32,26 +32,15 @@ import time
 
 BASELINE_DECODE_TOK_S = 51.22
 
-# chip peak table (bf16 FLOP/s, HBM B/s); device_kind -> (flops, bw)
-CHIP_PEAKS = {
-    "TPU v5e": (197e12, 819e9),
-    "TPU v5 lite": (197e12, 819e9),
-    "TPU v4": (275e12, 1228e9),
-    "TPU v6e": (918e12, 1640e9),
-}
-DEFAULT_PEAK = (197e12, 819e9)  # assume v5e if unknown
-
-
-def _chip_info():
-    import jax
-
-    dev = jax.devices()[0]
-    kind = dev.device_kind
-    on_accel = dev.platform != "cpu"
-    for name, peak in CHIP_PEAKS.items():
-        if name.lower() in kind.lower():
-            return kind, peak, on_accel
-    return kind, DEFAULT_PEAK, on_accel
+# chip peak table + per-step byte attribution live in dynamo_tpu.roofline
+# (shared with tools/profile_round.py); re-exported for callers that
+# import them from bench
+from dynamo_tpu.roofline import (  # noqa: E402
+    CHIP_PEAKS,
+    DEFAULT_PEAK,
+    decode_byte_accounting,
+)
+from dynamo_tpu.roofline import chip_info as _chip_info  # noqa: E402
 
 
 def _count_params(params) -> int:
@@ -268,12 +257,23 @@ async def run_bench() -> dict:
     weight_pass_ceiling = peak_bw / param_bytes      # steps/s if BW-bound
     roofline_frac = steps_per_s / weight_pass_ceiling
     mfu = decode_tok_s * 2 * n_params / peak_flops
+    # per-step byte attribution (dynamo_tpu/roofline.py): derived from
+    # the steady-state geometry every lane reaches by the end of the run
+    # — the same shape the device timing block below measures
+    byte_acct = decode_byte_accounting(
+        cfg, ecfg,
+        [min(prompt_len + max_tokens, ecfg.max_context)]
+        * ecfg.max_decode_slots,
+        param_bytes, steps_per_s=steps_per_s, peak_bw=peak_bw,
+    )
+    attn_roofline_frac = byte_acct["attn_roofline_frac"]
     if not on_accel:
         # CPU harness (tiny bench / CI): the denominators above are a
         # TPU's peak FLOPs/bandwidth, so "mfu 0.0 / roofline 0.0001"
         # would be bogus points polluting the perf trajectory — emit
-        # null for utilization fields that are meaningless on CPU
-        prefill_mfu = mfu = roofline_frac = None
+        # null for utilization fields that are meaningless on CPU. The
+        # BYTE fields stay: they are derived geometry, real on any host.
+        prefill_mfu = mfu = roofline_frac = attn_roofline_frac = None
 
     # ---- device-only time per fused round (dispatch + block) ----
     device_ms_per_step = None
@@ -351,6 +351,13 @@ async def run_bench() -> dict:
         "slo_itl_burn_rate": slo_burn.get("itl"),
         "mfu": mfu,
         "roofline_frac": roofline_frac,
+        # per-step byte attribution (derived, real even on CPU; the
+        # utilization FRACTION follows the on-accel honesty rule)
+        "kv_bytes_per_step": byte_acct["kv_bytes_per_step"],
+        "total_bytes_per_step": byte_acct["total_bytes_per_step"],
+        "bytes_per_step_breakdown": byte_acct["bytes_per_step_breakdown"],
+        "kv_ctx_bytes_vs_bf16": byte_acct["kv_ctx_bytes_vs_bf16"],
+        "attn_roofline_frac": attn_roofline_frac,
         "chip": chip,
         "params_m": n_params / 1e6,
         "batch": ecfg.max_decode_slots,
@@ -746,7 +753,14 @@ def main():
               "pipelined_dispatches", "pipeline_depth",
               "pipeline_overlap_ratio",
               "slo_ttft_burn_rate", "slo_itl_burn_rate", "mfu",
-              "roofline_frac", "chip", "params_m", "batch",
+              "roofline_frac",
+              # per-step byte attribution (dynamo_tpu/roofline.py):
+              # derived from geometry, so the byte fields are real even
+              # on CPU harnesses; attn_roofline_frac stays null there
+              "kv_bytes_per_step", "total_bytes_per_step",
+              "bytes_per_step_breakdown", "kv_ctx_bytes_vs_bf16",
+              "attn_roofline_frac",
+              "chip", "params_m", "batch",
               "core_error", "routing_error",
               "routing_kv_ttft_ms", "routing_random_ttft_ms",
               "routing_ttft_speedup",

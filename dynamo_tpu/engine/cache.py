@@ -14,6 +14,12 @@ module owns which page holds what:
 Parity: this is the engine-side half of what the reference gets from vLLM's
 prefix caching plus its own BlockPool (block_manager/pool.rs:156, sequence-
 hash registry block/registry.rs:490) and KvEventPublisher (publisher.rs:99).
+
+Representation-agnostic by design: with ``kv_quant=int8`` the device pages
+this allocator hands out hold int8 payloads + per-page scales, and since
+PR 14 the serving ctx shares that representation (group == page_size), so
+seal/admission copies are raw page moves — nothing here changes; a page is
+a page regardless of its element dtype.
 """
 from __future__ import annotations
 

@@ -204,14 +204,19 @@ class EngineConfig:
 
     # model memory
     cache_dtype: str = "bfloat16"
-    # paged-pool KV quantization: "none" (pool stores cache_dtype, the
-    # legacy A/B path) | "int8" (pool stores int8 pages with
-    # per-block-per-layer absmax scales; quantize fuses into the
-    # seal_blocks ctx->pool gather, dequantize into the load_ctx_pages
-    # admission copy — the hot decode path stays cache_dtype). Halves
-    # pool HBM residency, G2/G3 tier footprint, and the payload bytes of
-    # every disagg/G4/offload transfer; greedy outputs stay >=99%
-    # token-identical on the differential harness (tests/test_kv_quant).
+    # KV quantization: "none" (everything stores cache_dtype, the
+    # legacy A/B path) | "int8" (pool AND serving ctx store int8 with
+    # per-block-per-layer absmax scales — the ctx scale grid uses
+    # group == page_size, so seal/admission pool<->ctx copies are RAW
+    # int8 page moves with no quant/dequant pass at all). Prefill/span
+    # writes quantize on store, the once-per-round ring flush
+    # requantizes the touched scale groups, and the flash-decode kernel
+    # dequantizes each KV chunk in VMEM right after the DMA — live-
+    # context HBM traffic per step is ~halved while the QK/PV dots stay
+    # in the compute precision. Also halves pool HBM residency, G2/G3
+    # tier footprint, and the payload bytes of every disagg/G4/offload
+    # transfer; greedy outputs stay >=99% decisive-token-identical on
+    # the differential harness (tests/test_kv_quant).
     kv_quant: str = "none"
 
     # identity on the control plane

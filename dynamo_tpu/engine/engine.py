@@ -325,6 +325,15 @@ class TpuEngine:
         # consumer downstream of it) stores int8 pages + per-block
         # scales; the serving ctx region stays cache_dtype
         self.kv_quant = e.kv_quant == "int8"
+        # ctx region quantized too (in-kernel dequant decode hot path);
+        # one flag so mixed-precision experiments can split them later
+        self.ctx_quant = self.kv_quant
+        # ring-flush requantize geometry: every lane rewrites the same
+        # window of scale groups once per round (see llama._flush_ctx_quant)
+        _g = max(1, e.page_size)
+        _nG = -(-e.max_context // _g)
+        self._flush_groups_per_round = e.max_decode_slots * min(
+            -(-e.flush_every // _g) + 1, _nG)
         p_sh = llama.param_shardings(c, self.mesh)
         if params is None:
             params = llama.init_params(c, rng_seed)
@@ -338,11 +347,15 @@ class TpuEngine:
             llama.cache_shardings(c, self.mesh, kv_quant=e.kv_quant),
         )
         # contiguous per-slot serving context (+1 scratch lane for freed
-        # slots' in-flight garbage steps)
+        # slots' in-flight garbage steps). Under kv_quant=int8 the ctx
+        # region is int8 too (group == page_size scale grid), so the
+        # decode kernel streams half the live-KV bytes and pool<->ctx
+        # copies at seal/admission are raw int8 moves.
         self.ctx = jax.tree.map(
             lambda x, s: jax.device_put(x, s),
-            llama.init_ctx(c, e.max_decode_slots, e.max_context, cache_dtype),
-            llama.ctx_shardings(c, self.mesh),
+            llama.init_ctx(c, e.max_decode_slots, e.max_context, cache_dtype,
+                           kv_quant=e.kv_quant, group=e.page_size),
+            llama.ctx_shardings(c, self.mesh, kv_quant=e.kv_quant),
         )
         # decode write ring: the round's steps write here; flush_ctx
         # scatters it into the ctx region once per round (keeping the
@@ -1904,8 +1917,21 @@ class TpuEngine:
         )
         if seal is not None:
             if self.kv_quant:
-                KV_QUANT.inc("dynamo_kv_quant_pages_total", seal[3])
+                if self.ctx_quant:
+                    # ctx and pool share the int8 representation: the
+                    # fused seal moved raw pages, nothing requantized
+                    KV_QUANT.inc(
+                        "dynamo_kv_quant_ctx_seal_raw_pages_total",
+                        seal[3])
+                else:
+                    KV_QUANT.inc("dynamo_kv_quant_pages_total", seal[3])
             self._notify_commits()
+        if self.ctx_quant:
+            # ring flush requantized its per-lane window groups inside
+            # the same fused program (deterministic geometry: every lane
+            # touches the same window width each round)
+            KV_QUANT.inc("dynamo_kv_quant_ctx_flush_groups_total",
+                         self._flush_groups_per_round)
         self.flight.record(
             "round", slots=list(active), n_steps=n,
             # post-PR 7 round shape: seals ride the fused program
@@ -2412,7 +2438,11 @@ class TpuEngine:
             page_size=self.ecfg.page_size,
         )
         if self.kv_quant:
-            KV_QUANT.inc("dynamo_kv_quant_pages_total", n_real)
+            if self.ctx_quant:
+                KV_QUANT.inc(
+                    "dynamo_kv_quant_ctx_seal_raw_pages_total", n_real)
+            else:
+                KV_QUANT.inc("dynamo_kv_quant_pages_total", n_real)
         self._notify_commits()
 
     # ---- offload (G2 tier) ----
@@ -2855,6 +2885,11 @@ class TpuEngine:
                 self.ctx, self.cache, jnp.int32(slot),
                 jnp.asarray(padded),
             )
+            if self.kv_quant and self.ctx_quant:
+                # admission moved raw int8 pages + scales; the kernel
+                # dequantizes them in VMEM per chunk (no fused dequant)
+                KV_QUANT.inc("dynamo_kv_quant_ctx_admit_raw_pages_total",
+                             len(usable_pages))
         if matched_pages:
             # copy dispatched (if any) — device order lets us drop the
             # refs now (all matched refs, including dropped overflow)

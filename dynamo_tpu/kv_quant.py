@@ -2,26 +2,33 @@
 paged prefix pool.
 
 With ``kv_quant="int8"`` on EngineConfig the paged pool (G1 prefix-cache
-STORAGE) holds int8 pages with per-block-per-layer absmax scales; the hot
-decode path stays bf16 (the serving ctx region is untouched). The
-quantize happens once, inside the fused ``seal_blocks`` gather (ctx ->
-pool); the dequantize happens once, inside ``load_ctx_pages`` (pool ->
-ctx at admission). Everything DOWNSTREAM of the pool — G2/G3 host/disk
-tiers, disagg pushes, G4 peer fetches, export streams — moves the int8
-bytes plus the small scale sidecar, so a 16 GB chip holds ~2x the
-hittable prefix corpus and every transfer/offload path ships half the
-payload bytes.
+STORAGE) holds int8 pages with per-block-per-layer absmax scales, and the
+serving ctx region is int8 too: decode attention dequantizes each KV
+chunk in VMEM right after the DMA (ops/flash_decode.py), so live-context
+HBM traffic per step is ~halved. Quantization points: prefill/span
+writes quantize on store, the once-per-round ring flush requantizes the
+touched scale groups (the ring itself stays the compute dtype — it is
+tiny), and pool<->ctx copies at seal/admission are RAW int8 page moves
+(the group size equals the page size, so the representations are
+identical — no quant/dequant pass at the pool boundary at all).
+Everything DOWNSTREAM of the pool — G2/G3 host/disk tiers, disagg
+pushes, G4 peer fetches, export streams — moves the int8 bytes plus the
+small scale sidecar, so a 16 GB chip holds ~2x the hittable prefix
+corpus and every transfer/offload path ships half the payload bytes.
 
 This module owns the HOST representation: a page bundle (int8 data +
 f32 scales), host-side quantize/dequantize for tier/mode boundaries
 (a bf16 peer pushing into an int8 pool, or vice versa), the wire-header
 encoding (scales ride the JSON header of the existing two-part frames —
-they are ~1/(2*kvh*ps*hd) of the payload), and the ``dynamo_kv_quant_*``
+they are ~1/(2*kvh*ps*hd) of the payload), the shared DEVICE group-
+quantization helpers (``dequantize_groups``/``requantize_groups`` — the
+one absmax grid used by the ctx flush/span writes in models/llama.py and
+by the flash-decode reference path), and the ``dynamo_kv_quant_*``
 metric families rendered on all three scrape surfaces.
 
-Device-side quantize/dequantize lives in models/llama.py
-(seal_blocks/load_ctx_pages/gather_pages_q/scatter_pages_q) — fused into
-the existing pool-boundary programs, never a separate dispatch.
+The remaining device-side pool-boundary conversions (mixed dense/int8
+seal and load, gather_pages_q/scatter_pages_q) live in models/llama.py —
+fused into the existing programs, never a separate dispatch.
 """
 from __future__ import annotations
 
@@ -29,6 +36,7 @@ import time
 from dataclasses import dataclass
 from typing import Any, Optional
 
+import jax.numpy as jnp
 import numpy as np
 
 from dynamo_tpu.telemetry.metrics import CounterRegistry
@@ -47,6 +55,15 @@ FAMILIES: tuple[tuple[str, str, str], ...] = (
     ("dynamo_kv_pool_capacity_blocks", "gauge",
      "paged prefix-pool capacity in blocks (usable pages; int8 pools "
      "fit ~2x the blocks of a bf16 pool in the same HBM)"),
+    ("dynamo_kv_quant_ctx_seal_raw_pages_total", "counter",
+     "pages sealed ctx->pool as raw int8 copies (group size == page "
+     "size, so no requantize pass at the seal boundary)"),
+    ("dynamo_kv_quant_ctx_admit_raw_pages_total", "counter",
+     "pages admitted pool->ctx as raw int8 copies (no dequantize pass "
+     "at admission — the kernel dequantizes in VMEM per chunk)"),
+    ("dynamo_kv_quant_ctx_flush_groups_total", "counter",
+     "ctx scale groups covered by ring-flush requantize windows "
+     "(lanes x window groups, once per decode round)"),
 )
 
 _HISTOGRAMS: tuple[tuple[str, str], ...] = (
@@ -153,6 +170,66 @@ def from_wire(arr: np.ndarray, header: dict):
         header["kv_scales_shape"]
     )
     return QuantizedPages(arr, scales)
+
+
+# ---------------------------------------------------------------------------
+# Device-side group quantization (jnp; traced inside the fused round /
+# prefill programs — pure, no host effects).
+#
+# The int8 ctx region stores per-(layer, lane, position-group) absmax
+# scales with group == page_size. That granularity is deliberately the
+# POOL's granularity (one scale per [kvh, ps, hd] block, no head axis —
+# pinned by the PR 7 tier/wire format), so ctx<->pool copies are
+# representation-identical raw int8 moves. It is coarser than a
+# per-head grid, but the PR 7 measurements (max logprob delta 0.005 at
+# this exact grid) showed the quality budget is comfortable.
+#
+# Determinism rule: a write's scale depends ONLY on the request's own
+# data. `written` marks the groups a write overlaps (their scale is
+# recomputed); `valid` masks which window positions feed the absmax
+# (current-request prefix + the new span — NEVER the stale suffix left
+# by a previous slot occupant, which would make quantization depend on
+# slot-reuse history). Untouched groups keep their scale bit-exactly,
+# and dequant->requant with an unchanged scale is exact after rounding
+# (|q| <= 127 in f32), so they never drift.
+
+def dequantize_groups(
+    q: jnp.ndarray,        # int8 [L, kvh, N, W, hd]
+    scales: jnp.ndarray,   # f32 [L, N, W//group]
+    group: int,
+) -> jnp.ndarray:
+    """Per-group dequantize of N windows back to f32."""
+    L, kvh, N, W, hd = q.shape
+    g = q.reshape(L, kvh, N, W // group, group, hd).astype(jnp.float32)
+    out = g * scales[:, None, :, :, None, None]
+    return out.reshape(L, kvh, N, W, hd)
+
+
+def requantize_groups(
+    wf: jnp.ndarray,       # f32 [L, kvh, N, W, hd] — dequantized windows
+                           # with the new span already overlaid
+    old_scale: jnp.ndarray,  # f32 [L, N, W//group]
+    valid: jnp.ndarray,    # bool [N, W] — positions feeding the absmax
+    written: jnp.ndarray,  # bool [N, W//group] — groups whose scale is
+                           # recomputed (overlap the write)
+    group: int,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Requantize N windows: written groups get a fresh absmax scale
+    over their valid positions; untouched groups round-trip exactly
+    through their old scale. Returns (int8 windows, new scales)."""
+    L, kvh, N, W, hd = wf.shape
+    nW = W // group
+    gw = wf.reshape(L, kvh, N, nW, group, hd)
+    vm = valid.reshape(N, nW, group)
+    am = jnp.max(
+        jnp.where(vm[None, None, :, :, :, None], jnp.abs(gw), 0.0),
+        axis=(1, 4, 5),
+    )  # [L, N, nW]
+    fresh = jnp.maximum(am / 127.0, SCALE_EPS)
+    new_scale = jnp.where(written[None], fresh, old_scale)
+    div = jnp.maximum(new_scale, SCALE_EPS)[:, None, :, :, None, None]
+    q = jnp.clip(jnp.round(gw / div), -127, 127).astype(jnp.int8)
+    return q.reshape(L, kvh, N, W, hd), new_scale
 
 
 def to_pool_dtype(data: Any, quantized_pool: bool, dtype) -> Any:

@@ -67,10 +67,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--cache-dtype", default=cfg.cache_dtype)
     p.add_argument("--kv-quant", default=cfg.kv_quant,
                    choices=["none", "int8"],
-                   help="paged-pool KV quantization: int8 pages with "
-                        "per-block scales halve pool HBM residency, "
-                        "host/disk tier footprint and transfer bytes; "
-                        "the hot decode path stays --cache-dtype")
+                   help="KV quantization: int8 pool pages AND int8 "
+                        "decode ctx with per-group scales — the "
+                        "flash-decode kernel dequantizes each chunk "
+                        "in VMEM, halving live-context HBM traffic, "
+                        "pool residency, tier footprint and transfer "
+                        "bytes; the write ring stays --cache-dtype")
     p.add_argument("--host-offload-pages", type=int,
                    default=cfg.host_offload_pages,
                    help="host-DRAM KV offload tier capacity in pages "

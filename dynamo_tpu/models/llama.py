@@ -40,6 +40,11 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from dynamo_tpu.kv_quant import (
+    SCALE_EPS,
+    dequantize_groups,
+    requantize_groups,
+)
 from dynamo_tpu.models.config import ModelConfig
 from dynamo_tpu.ops.attention import (
     ctx_decode_attention,
@@ -210,20 +215,145 @@ def cache_is_quantized(cache: Cache) -> bool:
 
 
 def init_ctx(
-    config: ModelConfig, batch: int, ctx_len: int, dtype=None
+    config: ModelConfig, batch: int, ctx_len: int, dtype=None,
+    kv_quant: str = "none", group: int = 128,
 ) -> Cache:
     """Contiguous per-slot serving context ``[L, kvh, batch+1, S, hd]``.
     Lane `batch` is the scratch lane for freed slots' in-flight garbage
-    steps (see module doc / engine dest redirection)."""
+    steps (see module doc / engine dest redirection).
+
+    With ``kv_quant="int8"`` the region is int8 plus per-(layer, lane,
+    position-group) f32 absmax scales ``k_scale``/``v_scale``
+    [L, batch+1, S/group] — the flash-decode kernel dequantizes each KV
+    chunk in VMEM after the DMA, halving live-context HBM traffic.
+    ``group`` must be the engine's page_size so pool<->ctx copies at
+    seal/admission are raw int8 page moves (the scale grids coincide);
+    S is padded up to a multiple of it (the engine's max_context is
+    already page-aligned, so no padding in practice)."""
     c = config
-    dtype = dtype or jnp.dtype(c.dtype)
     shape = (c.num_layers, c.num_kv_heads, batch + 1, ctx_len, c.head_dim)
+    if kv_quant == "int8":
+        S = -(-ctx_len // group) * group
+        shape = shape[:3] + (S,) + shape[4:]
+        return {
+            "k": jnp.zeros(shape, jnp.int8),
+            "v": jnp.zeros(shape, jnp.int8),
+            "k_scale": jnp.zeros(
+                (c.num_layers, batch + 1, S // group), jnp.float32),
+            "v_scale": jnp.zeros(
+                (c.num_layers, batch + 1, S // group), jnp.float32),
+        }
+    dtype = dtype or jnp.dtype(c.dtype)
     return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
 
 
-def ctx_shardings(config: ModelConfig, mesh: Mesh) -> Cache:
+def ctx_shardings(config: ModelConfig, mesh: Mesh,
+                  kv_quant: str = "none") -> Cache:
     s = NamedSharding(mesh, P(None, "tp", None, None, None))
-    return {"k": s, "v": s}
+    out = {"k": s, "v": s}
+    if kv_quant == "int8":
+        # scales have no head axis: replicated over tp
+        sc = NamedSharding(mesh, P(None, None, None))
+        out["k_scale"] = sc
+        out["v_scale"] = sc
+    return out
+
+
+def ctx_is_quantized(ctx_kv: Cache) -> bool:
+    return "k_scale" in ctx_kv
+
+
+def ctx_group_size(ctx_kv: Cache) -> int:
+    """Position-group width of the int8 ctx scale grid."""
+    return ctx_kv["k"].shape[3] // ctx_kv["k_scale"].shape[2]
+
+
+def _ctx_compute_dtype(config: ModelConfig, ctx_kv: Cache):
+    """Dtype activations/attention run in. The dense ctx region doubles
+    as the compute dtype carrier; an int8 region cannot, so quantized
+    mode computes in the model dtype (engines pair cache_dtype with the
+    model dtype, so this is the same grid either way)."""
+    if ctx_is_quantized(ctx_kv):
+        return jnp.dtype(config.dtype)
+    return ctx_kv["k"].dtype
+
+
+def _ctx_slot_slab(ctx_kv: Cache, name: str, l: int, slot: jnp.ndarray,
+                   dtype, span: int = 0) -> jnp.ndarray:
+    """One slot's [kvh, S, hd] ctx slab in the compute dtype —
+    dequantizing on read when the region is int8 (prefill/score reads;
+    the decode hot path dequantizes inside the kernel instead)."""
+    slab = jax.lax.dynamic_index_in_dim(
+        ctx_kv[name][l], slot, axis=1, keepdims=False
+    )  # [kvh, S, hd]
+    if span > 0:
+        slab = slab[:, :span]
+    if not ctx_is_quantized(ctx_kv):
+        return slab
+    g = ctx_group_size(ctx_kv)
+    sc = jax.lax.dynamic_index_in_dim(
+        ctx_kv[name + "_scale"][l], slot, axis=0, keepdims=False
+    )  # [nG]
+    sc = jnp.repeat(sc, g)  # [S] per-position
+    if span > 0:
+        sc = sc[:span]
+    return (slab.astype(jnp.float32) * sc[None, :, None]).astype(dtype)
+
+
+def _quant_store_span(
+    buf: jnp.ndarray,      # int8 [L, kvh, lanes, S, hd]
+    scale: jnp.ndarray,    # f32 [L, lanes, nG]
+    slot: jnp.ndarray,     # scalar i32
+    start: jnp.ndarray,    # scalar i32 — span start position
+    span: jnp.ndarray,     # float [L, kvh, T, hd] — new KV rows
+    group: int,
+    valid_t: Optional[jnp.ndarray] = None,  # scalar i32 — leading span
+                           # rows that are REAL (rest is bucket padding)
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Quantize-on-store of a contiguous span into one slot's int8 ctx.
+
+    Works on the minimal group-aligned window covering [start, start+T):
+    gather window -> dequant -> overlay span -> requantize with fresh
+    absmax scales for the overlapped groups (absmax over the request's
+    own prefix + the span ONLY — stale suffix bytes from a previous
+    occupant never feed a scale, keeping quantization deterministic per
+    request history; see kv_quant.requantize_groups)."""
+    L, kvh, lanes, S, hd = buf.shape
+    nG = scale.shape[2]
+    T = span.shape[2]
+    nW = min((T + group - 1) // group + 1, nG)
+    W = nW * group
+    start = start.astype(jnp.int32)
+    g0 = jnp.clip(start // group, 0, nG - nW)
+    off = start - g0 * group  # in [0, W - T] by the window choice
+    flat = buf.reshape(L, kvh, lanes * S, hd)
+    base = slot.astype(jnp.int32) * S + g0 * group
+    win = jax.lax.dynamic_slice(
+        flat, (jnp.int32(0), jnp.int32(0), base, jnp.int32(0)),
+        (L, kvh, W, hd),
+    )[:, :, None]  # [L, kvh, 1, W, hd]
+    sw = jax.lax.dynamic_slice(
+        scale, (jnp.int32(0), slot.astype(jnp.int32), g0), (L, 1, nW)
+    )  # [L, 1, nW]
+    wf = dequantize_groups(win, sw, group)
+    wf = jax.lax.dynamic_update_slice(
+        wf, span.astype(jnp.float32)[:, :, None],
+        (0, 0, 0, off, 0),
+    )
+    vt = T if valid_t is None else jnp.clip(
+        valid_t.astype(jnp.int32), 0, T)
+    w_idx = jnp.arange(W, dtype=jnp.int32)
+    valid = (w_idx < off + vt)[None]                    # [1, W]
+    j = jnp.arange(nW, dtype=jnp.int32)
+    written = (((j + 1) * group > off) & (j * group < off + vt))[None]
+    q, s_new = requantize_groups(wf, sw, valid, written, group)
+    flat = jax.lax.dynamic_update_slice(
+        flat, q[:, :, 0], (jnp.int32(0), jnp.int32(0), base, jnp.int32(0))
+    )
+    scale = jax.lax.dynamic_update_slice(
+        scale, s_new, (jnp.int32(0), slot.astype(jnp.int32), g0)
+    )
+    return flat.reshape(L, kvh, lanes, S, hd), scale
 
 
 def init_ring(
@@ -474,7 +604,8 @@ def prefill_impl(
     positions = q_start + jnp.arange(T, dtype=jnp.int32)
     cos, sin = rope_cos_sin(positions, inv_freq)
 
-    h = _embed_rows(params, tokens, ctx_kv["k"].dtype)
+    cdt = _ctx_compute_dtype(c, ctx_kv)
+    h = _embed_rows(params, tokens, cdt)
     if embeds is not None:
         h = jnp.where(embeds_mask[:, None], embeds.astype(h.dtype), h)
 
@@ -496,12 +627,8 @@ def prefill_impl(
 
         def attend(q, kv, l=l):
             k_new, v_new = kv
-            k_ctx = jax.lax.dynamic_index_in_dim(
-                ctx_kv["k"][l], slot, axis=1, keepdims=False
-            )  # [kvh, S, hd]
-            v_ctx = jax.lax.dynamic_index_in_dim(
-                ctx_kv["v"][l], slot, axis=1, keepdims=False
-            )
+            k_ctx = _ctx_slot_slab(ctx_kv, "k", l, slot, cdt)
+            v_ctx = _ctx_slot_slab(ctx_kv, "v", l, slot, cdt)
             return ctx_prefill_attention(
                 q, k_ctx, v_ctx, k_new, v_new, q_start, seq_len
             )
@@ -511,19 +638,30 @@ def prefill_impl(
                            ffn_valid=positions < seq_len)
 
     # tail: one contiguous span write per buffer (all reads are done)
-    ck, cv = ctx_kv["k"], ctx_kv["v"]
-    upd_k = jnp.stack(new_ks).transpose(0, 2, 1, 3)[:, :, None]
-    upd_v = jnp.stack(new_vs).transpose(0, 2, 1, 3)[:, :, None]
-    ck = jax.lax.dynamic_update_slice(
-        ck, upd_k.astype(ck.dtype), (0, 0, slot, q_start, 0)
-    )
-    cv = jax.lax.dynamic_update_slice(
-        cv, upd_v.astype(cv.dtype), (0, 0, slot, q_start, 0)
-    )
+    upd_k = jnp.stack(new_ks).transpose(0, 2, 1, 3)  # [L, kvh, T, hd]
+    upd_v = jnp.stack(new_vs).transpose(0, 2, 1, 3)
+    if ctx_is_quantized(ctx_kv):
+        g = ctx_group_size(ctx_kv)
+        ck, ksc = _quant_store_span(
+            ctx_kv["k"], ctx_kv["k_scale"], slot, q_start, upd_k, g,
+            valid_t=seq_len - q_start)
+        cv, vsc = _quant_store_span(
+            ctx_kv["v"], ctx_kv["v_scale"], slot, q_start, upd_v, g,
+            valid_t=seq_len - q_start)
+        out_ctx = {"k": ck, "v": cv, "k_scale": ksc, "v_scale": vsc}
+    else:
+        ck, cv = ctx_kv["k"], ctx_kv["v"]
+        ck = jax.lax.dynamic_update_slice(
+            ck, upd_k[:, :, None].astype(ck.dtype), (0, 0, slot, q_start, 0)
+        )
+        cv = jax.lax.dynamic_update_slice(
+            cv, upd_v[:, :, None].astype(cv.dtype), (0, 0, slot, q_start, 0)
+        )
+        out_ctx = {"k": ck, "v": cv}
 
     last = seq_len - q_start - 1  # index of last valid token within T
     logits = _logits(c, params, h[last])
-    return {"k": ck, "v": cv}, logits
+    return out_ctx, logits
 
 
 prefill = jax.jit(prefill_impl, static_argnums=(0,), donate_argnums=(2,))
@@ -551,10 +689,12 @@ def _batch_forward(
         rope_inv_freq(c.head_dim, c.rope_theta, c.rope_scaling_dict)
     )
 
+    cdt = _ctx_compute_dtype(c, ctx_kv)
+
     def compute(toks, slot, q_start, seq_len):
         positions = q_start + jnp.arange(T, dtype=jnp.int32)
         cos, sin = rope_cos_sin(positions, inv_freq)
-        h = _embed_rows(params, toks, ctx_kv["k"].dtype)
+        h = _embed_rows(params, toks, cdt)
         new_ks: list[jnp.ndarray] = []
         new_vs: list[jnp.ndarray] = []
         for l in range(c.num_layers):
@@ -568,12 +708,10 @@ def _batch_forward(
             def attend(q, kv, l=l):
                 k_new, v_new = kv
                 if ctx_span > 0:
-                    k_ctx = jax.lax.dynamic_index_in_dim(
-                        ctx_kv["k"][l], slot, axis=1, keepdims=False
-                    )[:, :ctx_span]
-                    v_ctx = jax.lax.dynamic_index_in_dim(
-                        ctx_kv["v"][l], slot, axis=1, keepdims=False
-                    )[:, :ctx_span]
+                    k_ctx = _ctx_slot_slab(
+                        ctx_kv, "k", l, slot, cdt, span=ctx_span)
+                    v_ctx = _ctx_slot_slab(
+                        ctx_kv, "v", l, slot, cdt, span=ctx_span)
                 else:
                     k_ctx = v_ctx = None
                 return flash_prefill_attention(
@@ -583,8 +721,8 @@ def _batch_forward(
             h, _ = _layer_body(c, lp, h, cos, sin, write_kv, attend,
                                ffn_valid=positions < seq_len)
         return (
-            jnp.stack(new_ks).astype(ctx_kv["k"].dtype),
-            jnp.stack(new_vs).astype(ctx_kv["v"].dtype),
+            jnp.stack(new_ks).astype(cdt),
+            jnp.stack(new_vs).astype(cdt),
             h,
         )
 
@@ -597,10 +735,27 @@ def _write_chunks(
     vs: jnp.ndarray,
     slots: jnp.ndarray,
     q_starts: jnp.ndarray,
+    seq_lens: Optional[jnp.ndarray] = None,  # [K] i32 — bounds the rows
+                            # feeding int8 scales (padding excluded)
 ) -> Cache:
     """Tail pass: K span writes per buffer, K static (unrolled), after
-    every read — the donated update chain aliases in place."""
+    every read — the donated update chain aliases in place. Quantized
+    regions route each span through the group-requantize window
+    (_quant_store_span) instead of a raw DUS."""
     K = ks.shape[0]
+    if ctx_is_quantized(ctx_kv):
+        g = ctx_group_size(ctx_kv)
+        ck, ksc = ctx_kv["k"], ctx_kv["k_scale"]
+        cv, vsc = ctx_kv["v"], ctx_kv["v_scale"]
+        for i in range(K):
+            vt = None if seq_lens is None else seq_lens[i] - q_starts[i]
+            ck, ksc = _quant_store_span(
+                ck, ksc, slots[i], q_starts[i],
+                ks[i].transpose(0, 2, 1, 3), g, valid_t=vt)
+            cv, vsc = _quant_store_span(
+                cv, vsc, slots[i], q_starts[i],
+                vs[i].transpose(0, 2, 1, 3), g, valid_t=vt)
+        return {"k": ck, "v": cv, "k_scale": ksc, "v_scale": vsc}
     ck, cv = ctx_kv["k"], ctx_kv["v"]
     for i in range(K):
         upd_k = ks[i].transpose(0, 2, 1, 3)[:, :, None]  # [L,kvh,1,T,hd]
@@ -644,7 +799,7 @@ def batch_prefill_impl(
     ks, vs, h = _batch_forward(
         config, params, ctx_kv, tokens, slots, q_starts, seq_lens, ctx_span
     )
-    ctx_kv = _write_chunks(ctx_kv, ks, vs, slots, q_starts)
+    ctx_kv = _write_chunks(ctx_kv, ks, vs, slots, q_starts, seq_lens)
     last = jnp.maximum(seq_lens - q_starts - 1, 0)
     h_last = jnp.take_along_axis(h, last[:, None, None], axis=1)[:, 0]
     logits = _logits(config, params, h_last)
@@ -679,7 +834,7 @@ def batch_score_impl(
     ks, vs, h = _batch_forward(
         config, params, ctx_kv, tokens, slots, q_starts, seq_lens, ctx_span
     )
-    ctx_kv = _write_chunks(ctx_kv, ks, vs, slots, q_starts)
+    ctx_kv = _write_chunks(ctx_kv, ks, vs, slots, q_starts, seq_lens)
     return ctx_kv, _logits(config, params, h)
 
 
@@ -712,7 +867,7 @@ def batch_draft_impl(
     ks, vs, h = _batch_forward(
         config, params, ctx_kv, tokens, slots, q_starts, seq_lens, ctx_span
     )
-    ctx_kv = _write_chunks(ctx_kv, ks, vs, slots, q_starts)
+    ctx_kv = _write_chunks(ctx_kv, ks, vs, slots, q_starts, seq_lens)
     last = jnp.maximum(seq_lens - q_starts - 1, 0)
     h_last = jnp.take_along_axis(h, last[:, None, None], axis=1)[:, 0]
     logits = _logits(config, params, h_last)
@@ -734,7 +889,7 @@ def batch_draft_impl(
         ks, vs, h = _batch_forward(
             config, params, ctx_kv, toks_s, slots, pos, sl, ctx_span
         )
-        ctx_kv = _write_chunks(ctx_kv, ks, vs, slots, pos)
+        ctx_kv = _write_chunks(ctx_kv, ks, vs, slots, pos, sl)
         logits = _logits(config, params, h[:, 0])
         nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
         drafted = jax.lax.dynamic_update_slice_in_dim(
@@ -782,7 +937,8 @@ def decode_step_impl(
     positions = jnp.maximum(ctx_lens - 1, 0)
     cos, sin = rope_cos_sin(positions, inv_freq)  # [B, hd]
 
-    h = _embed_rows(params, tokens, ctx_kv["k"].dtype)  # [B, H]
+    h = _embed_rows(params, tokens, _ctx_compute_dtype(c, ctx_kv))  # [B, H]
+    quant = ctx_is_quantized(ctx_kv)
 
     # unrolled layers — see prefill_impl for why not lax.scan
     for l in range(c.num_layers):
@@ -803,6 +959,8 @@ def decode_step_impl(
                 q, ctx_kv["k"], ctx_kv["v"],
                 new_ring["k"], new_ring["v"], jnp.int32(l),
                 ctx_lens, ring_base,
+                ctx_k_scale=ctx_kv["k_scale"] if quant else None,
+                ctx_v_scale=ctx_kv["v_scale"] if quant else None,
             )
 
         h, ring = _layer_body(c, lp, h, cos, sin, write_kv, attend,
@@ -826,13 +984,24 @@ def flush_ctx_impl(
     of the round's reads — the single write aliases in place under
     donation). Ring entry (b, r) holds position ring_base[b]+r and goes to
     lane dest[b]; entries beyond valid_len[b], beyond the region length,
-    or belonging to freed slots are redirected to the scratch lane."""
+    or belonging to freed slots are redirected to the scratch lane.
+
+    Quantized regions (ctx_is_quantized) instead requantize the minimal
+    group-aligned WINDOW around each lane's ring span: gather old int8
+    window + scales, dequantize, overlay the valid ring entries, fresh
+    absmax scales for the groups the span overlaps (absmax over the
+    lane's own prefix + the new entries — never stale suffix bytes), and
+    scatter int8 + scales back. Still one fused pass inside the round
+    program — zero extra dispatches."""
     L, kvh, B, R, hd = ring["k"].shape
     S = ctx_kv["k"].shape[3]
     scratch = ctx_kv["k"].shape[2] - 1
     r_idx = jnp.arange(R, dtype=jnp.int32)[None, :]   # [1, R]
     pos = ring_base[:, None] + r_idx                  # [B, R]
     valid = (r_idx < valid_len[:, None]) & (pos < S)
+    if ctx_is_quantized(ctx_kv):
+        return _flush_ctx_quant(ctx_kv, ring, dest, ring_base, valid_len,
+                                valid)
     lane = jnp.where(valid, dest[:, None], scratch)   # [B, R]
     pos = jnp.where(valid, pos, 0)
     lflat = lane.reshape(-1)                          # [B*R]
@@ -846,6 +1015,65 @@ def flush_ctx_impl(
             # advanced dims ([B*R]) lead: target [B*R, kvh, hd]
             buf = buf.at[l, :, lflat, pflat].set(upd[l])
         out[name] = buf
+    return out
+
+
+def _flush_ctx_quant(
+    ctx_kv: Cache,
+    ring: Cache,
+    dest: jnp.ndarray,       # [B] i32 (freed slots -> scratch lane)
+    ring_base: jnp.ndarray,  # [B] i32
+    valid_len: jnp.ndarray,  # [B] i32
+    valid: jnp.ndarray,      # [B, R] bool — precomputed entry validity
+) -> Cache:
+    """Ring flush into an int8 ctx region (see flush_ctx_impl doc)."""
+    L, kvh, B, R, hd = ring["k"].shape
+    lanes, S = ctx_kv["k"].shape[2], ctx_kv["k"].shape[3]
+    g = ctx_group_size(ctx_kv)
+    nG = S // g
+    # window: enough group slots to hold a ring span at any alignment
+    nW = min(-(-R // g) + 1, nG)
+    W = nW * g
+    base = jnp.clip(ring_base.astype(jnp.int32), 0, S)
+    g0 = jnp.clip(base // g, 0, nG - nW)                       # [B]
+    lane = jnp.clip(dest.astype(jnp.int32), 0, lanes - 1)      # [B]
+    off = base - g0 * g                                        # [B]
+    # where each ring entry lands inside its lane's window; invalid
+    # entries (past valid_len / region end / vacated lanes) index W and
+    # are DROPPED from the overlay rather than redirected
+    w_of_r = jnp.where(
+        valid, off[:, None] + jnp.arange(R, dtype=jnp.int32)[None, :], W
+    )                                                          # [B, R]
+    # absmax inputs: the lane's own prefix + the new valid entries; the
+    # suffix beyond the span (stale bytes) never feeds a scale
+    w_idx = jnp.arange(W, dtype=jnp.int32)[None, :]            # [1, W]
+    span_end = off + jnp.clip(valid_len.astype(jnp.int32), 0, R)
+    valid_w = w_idx < span_end[:, None]                        # [B, W]
+    j = jnp.arange(nW, dtype=jnp.int32)[None, :]
+    written = ((j + 1) * g > off[:, None]) & (j * g < span_end[:, None])
+    written &= (valid_len > 0)[:, None]                        # [B, nW]
+    # per-lane flat gather/scatter indices for the int8 window
+    widx = (lane * S + g0 * g)[:, None] + jnp.arange(W)[None, :]
+    widx_f = widx.reshape(-1)                                  # [B*W]
+    gidx = g0[:, None] + j                                     # [B, nW]
+    b_idx = jnp.arange(B, dtype=jnp.int32)[:, None]            # [B, 1]
+
+    out = {}
+    for name in ("k", "v"):
+        flat = ctx_kv[name].reshape(L, kvh, lanes * S, hd)
+        win = flat[:, :, widx_f].reshape(L, kvh, B, W, hd)
+        sw = ctx_kv[name + "_scale"][:, lane[:, None], gidx]   # [L, B, nW]
+        wf = dequantize_groups(win, sw, g)
+        overlay = ring[name].astype(jnp.float32)               # [L,kvh,B,R,hd]
+        wf = wf.at[:, :, b_idx, w_of_r].set(overlay, mode="drop")
+        q, s_new = requantize_groups(wf, sw, valid_w, written, g)
+        # vacated lanes all alias the scratch lane: overlapping windows
+        # write garbage over garbage (scratch is garbage by contract)
+        flat = flat.at[:, :, widx_f].set(q.reshape(L, kvh, B * W, hd))
+        out[name] = flat.reshape(L, kvh, lanes, S, hd)
+        out[name + "_scale"] = ctx_kv[name + "_scale"].at[
+            :, lane[:, None], gidx
+        ].set(s_new)
     return out
 
 
@@ -878,13 +1106,48 @@ def load_ctx_pages_impl(
     S = ctx_kv["k"].shape[3]
     usable = min(n, S // ps)
     if usable <= 0:
-        return {"k": ctx_kv["k"], "v": ctx_kv["v"]}
+        return dict(ctx_kv)
     page_ids = page_ids[:usable]
-    quant = cache_is_quantized(cache)
+    pool_q = cache_is_quantized(cache)
+    ctx_q = ctx_is_quantized(ctx_kv)
+    if ctx_q:
+        # int8 ctx: the scale grids coincide (group == page_size by
+        # init_ctx contract), so a quantized pool admits as a RAW int8
+        # page copy + scale copy — no dequantize pass at all; the
+        # decode kernel dequantizes per chunk in VMEM. A dense pool
+        # (cross-mode peer) quantizes per page on the way in.
+        g = ctx_group_size(ctx_kv)
+        assert g == ps, (
+            f"int8 ctx group ({g}) must equal pool page_size ({ps}) — "
+            "init_ctx(group=page_size) is the engine contract"
+        )
+        out = dict(ctx_kv)
+        for name in ("k", "v"):
+            pages = cache[name][:, :, page_ids]  # [L, kvh, u, ps, hd]
+            L, kvh, _, _, hd = pages.shape
+            if pool_q:
+                q = pages
+                s = cache[name + "_scale"][:, page_ids]   # [L, u]
+            else:
+                pf = pages.astype(jnp.float32)
+                s = jnp.maximum(
+                    jnp.max(jnp.abs(pf), axis=(1, 3, 4)) / 127.0,
+                    SCALE_EPS)
+                q = jnp.clip(
+                    jnp.round(pf / s[:, None, :, None, None]), -127, 127
+                ).astype(jnp.int8)
+            span = q.reshape(L, kvh, usable * ps, hd)
+            out[name] = jax.lax.dynamic_update_slice(
+                ctx_kv[name], span[:, :, None], (0, 0, slot, 0, 0)
+            )
+            out[name + "_scale"] = jax.lax.dynamic_update_slice(
+                ctx_kv[name + "_scale"], s[:, None], (0, slot, 0)
+            )
+        return out
     out = {}
     for name in ("k", "v"):
         pages = cache[name][:, :, page_ids]      # [L, kvh, usable, ps, hd]
-        if quant:
+        if pool_q:
             # fused dequant: int8 pages * per-(layer, page) scale, in the
             # same admission-copy program — never a separate dispatch
             s = cache[name + "_scale"][:, page_ids]       # [L, usable]
@@ -909,7 +1172,20 @@ def write_ctx_span_impl(
 ) -> Cache:
     """Write a whole computed KV span into a slot's region at [0, T) —
     how sp_prefill's ring-computed prompt KV enters the serving context
-    (GSPMD gathers the sp-sharded span into the replicated region)."""
+    (GSPMD gathers the sp-sharded span into the replicated region).
+    Int8 ctx quantizes on store (fresh absmax scales for the covered
+    groups — same grid as the in-round writes)."""
+    if ctx_is_quantized(ctx_kv):
+        out = dict(ctx_kv)
+        g = ctx_group_size(ctx_kv)
+        T = kv["k"].shape[2]
+        zero = jnp.int32(0)
+        for name in ("k", "v"):
+            out[name], out[name + "_scale"] = _quant_store_span(
+                ctx_kv[name], ctx_kv[name + "_scale"], slot, zero,
+                kv[name], g, valid_t=jnp.int32(T),
+            )
+        return out
     out = {}
     for name in ("k", "v"):
         upd = kv[name][:, :, None]  # [L, kvh, 1, T, hd]
@@ -938,10 +1214,18 @@ def seal_blocks_impl(
 
     Quantized pools (cache_is_quantized) quantize in the SAME fused
     gather: per-(layer, page) absmax scales over the block's
-    [kvh, ps, hd] elements, int8 payload + scale scattered together —
-    the pool boundary is the one place KV precision drops."""
+    [kvh, ps, hd] elements, int8 payload + scale scattered together.
+    When the ctx region is int8 too (same group == page_size grid) the
+    seal degenerates to a RAW int8 copy: blocks and their scales move
+    verbatim, no requantize pass at the boundary at all."""
     ps = page_size
-    quant = cache_is_quantized(cache)
+    pool_q = cache_is_quantized(cache)
+    ctx_q = ctx_is_quantized(ctx_kv)
+    if ctx_q:
+        g = ctx_group_size(ctx_kv)
+        assert g == ps, (
+            f"int8 ctx group ({g}) must equal pool page_size ({ps})"
+        )
     out = {}
     for name in ("k", "v"):
         # ONE gather over the (lane, position)-flattened axis. The
@@ -954,7 +1238,27 @@ def seal_blocks_impl(
         flat = src.reshape(L, kvh, lanes * S, hd)
         idx = (slots * S + starts)[:, None] + jnp.arange(ps)[None, :]
         blocks = flat[:, :, idx]                 # [L, kvh, n, ps, hd]
-        if quant:
+        if ctx_q:
+            # blocks are already int8; their ctx scales are page-aligned
+            # (starts are block starts, ps == group), so the pool entry
+            # is the ctx entry moved verbatim
+            sc = ctx_kv[name + "_scale"][
+                :, slots, starts // ps
+            ]                                    # [L, n]
+            if pool_q:
+                out[name] = cache[name].at[:, :, pages].set(blocks)
+                out[name + "_scale"] = (
+                    cache[name + "_scale"].at[:, pages].set(sc)
+                )
+            else:
+                # cross-mode pool (dense): dequantize the blocks in the
+                # same fused gather before the dense scatter
+                dense = (blocks.astype(jnp.float32)
+                         * sc[:, None, :, None, None])
+                out[name] = cache[name].at[:, :, pages].set(
+                    dense.astype(cache[name].dtype))
+            continue
+        if pool_q:
             bf = blocks.astype(jnp.float32)
             s = jnp.max(jnp.abs(bf), axis=(1, 3, 4)) / 127.0   # [L, n]
             s = jnp.maximum(s, 1e-8)

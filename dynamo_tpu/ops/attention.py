@@ -51,16 +51,22 @@ def ctx_decode_attention(
     layer: jnp.ndarray,      # scalar i32
     ctx_lens: jnp.ndarray,   # [B] i32 — context length INCL. current token
     ring_base: jnp.ndarray,  # [B] i32 — position held by ring slot 0
+    ctx_k_scale: Optional[jnp.ndarray] = None,  # f32 [L, B(+1), S//g]
+    ctx_v_scale: Optional[jnp.ndarray] = None,  # when ctx is int8
 ) -> jnp.ndarray:
     """Decode attention over the two-tier context (ctx region below
     ring_base + ring above). The current token's KV must already be in the
-    ring. Returns [B, n_heads, hd]."""
+    ring. Returns [B, n_heads, hd]. When the ctx region is int8
+    (scales given), each KV chunk dequantizes in VMEM right after the
+    DMA — the HBM stream is the int8 bytes."""
     if _pallas_enabled():
         return flash_decode_attention(
-            q, ctx_k, ctx_v, ring_k, ring_v, layer, ctx_lens, ring_base
+            q, ctx_k, ctx_v, ring_k, ring_v, layer, ctx_lens, ring_base,
+            ctx_k_scale=ctx_k_scale, ctx_v_scale=ctx_v_scale,
         )
     return flash_decode_attention_reference(
-        q, ctx_k, ctx_v, ring_k, ring_v, layer, ctx_lens, ring_base
+        q, ctx_k, ctx_v, ring_k, ring_v, layer, ctx_lens, ring_base,
+        ctx_k_scale=ctx_k_scale, ctx_v_scale=ctx_v_scale,
     )
 
 
@@ -161,13 +167,16 @@ def flash_prefill_attention(
             pad = ((0, 0), (0, nblk * blk - S), (0, 0))
             k_src = jnp.pad(k_src, pad)
             v_src = jnp.pad(v_src, pad)
-        kb = k_src.reshape(kvh, nblk, blk, hd).transpose(1, 0, 2, 3)
-        vb = v_src.reshape(kvh, nblk, blk, hd).transpose(1, 0, 2, 3)
+        # scan over block starts and slice per step — the old
+        # reshape+transpose built a [nblk, kvh, blk, hd] copy of the
+        # whole source up front, so even exact-fit calls paid a full
+        # extra materialization of the context
         starts = jnp.arange(nblk, dtype=jnp.int32) * blk
 
-        def step(c, x):
+        def step(c, start):
             m, l, acc = c
-            k_blk, v_blk, start = x           # [kvh, blk, hd]
+            k_blk = jax.lax.dynamic_slice_in_dim(k_src, start, blk, 1)
+            v_blk = jax.lax.dynamic_slice_in_dim(v_src, start, blk, 1)
             k_rep = jnp.repeat(k_blk, n_rep, axis=0)
             v_rep = jnp.repeat(v_blk, n_rep, axis=0)
             s = jnp.einsum(
@@ -186,7 +195,7 @@ def flash_prefill_attention(
             )
             return (m_new, l_new, acc_new), None
 
-        carry, _ = jax.lax.scan(step, carry, (kb, vb, starts))
+        carry, _ = jax.lax.scan(step, carry, starts)
         return carry
 
     carry = (m0, l0, acc0)

@@ -39,6 +39,8 @@ out of the per-step critical path entirely.
 from __future__ import annotations
 
 import functools
+import logging
+import math
 import os
 
 import jax
@@ -46,9 +48,52 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+logger = logging.getLogger(__name__)
+
+# renamed TPUCompilerParams -> CompilerParams across jax releases
+_CompilerParams = getattr(
+    pltpu, "CompilerParams", None
+) or getattr(pltpu, "TPUCompilerParams")
+
 NEG_INF = -1e30
 
 DEFAULT_CHUNK = 512
+
+# chunk floor for the divisor fallback: below this the grid degenerates
+# into the per-invocation-overhead regime the kernel exists to avoid
+CHUNK_FLOOR = 128
+
+_chunk_warned: set = set()
+
+
+def _pick_chunk(S: int, want: int, step: int = 1) -> int:
+    """Chunk size for a context of S positions: ``want`` itself when it
+    already tiles S (and is a multiple of ``step`` — the int8 scale
+    group), else the largest divisor of S ≤ want that is a multiple of
+    step, promoted to the smallest divisor ≥ CHUNK_FLOOR if the best
+    candidate falls below it. The old ``gcd(want, S)`` fallback could
+    silently pick a tiny divisor (S=520 → chunk 8 → 66 grid invocations
+    per layer — the round-3 overhead cliff); log once per config when
+    the request is adjusted."""
+    want = max(1, min(want, S))
+    if S % want == 0 and want % step == 0:
+        return want
+    divs = [d for d in range(1, S + 1) if S % d == 0 and d % step == 0]
+    below = [d for d in divs if d <= want]
+    best = max(below) if below else min(divs)
+    floor = min(CHUNK_FLOOR, S)
+    if best < floor:
+        above = [d for d in divs if d >= floor]
+        if above:
+            best = min(above)
+    key = (S, want, step)
+    if key not in _chunk_warned:
+        _chunk_warned.add(key)
+        logger.info(
+            "flash_decode: chunk %d does not tile S=%d (group %d); "
+            "using %d", want, S, step, best,
+        )
+    return best
 
 
 def _kernel(
@@ -58,20 +103,29 @@ def _kernel(
     base_sm,     # [B] i32 — ring base positions
     # blocks
     q_ref,       # [SB, nkv, G, HD]
-    k_ref,       # [1, nkv, SB, CHUNK, HD]
+    k_ref,       # [1, nkv, SB, CHUNK, HD] — int8 when quantized
     v_ref,
-    rk_ref,      # [1, nkv, SB, R, HD]   ring lanes
-    rv_ref,
-    o_ref,       # [SB, nkv, G, HD]
-    # scratch
-    m_ref,       # [SB, nkv, G, 128] f32 running max
-    l_ref,       # [SB, nkv, G, 128] f32 running denom
-    acc_ref,     # [SB, nkv, G, HD] f32 running numerator
-    *,
+    # quantized only: ksc_ref/vsc_ref [1, SB, CHUNK//group] f32
+    # then:
+    # rk_ref,    # [1, nkv, SB, R, HD]   ring lanes (compute dtype)
+    # rv_ref,
+    # o_ref,     # [SB, nkv, G, HD]
+    # scratch:
+    # m_ref,     # [SB, nkv, G, 128] f32 running max
+    # l_ref,     # [SB, nkv, G, 128] f32 running denom
+    # acc_ref,   # [SB, nkv, G, HD] f32 running numerator
+    *refs,
     scale: float,
     chunk: int,
     sb: int,
+    quantized: bool,
 ):
+    if quantized:
+        ksc_ref, vsc_ref = refs[:2]
+        rk_ref, rv_ref, o_ref, m_ref, l_ref, acc_ref = refs[2:]
+    else:
+        ksc_ref = vsc_ref = None
+        rk_ref, rv_ref, o_ref, m_ref, l_ref, acc_ref = refs
     s_idx = pl.program_id(0)
     i = pl.program_id(1)
     n_chunks = pl.num_programs(1)  # ctx chunks + 1 ring chunk
@@ -117,9 +171,25 @@ def _kernel(
         @pl.when(jnp.logical_and(
             jnp.logical_not(is_ring), i * chunk < base))
         def _(j=j, ctx=ctx, base=base):
+            k = k_ref[0, :, j]                  # [nkv, chunk, HD]
+            v = v_ref[0, :, j]
+            if quantized:
+                # dequantize in VMEM, right after the DMA: the HBM
+                # stream was the int8 bytes; QK/PV dots stay in the
+                # compute precision
+                nkv, _, hd = k.shape
+                nGc = ksc_ref.shape[2]
+                grp = chunk // nGc
+                ks = ksc_ref[0, j]              # [chunk//grp] f32
+                vs = vsc_ref[0, j]
+                k = (k.astype(jnp.float32).reshape(nkv, nGc, grp, hd)
+                     * ks[None, :, None, None]
+                     ).reshape(nkv, chunk, hd).astype(q_ref.dtype)
+                v = (v.astype(jnp.float32).reshape(nkv, nGc, grp, hd)
+                     * vs[None, :, None, None]
+                     ).reshape(nkv, chunk, hd).astype(q_ref.dtype)
             accumulate(
-                j, k_ref[0, :, j], v_ref[0, :, j],
-                i * chunk, jnp.minimum(base, ctx), chunk,
+                j, k, v, i * chunk, jnp.minimum(base, ctx), chunk,
             )
 
         # ring chunk: slot r holds position base + r, valid below ctx
@@ -149,24 +219,27 @@ def flash_decode_attention(
     chunk: int = 0,
     interpret: bool = False,
     slot_block: int = 0,
+    ctx_k_scale: jnp.ndarray | None = None,  # f32 [L, B(+1), S//group]
+    ctx_v_scale: jnp.ndarray | None = None,  # (int8 ctx_k/ctx_v)
 ) -> jnp.ndarray:
     """Flash decode attention over contiguous KV + ring. Returns
     [B, n_heads, HD]. The current token's KV must already be in the ring
     (position ctx-1 == ring_base + r for the step's ring slot r).
-    chunk/slot_block of 0 pick the defaults (env-overridable)."""
+    chunk/slot_block of 0 pick the defaults (env-overridable). With
+    ctx scales given, ctx_k/ctx_v are int8 and each chunk dequantizes in
+    VMEM after its DMA (half the live-context HBM bytes)."""
     B, n_heads, hd = q.shape
     L, nkv, _, S, _ = ctx_k.shape
     R = ring_k.shape[3]
     g = n_heads // nkv
+    quantized = ctx_k_scale is not None
     if chunk <= 0:
         chunk = int(os.environ.get("DYNAMO_FLASH_CHUNK", DEFAULT_CHUNK))
     if slot_block <= 0:
         slot_block = int(os.environ.get("DYNAMO_FLASH_SB", 1))
-    # chunk must tile S exactly; gcd rounds it down to a divisor (legal
-    # configs can make S a non-multiple of the default chunk)
-    import math
-
-    chunk = math.gcd(min(chunk, S), S)
+    # chunk must tile S exactly (and whole scale groups when quantized)
+    group = S // ctx_k_scale.shape[2] if quantized else 1
+    chunk = _pick_chunk(S, chunk, group)
     sb = math.gcd(slot_block, B)
     scale = float(1.0 / (hd ** 0.5))
     qg = q.reshape(B, nkv, g, hd)
@@ -177,31 +250,50 @@ def flash_decode_attention(
     def q_map(s, i, layer, ctx, base):
         return (s, 0, 0, 0)
 
-    def kv_map(s, i, layer, ctx, base):
+    def _grp_live(s, base):
         # chunks beyond the slot GROUP's longest live context repeat the
         # previous index so the pipeline skips the (unused) DMA
         # scalar loads only in index maps (SMEM): unrolled group max
         grp_max = base[s * sb]
         for j in range(1, sb):
             grp_max = jnp.maximum(grp_max, base[s * sb + j])
-        live = jnp.maximum((grp_max + chunk - 1) // chunk - 1, 0)
-        return (layer[0], 0, s, jnp.minimum(i, live), 0)
+        return jnp.maximum((grp_max + chunk - 1) // chunk - 1, 0)
+
+    def kv_map(s, i, layer, ctx, base):
+        return (layer[0], 0, s, jnp.minimum(i, _grp_live(s, base)), 0)
+
+    def sc_map(s, i, layer, ctx, base):
+        return (layer[0], s, jnp.minimum(i, _grp_live(s, base)))
 
     def ring_map(s, i, layer, ctx, base):
         return (layer[0], 0, s, 0, 0)
 
+    in_specs = [
+        pl.BlockSpec((sb, nkv, g, hd), q_map),
+        pl.BlockSpec((1, nkv, sb, chunk, hd), kv_map),
+        pl.BlockSpec((1, nkv, sb, chunk, hd), kv_map),
+    ]
+    inputs = [qg, ctx_k, ctx_v]
+    if quantized:
+        in_specs += [
+            pl.BlockSpec((1, sb, chunk // group), sc_map),
+            pl.BlockSpec((1, sb, chunk // group), sc_map),
+        ]
+        inputs += [ctx_k_scale, ctx_v_scale]
+    in_specs += [
+        pl.BlockSpec((1, nkv, sb, R, hd), ring_map),
+        pl.BlockSpec((1, nkv, sb, R, hd), ring_map),
+    ]
+    inputs += [ring_k, ring_v]
+
     out = pl.pallas_call(
-        functools.partial(_kernel, scale=scale, chunk=chunk, sb=sb),
+        functools.partial(
+            _kernel, scale=scale, chunk=chunk, sb=sb, quantized=quantized
+        ),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=3,
             grid=(B // sb, n_chunks + 1),
-            in_specs=[
-                pl.BlockSpec((sb, nkv, g, hd), q_map),
-                pl.BlockSpec((1, nkv, sb, chunk, hd), kv_map),
-                pl.BlockSpec((1, nkv, sb, chunk, hd), kv_map),
-                pl.BlockSpec((1, nkv, sb, R, hd), ring_map),
-                pl.BlockSpec((1, nkv, sb, R, hd), ring_map),
-            ],
+            in_specs=in_specs,
             out_specs=pl.BlockSpec((sb, nkv, g, hd), q_map),
             scratch_shapes=[
                 pltpu.VMEM((sb, nkv, g, 128), jnp.float32),
@@ -211,7 +303,7 @@ def flash_decode_attention(
         ),
         out_shape=jax.ShapeDtypeStruct((B, nkv, g, hd), q.dtype),
         # generous scoped-vmem budget for the chunked block pipeline
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             vmem_limit_bytes=64 * 1024 * 1024,
         ),
         interpret=interpret,
@@ -219,7 +311,7 @@ def flash_decode_attention(
         jnp.asarray(layer, jnp.int32).reshape(1),
         ctx_i32,
         base_i32,
-        qg, ctx_k, ctx_v, ring_k, ring_v,
+        *inputs,
     )
     return out.reshape(B, n_heads, hd)
 
@@ -233,14 +325,28 @@ def flash_decode_attention_reference(
     layer: jnp.ndarray,
     ctx_lens: jnp.ndarray,
     ring_base: jnp.ndarray,
+    ctx_k_scale: jnp.ndarray | None = None,
+    ctx_v_scale: jnp.ndarray | None = None,
 ) -> jnp.ndarray:
-    """Pure-jnp equivalent (CPU tests / kernel parity checks)."""
+    """Pure-jnp equivalent (CPU tests / kernel parity checks). With ctx
+    scales given, ctx_k/ctx_v are int8 per-group quantized — dequantize
+    them to the query dtype first (matching the kernel's in-VMEM
+    dequant, so parity tests cover the quantized math too)."""
     B, n_heads, hd = q.shape
     L, nkv, _, S, _ = ctx_k.shape
     R = ring_k.shape[3]
     n_rep = n_heads // nkv
-    k = jnp.repeat(ctx_k[layer][:, :B], n_rep, axis=0)  # [nh, B, S, hd]
-    v = jnp.repeat(ctx_v[layer][:, :B], n_rep, axis=0)
+    kl, vl = ctx_k[layer][:, :B], ctx_v[layer][:, :B]  # [nkv, B, S, hd]
+    if ctx_k_scale is not None:
+        g = S // ctx_k_scale.shape[2]
+        ks = jnp.repeat(ctx_k_scale[layer][:B], g, axis=1)  # [B, S]
+        vs = jnp.repeat(ctx_v_scale[layer][:B], g, axis=1)
+        kl = (kl.astype(jnp.float32) * ks[None, :, :, None]
+              ).astype(q.dtype)
+        vl = (vl.astype(jnp.float32) * vs[None, :, :, None]
+              ).astype(q.dtype)
+    k = jnp.repeat(kl, n_rep, axis=0)                   # [nh, B, S, hd]
+    v = jnp.repeat(vl, n_rep, axis=0)
     rk = jnp.repeat(ring_k[layer], n_rep, axis=0)       # [nh, B, R, hd]
     rv = jnp.repeat(ring_v[layer], n_rep, axis=0)
     k = jnp.concatenate([k, rk], axis=2)                # [nh, B, S+R, hd]

@@ -40,12 +40,8 @@ def _engine(**kw) -> TpuEngine:
                      mesh_config=MeshConfig(tp=1))
 
 
-async def test_steady_decode_round_budget():
-    """THE pin: in a steady decode window (every slot active, no
-    admissions/releases/transfers), dispatches-per-round must stay at
-    1 program + 1 fetch — and seals must ride the round program, never
-    a standalone seal_blocks dispatch."""
-    eng = _engine()
+async def _steady_window_budget(**kw):
+    eng = _engine(**kw)
     eng.start()
     rng = np.random.RandomState(0)
     n_req, osl = 4, 64
@@ -93,6 +89,21 @@ async def test_steady_decode_round_budget():
     # blocks complete every PS tokens: with 4 slots x 4 steps/round the
     # fused-seal variant must actually be exercised in the window
     assert delta["round_seal"] >= 1, delta
+
+
+async def test_steady_decode_round_budget():
+    """THE pin: in a steady decode window (every slot active, no
+    admissions/releases/transfers), dispatches-per-round must stay at
+    1 program + 1 fetch — and seals must ride the round program, never
+    a standalone seal_blocks dispatch."""
+    await _steady_window_budget()
+
+
+async def test_steady_decode_round_budget_int8():
+    """kv_quant=int8 keeps the identical budget: ring-flush
+    requantization and the raw int8 fused seals all ride the round
+    program — the in-kernel quant path costs ZERO extra dispatches."""
+    await _steady_window_budget(kv_quant="int8")
 
 
 async def test_whole_run_dispatch_budget():

@@ -163,11 +163,13 @@ async def test_int8_vs_bf16_greedy_differential():
     assert eng_q.kv_quant and eng_q.cache["k"].dtype == jnp.int8
     await eng_q.stop()
 
-    # cold waves never read the pool: byte-identical paths
-    assert [t for t, _, _ in cold_q] == [t for t, _, _ in cold_n]
-
+    # since PR 14 the ctx region itself is int8 (in-kernel dequant), so
+    # even cold waves run quantized attention: BOTH waves get the
+    # near-tie-aware comparison instead of cold byte-identity
     decisive = decisive_matched = 0
-    for (tq, lq, _), (tn, ln, g2) in zip(warm_q, warm_n):
+    for (tq, lq, _), (tn, ln, g2) in zip(
+        cold_q + warm_q, cold_n + warm_n
+    ):
         for j, (a, b) in enumerate(zip(tq, tn)):
             gap = (g2[j][0][1] - g2[j][1][1]) if len(g2[j]) > 1 else 1.0
             if a != b:

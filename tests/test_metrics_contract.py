@@ -160,6 +160,11 @@ def test_readme_documents_canonical_series():
         "dynamo_kv_quant_scale_bytes_total",
         "dynamo_kv_quant_dequant_seconds",
         "dynamo_kv_pool_capacity_blocks",
+        # in-kernel int8 decode ctx (PR 14: raw pool<->ctx copies +
+        # once-per-round ring-flush requantize)
+        "dynamo_kv_quant_ctx_seal_raw_pages_total",
+        "dynamo_kv_quant_ctx_admit_raw_pages_total",
+        "dynamo_kv_quant_ctx_flush_groups_total",
         # KV data-integrity plane (dynamo_tpu/kv_integrity.py)
         "dynamo_kv_integrity_verified_total",
         "dynamo_kv_integrity_failed_total",

@@ -265,7 +265,19 @@ async def test_planner_scales_multihost_engine_groups():
         await planner.start()
         client = await rt.namespace("mhplan").component(
             "backend-r0").endpoint("generate").client()
-        await client.wait_for_instances(1, timeout_s=180)
+        # liveness-aware bring-up wait: if the spawned group dies (the
+        # cross-host smoke can't run in every environment) fail in
+        # seconds instead of burning the whole instance timeout
+        deadline = asyncio.get_running_loop().time() + 180
+        while True:
+            assert conn.current_replicas() >= 1, \
+                "multihost group died during bring-up"
+            try:
+                await client.wait_for_instances(1, timeout_s=2.0)
+                break
+            except TimeoutError:
+                if asyncio.get_running_loop().time() > deadline:
+                    raise
 
         stream = client.generate({
             "token_ids": list(range(1, 50)),

@@ -328,6 +328,25 @@ def _dispatch_budget_mode(
     delta = stats["delta"]
     rounds = delta.get("round", 0) + delta.get("round_seal", 0)
     wall_ms_per_step = stats["wall_s"] / max(stats["steps"], 1) * 1e3
+    steps_per_s = (
+        stats["steps"] / stats["wall_s"] if stats["wall_s"] > 0 else None
+    )
+    # per-step byte attribution (dynamo_tpu/roofline.py): derived from
+    # the workload's steady geometry. attn_roofline_frac only attributes
+    # against a real accelerator's bandwidth (PR 7 honesty rule).
+    from dynamo_tpu.roofline import chip_info, decode_byte_accounting
+
+    _, (_, peak_bw), on_accel = chip_info()
+    param_bytes = sum(
+        x.size * x.dtype.itemsize for x in jax.tree.leaves(eng.params)
+    )
+    byte_acct = decode_byte_accounting(
+        cfg, ecfg,
+        [min(48 + osl, ecfg.max_context)] * ecfg.max_decode_slots,
+        param_bytes, steps_per_s=steps_per_s, peak_bw=peak_bw,
+    )
+    if not on_accel:
+        byte_acct["attn_roofline_frac"] = None
     # performance-attribution view (telemetry/prof.py): ms/step of each
     # host-round segment over the same window — names the slices inside
     # host_ms_per_step so the next perf PR attacks segments, not a blob
@@ -344,6 +363,7 @@ def _dispatch_budget_mode(
         with open(baseline) as f:
             base = json.load(f)
         base_bd = base.get("host_breakdown") or {}
+        base_bytes = base.get("bytes_per_step_breakdown") or {}
         extra["baseline_deltas"] = {
             "host_ms_per_step": round(
                 (wall_ms_per_step - device_ms_per_step)
@@ -354,6 +374,15 @@ def _dispatch_budget_mode(
             "host_breakdown": {
                 s: round(v - base_bd.get(s, 0.0), 5)
                 for s, v in host_breakdown.items()
+            },
+            # byte deltas vs the prior run — the kv_quant=int8
+            # before/after (live-KV bytes halving) in one diffable field
+            "kv_bytes_per_step": (
+                byte_acct["kv_bytes_per_step"]
+                - base.get("kv_bytes_per_step", 0)),
+            "bytes_per_step_breakdown": {
+                s: v - base_bytes.get(s, 0)
+                for s, v in byte_acct["bytes_per_step_breakdown"].items()
             },
         }
     print(json.dumps({
@@ -380,6 +409,11 @@ def _dispatch_budget_mode(
         "pipeline_depth": round(pipe["pipeline_depth"], 4),
         "overlap_ratio": round(pipe["overlap_ratio"], 4),
         "pipe_flushes": pipe["pipe_flushes"],
+        "kv_bytes_per_step": byte_acct["kv_bytes_per_step"],
+        "total_bytes_per_step": byte_acct["total_bytes_per_step"],
+        "bytes_per_step_breakdown": byte_acct["bytes_per_step_breakdown"],
+        "kv_ctx_bytes_vs_bf16": byte_acct["kv_ctx_bytes_vs_bf16"],
+        "attn_roofline_frac": byte_acct["attn_roofline_frac"],
         **extra,
     }))
     return 0
