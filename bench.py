@@ -805,7 +805,17 @@ def main():
               "integrity_clean_hit_ttft_ms", "integrity_corrupt_ttft_ms",
               "integrity_flips_injected", "integrity_quarantined",
               "integrity_recomputed", "integrity_token_divergence",
-              "integrity_error"):
+              "integrity_error",
+              # store_outage phase (bench_modes.store_outage_experiment):
+              # store killed + WAL-restarted mid-storm — zero failed
+              # requests, sessions resync, leases reclaimed from replay
+              "store_outage_requests", "store_outage_failed",
+              "store_outage_token_equal", "store_outage_ms",
+              "store_outage_degraded_ms", "store_outage_resync_ms",
+              "store_outage_resyncs", "store_outage_reconnects",
+              "store_outage_replayed_keys",
+              "store_outage_replayed_queue_items",
+              "store_outage_workers_after", "store_outage_error"):
         v = stats.get(k)
         if v is None and k.endswith("_error"):
             continue

@@ -37,6 +37,16 @@ mechanics). Reports remote-prefill TTFT chunk-streamed vs monolithic,
 compute / total transfer seconds), and greedy token equality of the
 chunked, monolithic and pure-local paths.
 
+``store_outage`` — the control-plane survivability experiment (PR 15
+tentpole): a journal-backed store under a full watcher/router stack is
+killed mid-storm (``crash_store``) and restarted from its WAL on the
+same port while streams are in flight. Every client runs through
+``StoreSession`` (``resync=True``), so the phase reports ZERO failed
+requests (streams flow worker<->frontend direct; the degraded window
+freezes health/load instead of evicting), greedy token identity, the
+outage/degraded/resync wall times, the journal replay counts, and the
+post-recovery fleet size (leases reclaimed — no registration churn).
+
 Run standalone (``python -m dynamo_tpu.bench_modes``) or via bench.py,
 which shells out with JAX_PLATFORMS=cpu and merges the JSON fields.
 """
@@ -988,6 +998,182 @@ async def integrity_experiment(n_new: int = 6) -> dict:
     }
 
 
+async def store_outage_experiment(
+    n_workers: int = 2,
+    n_requests: int = 8,
+    prompt_tokens: int = 48,
+    out_tokens: int = 24,
+    outage_s: float = 0.4,
+) -> dict:
+    """Control-plane outage survivability (the PR 15 tentpole): a
+    journal-backed store serves a mocker fleet discovered through the
+    full watcher stack, every client connected via StoreSession
+    (``resync=True``). Mid-storm the store process "dies"
+    (``crash_store``: sweeper cancelled, journal closed, every live
+    connection aborted) and restarts ``outage_s`` later on the SAME
+    port from the SAME journal. Streams flow worker<->frontend direct,
+    so the acceptance target is ZERO failed requests; sessions must
+    resync (leases reclaimed from the replayed journal — same ids, no
+    registration churn) and the degraded window must close. Reports
+    failed requests, greedy token identity vs an unloaded reference,
+    outage/degraded/resync wall times, journal replay counts, and the
+    post-recovery fleet size."""
+    import tempfile
+
+    from dynamo_tpu.frontend import ModelManager
+    from dynamo_tpu.frontend.watcher import (
+        ModelEntry,
+        ModelWatcher,
+        register_llm,
+    )
+    from dynamo_tpu.kv_router.scheduler import KvRouterConfig
+    from dynamo_tpu.mocker import MockerArgs, MockerEngine
+    from dynamo_tpu.protocols.common import (
+        PreprocessedRequest,
+        StopConditions,
+    )
+    from dynamo_tpu.runtime.component import DistributedRuntime
+    from dynamo_tpu.runtime.store import crash_store, serve_store
+
+    bs = 16
+    rng = np.random.RandomState(17)
+    prompts = [rng.randint(1, 10_000, size=prompt_tokens).tolist()
+               for _ in range(n_requests)]
+
+    def req_for(prompt):
+        return PreprocessedRequest(
+            token_ids=list(prompt),
+            stop_conditions=StopConditions(max_tokens=out_tokens,
+                                           ignore_eos=True),
+        )
+
+    def make_args(wid: str) -> "MockerArgs":
+        # slow decode so the streams genuinely span the outage window
+        return MockerArgs(
+            num_pages=512, page_size=bs, max_decode_slots=16,
+            worker_id=wid,
+            prefill_time_per_token_s=0.0002,
+            decode_time_per_step_s=0.02,
+        )
+
+    # unloaded reference: token-identity oracle for every stream
+    refs = []
+    ref_eng = MockerEngine(make_args("ref"))
+    for p in prompts:
+        toks = []
+        async for out in ref_eng.generate(req_for(p)):
+            toks.extend(out.token_ids)
+        refs.append(toks)
+    await ref_eng.stop()
+
+    tmp = tempfile.mkdtemp(prefix="dynamo-bench-wal-")
+    journal = f"{tmp}/store.wal"
+    server, store = await serve_store(
+        port=0, sweep_interval_s=0.05, journal_path=journal)
+    port = server.sockets[0].getsockname()[1]
+
+    workers = []
+    for i in range(n_workers):
+        rt = await DistributedRuntime.connect(port=port, resync=True)
+        eng = MockerEngine(make_args(f"w{i}"))
+        entry = ModelEntry(
+            name="outage-model", namespace="bench_outage",
+            component="backend", block_size=bs, router_mode="kv",
+        )
+        served = await register_llm(rt, eng, entry, lease_ttl_s=1.0)
+        workers.append((rt, eng, served))
+
+    frontend_rt = await DistributedRuntime.connect(port=port, resync=True)
+    manager = ModelManager()
+    watcher = await ModelWatcher(
+        frontend_rt, manager, namespace="bench_outage",
+        router_config=KvRouterConfig(router_temperature=0.0),
+    ).start()
+    push = None
+    for _ in range(200):
+        push = watcher._routers.get("outage-model")
+        if push is not None and len(push.workers) == n_workers:
+            break
+        await asyncio.sleep(0.02)
+    if push is None or len(push.workers) != n_workers:
+        raise RuntimeError("fleet never fully discovered")
+
+    sessions = [rt.kv for rt, _, _ in workers] + [frontend_rt.kv]
+    failed = 0
+    outs: dict[int, list[int]] = {}
+
+    async def one(idx: int) -> None:
+        nonlocal failed
+        toks: list[int] = []
+        try:
+            async for out in push.generate(req_for(prompts[idx])):
+                toks.extend(out.token_ids)
+        # dynlint: disable=DTL007 — the bench MUST count arbitrary stream
+        # failures, not crash on the first one
+        except Exception:  # noqa: BLE001 — any failure counts against 0
+            failed += 1
+            return
+        outs[idx] = toks
+
+    tasks = [asyncio.ensure_future(one(i)) for i in range(n_requests)]
+    # let every stream start, then kill the store mid-storm
+    await asyncio.sleep(0.08)
+    t_kill = time.monotonic()
+    crash_store(server)
+    await asyncio.sleep(outage_s)
+    server2, store2 = await serve_store(
+        port=port, sweep_interval_s=0.05, journal_path=journal)
+    t_restart = time.monotonic()
+    # degraded window closes when every session has resynced
+    for _ in range(400):
+        if all(not s.degraded and s.resyncs >= 1 for s in sessions):
+            break
+        await asyncio.sleep(0.02)
+    t_resync = time.monotonic()
+    recovered = all(not s.degraded and s.resyncs >= 1 for s in sessions)
+
+    await asyncio.gather(*tasks)
+    # workers must still be registered (reclaimed leases -> same keys)
+    fleet_after = 0
+    for _ in range(100):
+        fleet_after = len(push.workers)
+        if fleet_after == n_workers:
+            break
+        await asyncio.sleep(0.05)
+    token_equal = all(outs[i] == refs[i] for i in outs)
+
+    await watcher.stop()
+    await frontend_rt.close()
+    for rt, eng, served in workers:
+        await served.shutdown()
+        await eng.stop()
+        await rt.close()
+    server2.close()
+    store2.close_journal()
+    import shutil
+
+    shutil.rmtree(tmp, ignore_errors=True)
+    if not recovered:
+        raise RuntimeError(
+            f"sessions never resynced after store restart "
+            f"(degraded={[s.degraded for s in sessions]}, "
+            f"resyncs={[s.resyncs for s in sessions]})"
+        )
+    return {
+        "store_outage_requests": n_requests,
+        "store_outage_failed": failed,
+        "store_outage_token_equal": token_equal,
+        "store_outage_ms": round((t_restart - t_kill) * 1e3, 1),
+        "store_outage_degraded_ms": round((t_resync - t_kill) * 1e3, 1),
+        "store_outage_resync_ms": round((t_resync - t_restart) * 1e3, 1),
+        "store_outage_resyncs": sum(s.resyncs for s in sessions),
+        "store_outage_reconnects": sum(s.reconnects for s in sessions),
+        "store_outage_replayed_keys": store2.replayed_keys,
+        "store_outage_replayed_queue_items": store2.replayed_queue_items,
+        "store_outage_workers_after": fleet_after,
+    }
+
+
 def main():
     out = asyncio.run(routing_experiment())
     out.update(asyncio.run(fault_experiment()))
@@ -1017,6 +1203,10 @@ def main():
         out.update(asyncio.run(integrity_experiment()))
     except Exception as e:  # noqa: BLE001 — best-effort phase
         out["integrity_error"] = str(e)[:200]
+    try:
+        out.update(asyncio.run(store_outage_experiment()))
+    except Exception as e:  # noqa: BLE001 — best-effort phase
+        out["store_outage_error"] = str(e)[:200]
     print(json.dumps(out))
 
 

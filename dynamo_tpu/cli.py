@@ -43,15 +43,21 @@ def _run_cp(rest: list[str]) -> int:
     p.add_argument("--port", type=int, default=7111)
     p.add_argument("--python", action="store_true",
                    help="force the Python store (skip the native binary)")
+    p.add_argument("--store-journal", metavar="PATH", default=None,
+                   help="WAL journal path: keys/leases/queues survive a "
+                        "store restart (replayed at startup with a lease "
+                        "grace window). Python store only.")
     args = p.parse_args(rest)
 
     native = os.path.join(
         os.path.dirname(__file__), "native", "build", "dcp-server"
     )
-    if not args.python and os.path.exists(native):
+    if not args.python and args.store_journal is None \
+            and os.path.exists(native):
         # exec (not subprocess): signals sent to this process must reach
         # the actual server — a supervisor's SIGTERM would otherwise kill
-        # only the wrapper and orphan the store
+        # only the wrapper and orphan the store. (--store-journal implies
+        # the Python store: the native binary has no WAL.)
         os.execv(native, [native, str(args.port)])
 
     import asyncio
@@ -59,8 +65,16 @@ def _run_cp(rest: list[str]) -> int:
     from dynamo_tpu.runtime.store import serve_store
 
     async def _serve():
-        server, _ = await serve_store(port=args.port)
-        print(f"dcp-server (python) listening on 127.0.0.1:{args.port}")
+        server, store = await serve_store(
+            port=args.port, journal_path=args.store_journal
+        )
+        extra = ""
+        if args.store_journal:
+            extra = (f" (journal {args.store_journal}: "
+                     f"{store.replayed_keys} keys, "
+                     f"{store.replayed_queue_items} queue items replayed)")
+        print(f"dcp-server (python) listening on "
+              f"127.0.0.1:{args.port}{extra}")
         async with server:
             await server.serve_forever()
 

@@ -277,6 +277,7 @@ class HttpService:
         from dynamo_tpu.kv_quant import KV_QUANT
         from dynamo_tpu.kv_transfer_metrics import KV_TRANSFER
         from dynamo_tpu.resilience.metrics import RESILIENCE
+        from dynamo_tpu.runtime.store_metrics import STORE
         from dynamo_tpu.telemetry.prof import PROF
 
         # SLO burn-rate gauges refresh at scrape time from the frontend's
@@ -293,7 +294,8 @@ class HttpService:
                 + KV_QUANT.render().encode()
                 + KV_INTEGRITY.render().encode()
                 + OVERLOAD.render().encode()
-                + PROF.render().encode())
+                + PROF.render().encode()
+                + STORE.render().encode())
         return web.Response(
             body=body, content_type=CONTENT_TYPE_LATEST.split(";")[0]
         )

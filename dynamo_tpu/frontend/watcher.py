@@ -107,6 +107,9 @@ async def register_llm(
     await rt.kv.put(key, entry.to_json(), lease=served.lease_id)
 
     allocator = getattr(engine, "allocator", None)
+    # resync sessions re-grant a lost lease under a NEW id when the old one
+    # can't be reclaimed; everything keyed by lease id follows the rekey
+    on_rekey: Optional[list] = getattr(served.lease, "on_rekey", None)
     if entry.router_mode != "kv":
         # only KV-routed models have indexers consuming these events;
         # publishing for others just pollutes the event plane
@@ -117,6 +120,15 @@ async def register_llm(
         allocator.worker_id = str(served.lease_id)
         allocator.on_event = pub
         served.kv_publisher = pub
+        if on_rekey is not None:
+            def _rekey_kv(old: int, new: int,
+                          pub=pub, allocator=allocator) -> None:
+                wid = str(new)
+                pub.worker_id = wid
+                pub.topic = f"{KV_EVENTS_TOPIC}.{wid}"
+                allocator.worker_id = wid
+
+            on_rekey.append(_rekey_kv)
         if kv_resync_interval_s > 0:
             # periodic authoritative resync: the pub/sub plane is lossy
             # (slow consumers drop), and a dropped STORED would otherwise
@@ -149,12 +161,20 @@ async def register_llm(
             )
     # load-metrics plane (planner + standalone exporter consume this)
     if hasattr(engine, "on_metrics"):
-        from dynamo_tpu.runtime.publisher import WorkerMetricsPublisher
+        from dynamo_tpu.runtime.publisher import METRICS_TOPIC, \
+            WorkerMetricsPublisher
 
         mpub = WorkerMetricsPublisher(rt.kv, str(served.lease_id))
         mpub.start()
         engine.on_metrics = mpub
         served.metrics_publisher = mpub
+        if on_rekey is not None:
+            def _rekey_metrics(old: int, new: int, mpub=mpub) -> None:
+                wid = str(new)
+                mpub.worker_id = wid
+                mpub.topic = f"{METRICS_TOPIC}.{wid}"
+
+            on_rekey.append(_rekey_metrics)
     return served
 
 
@@ -234,6 +254,21 @@ class ModelWatcher:
         self._breaker_board = await SharedBreakerBoard(
             self.rt.kv, self.health, namespace=self.namespace
         ).start()
+        # degraded-mode serving: when the control-plane session loses its
+        # store, freeze the health/load views (stale-while-revalidate —
+        # keep routing off the last-known fleet picture) instead of aging
+        # every worker out while the metrics stream is paused
+        add_listener = getattr(self.rt.kv, "add_state_listener", None)
+        if add_listener is not None:
+            def _on_store_state(degraded: bool) -> None:
+                if degraded:
+                    self.health.freeze()
+                    self.load.freeze()
+                else:
+                    self.health.thaw()
+                    self.load.thaw()
+
+            add_listener(_on_store_state)
         return self
 
     async def stop(self) -> None:

@@ -772,7 +772,9 @@ async def _serve_worker(args, chain) -> None:
     from dynamo_tpu.runtime.component import DistributedRuntime
 
     host, port = _cp_addr(args)
-    rt = await DistributedRuntime.connect(host=host, port=port)
+    # resync: a store bounce must not unregister a serving worker — the
+    # session re-grants the lease and re-puts registration keys
+    rt = await DistributedRuntime.connect(host=host, port=port, resync=True)
 
     engine = chain.engine
     disagg_parts = []
@@ -947,7 +949,7 @@ async def _serve_prefill_worker(args, chain) -> None:
     from dynamo_tpu.runtime.component import DistributedRuntime
 
     host, port = _cp_addr(args)
-    rt = await DistributedRuntime.connect(host=host, port=port)
+    rt = await DistributedRuntime.connect(host=host, port=port, resync=True)
     worker = await PrefillWorker(
         rt, chain.engine, namespace=args.namespace
     ).start()
@@ -968,7 +970,9 @@ async def _serve_http_dynamic(args) -> None:
     from dynamo_tpu.runtime.component import DistributedRuntime
 
     host, port = _cp_addr(args)
-    rt = await DistributedRuntime.connect(host=host, port=port)
+    # resync: the frontend serves from last-known state through an outage
+    # (ModelWatcher freezes its health/load views) and resyncs after
+    rt = await DistributedRuntime.connect(host=host, port=port, resync=True)
     manager = ModelManager()
     kv_recorder = None
     if args.record_kv_events:

@@ -58,6 +58,14 @@ GUARDED_BY: dict[str, dict[str, str]] = {
         # snapshot
         "_ring": "_lock", "_next": "_lock", "_seq": "_lock",
     },
+    "runtime/session.py": {
+        # session registration state: mutated by user-facing calls
+        # (put/lease_grant/watch_prefix) AND the supervisor's resync —
+        # concurrent asyncio tasks, so every access holds the session
+        # mutex (an await between read and write is a lost update)
+        "_session_leases": "_mu",
+        "_session_watches": "_mu",
+    },
 }
 
 _EXEMPT_FUNCTIONS = ("__init__",)

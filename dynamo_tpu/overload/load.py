@@ -57,6 +57,10 @@ class WorkerLoadView:
         self.stale_after_s = stale_after_s
         self.clock = clock
         self._load: dict[str, _WorkerLoad] = {}
+        # control-plane degraded mode: while frozen, last-published load
+        # stays "fresh" — stale-while-revalidate beats forgetting every
+        # budget/backlog hint the moment the metrics stream pauses
+        self._frozen_at: Optional[float] = None
 
     # ---- feeds ----
 
@@ -104,7 +108,27 @@ class WorkerLoadView:
     # ---- routing decisions ----
 
     def _fresh(self, wl: _WorkerLoad, now: float) -> bool:
+        if self._frozen_at is not None:
+            return True
         return now - wl.t <= self.stale_after_s
+
+    # ---- control-plane degraded mode ----
+
+    def freeze(self) -> None:
+        """Store unreachable (metrics stream paused): hold the last-known
+        load hints instead of aging them out."""
+        if self._frozen_at is None:
+            self._frozen_at = self.clock()
+
+    def thaw(self) -> None:
+        """Store back: restart freshness clocks from now so last-known
+        entries get one full stale_after_s to be re-published."""
+        if self._frozen_at is None:
+            return
+        now = self.clock()
+        for wl in self._load.values():
+            wl.t = now
+        self._frozen_at = None
 
     def saturated(self, worker_id: str) -> bool:
         """Published backlog at budget, or inside a bounce cooldown."""

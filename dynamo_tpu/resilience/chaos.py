@@ -37,6 +37,17 @@ is the only way back to correct tokens):
                  lost/torn disk writes (a live ftruncate would SIGBUS
                  through the active mmap)
 
+Control-plane points (runtime/store.py serving loop — the store process
+itself as the fault domain):
+
+  kill_store       on the next store op, crash the store server: stop
+                   accepting, hard-abort every live client connection
+                   (RST, not FIN), kill the sweeper — a store process
+                   death; clients must resync via StoreSession
+  partition_store  hold every reply for ``t`` seconds: the TCP conn stays
+                   up but the store goes silent (network partition shape;
+                   no error raised)
+
 Entry grammar: comma-separated ``name[:key=value]*`` with keys
 ``p`` (probability, default 1), ``t`` (seconds), ``after`` (output count).
 """
@@ -55,7 +66,8 @@ from dynamo_tpu.resilience.metrics import RESILIENCE
 log = logging.getLogger(__name__)
 
 POINT_NAMES = ("kill_worker", "stall_stream", "drop_response", "delay",
-               "storm", "flip_kv_bits", "corrupt_frame", "truncate_g3")
+               "storm", "flip_kv_bits", "corrupt_frame", "truncate_g3",
+               "kill_store", "partition_store")
 
 
 class ChaosInjectedError(ConnectionResetError):

@@ -54,6 +54,11 @@ class WorkerHealthTracker:
         self.on_state_change: Optional[
             Callable[[str, str, float], None]
         ] = None    # (worker_id, "open"|"closed", window_s)
+        # control-plane degraded mode (StoreSession listener): while
+        # frozen, heartbeat staleness never blocks — the metrics stream
+        # rides the store, so silence during an outage says nothing about
+        # worker health (stale-while-revalidate, not amnesia)
+        self._frozen_at: Optional[float] = None
 
     def breaker(self, worker_id: str) -> CircuitBreaker:
         b = self._breakers.get(worker_id)
@@ -75,12 +80,33 @@ class WorkerHealthTracker:
             self.heartbeat(wid)
 
     def stale(self, worker_id: str) -> bool:
-        if self.heartbeat_ttl_s is None:
+        if self.heartbeat_ttl_s is None or self._frozen_at is not None:
             return False
         seen = self._last_seen.get(worker_id)
         if seen is None:
             return False  # never heartbeated: no signal, not stale
         return self.clock() - seen > self.heartbeat_ttl_s
+
+    # ---- control-plane degraded mode ----
+
+    def freeze(self) -> None:
+        """Store unreachable: hold the last-known picture. Breakers keep
+        working off live request outcomes; only heartbeat-staleness (a
+        store-derived signal) is suspended."""
+        if self._frozen_at is None:
+            self._frozen_at = self.clock()
+            log.warning("health view frozen (control plane degraded)")
+
+    def thaw(self) -> None:
+        """Store back: give every known worker one full heartbeat TTL to
+        resume publishing before staleness can block it again."""
+        if self._frozen_at is None:
+            return
+        now = self.clock()
+        for wid in self._last_seen:
+            self._last_seen[wid] = now
+        self._frozen_at = None
+        log.info("health view thawed (control plane resynced)")
 
     # ---- routing decisions ----
 

@@ -173,8 +173,14 @@ class KvClient:
             self._rx_task.cancel()
             self._rx_task = None
         if self._writer is not None:
-            self._writer.close()
-            self._writer = None
+            w, self._writer = self._writer, None
+            w.close()
+            try:
+                # without this the transport (and its FD) outlives close()
+                # and leaks into the loop's next iteration
+                await w.wait_closed()
+            except (ConnectionError, OSError):
+                pass
         self.closed.set()
 
     async def _rx(self) -> None:

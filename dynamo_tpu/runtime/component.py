@@ -249,8 +249,19 @@ class DistributedRuntime:
 
     @classmethod
     async def connect(
-        cls, host: str = "127.0.0.1", port: int = 7111
+        cls, host: str = "127.0.0.1", port: int = 7111,
+        *, resync: bool = False,
     ) -> "DistributedRuntime":
+        """With ``resync=True``, ``rt.kv`` is a `StoreSession` (duck-typed
+        KvClient) that survives control-plane outages: auto-reconnect,
+        lease re-grant + key re-registration, watch resync with
+        synthesized deltas. Default False keeps the one-connection
+        semantics tests rely on (a store death fails calls loudly)."""
+        if resync:
+            from dynamo_tpu.runtime.session import StoreSession
+
+            session = await StoreSession(host, port).connect()
+            return cls(session)
         kv = await KvClient(host, port).connect()
         return cls(kv)
 

@@ -10,20 +10,38 @@ This is the wire-compatible fallback for the native C++ ``dcp-server``
 (dynamo_tpu/native/dcp_server.cc); protocol in runtime/protocol.py. The
 in-process `KvStore` core is shared by both the asyncio server here and
 unit tests.
+
+Durability (``journal_path``): every mutation (put/delete/lease
+grant+revoke/qpush/qpop) appends one JSONL record to a WAL, compacted to
+a one-line-per-live-entry snapshot via the same tmp+fsync+atomic-rename
+discipline as the G3 manifest (engine/offload.py) — a crash leaves either
+the old or the new journal, never a half state, and a torn tail (partial
+last write) is tolerated on replay. Restarted leases get a grace window
+(deadline = now + max(ttl, lease_grace_s)) so still-alive workers
+reconnecting after the bounce can reclaim their lease ids — and the
+registration keys bound to them — before the sweeper erases the fleet.
+Off by default: journal_path=None is exactly the old in-memory store.
 """
 from __future__ import annotations
 
 import asyncio
 import itertools
+import json
 import logging
+import os
 import time
 from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Callable, Optional
 
 from dynamo_tpu.runtime.protocol import encode_frame, read_frame
+from dynamo_tpu.runtime.store_metrics import STORE
 
 log = logging.getLogger(__name__)
+
+# compaction slack: rewrite the journal once it holds more than this many
+# lines per live entry (floor 256 so tiny stores don't thrash the file)
+_WAL_SLACK = 4
 
 WatchSink = Callable[[dict[str, Any]], None]
 
@@ -38,7 +56,12 @@ class _Watch:
 class KvStore:
     """The store core: keys, leases, watches. Time injected for tests."""
 
-    def __init__(self, clock: Callable[[], float] = time.monotonic):
+    def __init__(
+        self,
+        clock: Callable[[], float] = time.monotonic,
+        journal_path: Optional[str] = None,
+        lease_grace_s: float = 10.0,
+    ):
         self._clock = clock
         self._kv: dict[str, tuple[str, int]] = {}       # key -> (value, lease)
         self._leases: dict[int, float] = {}             # lease -> deadline
@@ -52,12 +75,43 @@ class KvStore:
         self._qwaiters: dict[str, deque] = {}
         self._ids = itertools.count(1)
         self.revision = 0
+        # -- WAL (off when journal_path is None) --
+        self.journal_path = journal_path
+        self.lease_grace_s = lease_grace_s
+        self._journal = None
+        self._journal_lines = 0
+        self.replayed_keys = 0
+        self.replayed_queue_items = 0
+        self.torn_records = 0
+        if journal_path is not None:
+            self._replay_journal()
+            # startup snapshot: drops the torn tail and replayed-away
+            # churn so the attach point is a clean one-line-per-entry file
+            self.compact_journal()
 
     # ---- kv ----
 
+    def lease_alive(self, lease: int) -> bool:
+        """Granted AND not past its deadline — the sweep-race fix: an
+        expired-but-unswept lease must be authoritatively dead regardless
+        of sweeper cadence."""
+        dl = self._leases.get(lease)
+        return dl is not None and dl >= self._clock()
+
+    def expire_lease_if_overdue(self, lease: int) -> bool:
+        """Inline expiry for a lease caught past its deadline by put /
+        keepalive before the sweeper ran: delete its keys + notify now."""
+        dl = self._leases.get(lease)
+        if dl is None or dl >= self._clock():
+            return False
+        log.info("lease %d expired (caught inline, pre-sweep)", lease)
+        self.lease_revoke(lease)
+        return True
+
     def put(self, key: str, value: str, lease: int = 0) -> int:
         if lease:
-            if lease not in self._leases:
+            if not self.lease_alive(lease):
+                self.expire_lease_if_overdue(lease)
                 raise KeyError(f"lease {lease} not found")
             self._lease_keys.setdefault(lease, set()).add(key)
         old = self._kv.get(key)
@@ -68,6 +122,7 @@ class KvStore:
                 ks.discard(key)
         self._kv[key] = (value, lease)
         self.revision += 1
+        self._wal({"op": "put", "key": key, "value": value, "lease": lease})
         self._notify("put", key, value)
         return self.revision
 
@@ -88,6 +143,7 @@ class KvStore:
             if ks is not None:
                 ks.discard(key)
         self.revision += 1
+        self._wal({"op": "delete", "key": key})
         self._notify("delete", key, None)
         return 1
 
@@ -103,17 +159,24 @@ class KvStore:
         lease = next(self._ids)
         self._leases[lease] = self._clock() + ttl
         self._lease_ttl[lease] = ttl
+        self._wal({"op": "lease_grant", "lease": lease, "ttl": ttl})
         return lease
 
     def lease_keepalive(self, lease: int) -> bool:
-        if lease not in self._leases:
+        if not self.lease_alive(lease):
+            # sweep-race fix: membership alone is not liveness — a lease
+            # past its deadline must not be resurrectable just because
+            # the sweeper hasn't run yet
+            self.expire_lease_if_overdue(lease)
             return False
         self._leases[lease] = self._clock() + self._lease_ttl[lease]
         return True
 
     def lease_revoke(self, lease: int) -> None:
-        self._leases.pop(lease, None)
+        had = self._leases.pop(lease, None) is not None
         self._lease_ttl.pop(lease, None)
+        if had:
+            self._wal({"op": "lease_revoke", "lease": lease})
         for k in list(self._lease_keys.pop(lease, set())):
             self.delete(k)
 
@@ -149,6 +212,10 @@ class KvStore:
                           exc_info=True)
                 continue
         self._queues.setdefault(queue, deque()).append(value)
+        # journal only what actually landed in the queue: a value handed
+        # straight to a parked popper is net-zero and must not be
+        # resurrected by replay
+        self._wal({"op": "qpush", "queue": queue, "value": value})
         return len(self._queues[queue])
 
     def qpop(self, queue: str) -> Optional[str]:
@@ -157,6 +224,7 @@ class KvStore:
             v = q.popleft()
             if not q:
                 self._queues.pop(queue, None)
+            self._wal({"op": "qpop", "queue": queue})
             return v
         return None
 
@@ -246,6 +314,150 @@ class KvStore:
                               exc_info=True)
                     self._watches.pop(w.watch_id, None)
 
+    # ---- WAL (journal_path set) — same journal idiom as the G3 manifest
+    # (engine/offload.py): JSONL append + flush per mutation, periodic
+    # compaction to a one-line-per-live-entry snapshot via tmp + fsync +
+    # atomic rename. ----
+
+    def _live_entries(self) -> int:
+        return (
+            len(self._kv)
+            + len(self._leases)
+            + sum(len(q) for q in self._queues.values())
+        )
+
+    def _wal(self, rec: dict[str, Any]) -> None:
+        if self.journal_path is None:
+            return
+        if self._journal is None:
+            fresh = not os.path.exists(self.journal_path)
+            self._journal = open(self.journal_path, "a", encoding="utf-8")
+            if fresh:
+                self._journal.write(json.dumps({"dcp_wal": 1}) + "\n")
+                self._journal_lines = 1
+        self._journal.write(json.dumps(rec) + "\n")
+        self._journal.flush()
+        self._journal_lines += 1
+        if self._journal_lines > max(_WAL_SLACK * self._live_entries(), 256):
+            self.compact_journal()
+
+    def compact_journal(self) -> None:
+        """Rewrite the journal as a snapshot of live state: meta line, then
+        one lease_grant per live lease, one put per key, one qpush per
+        queued item. Crash-safe: tmp + fsync + atomic rename."""
+        if self.journal_path is None:
+            return
+        if self._journal is not None:
+            self._journal.close()
+            self._journal = None
+        tmp = self.journal_path + ".tmp"
+        lines = 1
+        with open(tmp, "w", encoding="utf-8") as f:
+            f.write(json.dumps({"dcp_wal": 1}) + "\n")
+            # leases first so replayed puts find their lease registered
+            for lease, ttl in self._lease_ttl.items():
+                f.write(json.dumps(
+                    {"op": "lease_grant", "lease": lease, "ttl": ttl}) + "\n")
+                lines += 1
+            for key, (value, lease) in self._kv.items():
+                f.write(json.dumps(
+                    {"op": "put", "key": key, "value": value,
+                     "lease": lease}) + "\n")
+                lines += 1
+            for queue, q in self._queues.items():
+                for value in q:
+                    f.write(json.dumps(
+                        {"op": "qpush", "queue": queue,
+                         "value": value}) + "\n")
+                    lines += 1
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.journal_path)
+        self._journal_lines = lines
+
+    def _replay_journal(self) -> None:
+        """Rebuild state from the journal at startup. Torn tails (partial
+        final write from a crash) are counted and skipped, matching the G3
+        manifest loader. Restored lease deadlines get a grace window —
+        max(ttl, lease_grace_s) from now — so still-alive workers can
+        reclaim their leases before the sweeper erases the fleet."""
+        if not os.path.exists(self.journal_path):
+            return
+        now = self._clock()
+        max_lease = 0
+        with open(self.journal_path, "r", encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    self.torn_records += 1
+                    continue
+                op = rec.get("op")
+                if op == "put":
+                    lease = rec.get("lease", 0)
+                    if lease and lease not in self._leases:
+                        continue  # lease revoked later in the log
+                    self._kv[rec["key"]] = (rec.get("value", ""), lease)
+                    if lease:
+                        self._lease_keys.setdefault(lease, set()).add(
+                            rec["key"])
+                elif op == "delete":
+                    _, lease = self._kv.pop(rec["key"], ("", 0))
+                    if lease:
+                        ks = self._lease_keys.get(lease)
+                        if ks is not None:
+                            ks.discard(rec["key"])
+                elif op == "lease_grant":
+                    lease = int(rec["lease"])
+                    ttl = float(rec.get("ttl", 10.0))
+                    max_lease = max(max_lease, lease)
+                    self._leases[lease] = now + max(ttl, self.lease_grace_s)
+                    self._lease_ttl[lease] = ttl
+                elif op == "lease_revoke":
+                    lease = int(rec["lease"])
+                    self._leases.pop(lease, None)
+                    self._lease_ttl.pop(lease, None)
+                    for k in self._lease_keys.pop(lease, set()):
+                        self._kv.pop(k, None)
+                elif op == "qpush":
+                    self._queues.setdefault(
+                        rec["queue"], deque()).append(rec.get("value", ""))
+                elif op == "qpop":
+                    q = self._queues.get(rec["queue"])
+                    if q:
+                        q.popleft()
+                        if not q:
+                            self._queues.pop(rec["queue"], None)
+        if max_lease:
+            # restart the id counter past everything in the log so fresh
+            # grants never collide with reclaimed leases
+            self._ids = itertools.count(max_lease + 1)
+        self.replayed_keys = len(self._kv)
+        self.replayed_queue_items = sum(
+            len(q) for q in self._queues.values())
+        self.revision = self.replayed_keys
+        if self.replayed_keys:
+            STORE.inc("dynamo_store_replayed_keys_total", self.replayed_keys)
+        if self.replayed_queue_items:
+            STORE.inc("dynamo_store_replayed_queue_items_total",
+                      self.replayed_queue_items)
+        if self.torn_records:
+            log.warning("store journal: skipped %d torn record(s)",
+                        self.torn_records)
+        log.info(
+            "store journal replayed: %d key(s), %d lease(s), %d queue "
+            "item(s) (grace %.1fs)", self.replayed_keys, len(self._leases),
+            self.replayed_queue_items, self.lease_grace_s,
+        )
+
+    def close_journal(self) -> None:
+        if self._journal is not None:
+            self._journal.close()
+            self._journal = None
+
 
 class _Conn:
     """One client connection to the store server."""
@@ -266,9 +478,12 @@ class _Conn:
         s = self.store
         if op == "put":
             lease = req.get("lease", 0)
-            if lease and lease not in s._leases:
+            if lease and not s.lease_alive(lease):
                 # in-band error, wire-identical to dcp_server.cc — a stale
-                # lease must not tear down the whole multiplexed connection
+                # lease must not tear down the whole multiplexed connection.
+                # lease_alive (not membership) so an expired-but-unswept
+                # lease is authoritatively dead here too.
+                s.expire_lease_if_overdue(lease)
                 return {"ok": False, "error": "lease not found"}
             rev = s.put(req["key"], req.get("value", ""), lease)
             return {"ok": True, "rev": rev}
@@ -343,15 +558,26 @@ async def serve_store(
     port: int = 7111,
     store: Optional[KvStore] = None,
     sweep_interval_s: float = 0.5,
+    journal_path: Optional[str] = None,
 ) -> tuple[asyncio.AbstractServer, KvStore]:
     """Run the Python control-plane server. Returns (server, store)."""
-    store = store or KvStore()
+    store = store or KvStore(journal_path=journal_path)
+    conn_writers: set[asyncio.StreamWriter] = set()
 
     async def on_conn(reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        from dynamo_tpu.resilience.chaos import CHAOS
+
         conn = _Conn(store, writer)
+        conn_writers.add(writer)
         try:
             while True:
                 req = await read_frame(reader)
+                if CHAOS.fire("kill_store"):
+                    crash_store(server)
+                    raise ConnectionResetError("chaos: store killed")
+                # a partition holds replies indefinitely: the TCP conn
+                # stays up but the store goes silent (vs kill's hard RST)
+                await CHAOS.maybe_stall("partition_store", 0)
                 try:
                     resp = conn.handle(req)
                 except Exception as e:  # noqa: BLE001 — answer in-band;
@@ -376,6 +602,7 @@ async def serve_store(
                 store.unwatch(wid)
             for sid in conn.sub_ids:
                 store.unsubscribe(sid)
+            conn_writers.discard(writer)
             writer.close()
 
     async def sweeper():
@@ -386,5 +613,37 @@ async def serve_store(
 
     server = await asyncio.start_server(on_conn, host, port)
     task = asyncio.get_running_loop().create_task(sweeper())
-    server._dcp_sweeper = task  # keep a ref; dies with the loop
+    server._dcp_sweeper = task  # keep a ref until close
+    server._dcp_conn_writers = conn_writers
+    server._dcp_store = store
+    # close() must also cancel the sweeper — otherwise every store
+    # instance leaks a live 0.5s-cadence task into the loop
+    _orig_close = server.close
+
+    def _close() -> None:
+        if not task.done():
+            task.cancel()
+        _orig_close()
+
+    server.close = _close
     return server, store
+
+
+def crash_store(server: asyncio.AbstractServer) -> None:
+    """Simulate the store process dying: stop accepting, hard-abort every
+    live connection (clients see ConnectionResetError, not a clean FIN),
+    kill the sweeper. The KvStore object — and its journal — survive only
+    on disk; restart with ``serve_store(store=KvStore(journal_path=...))``.
+    Used by the kill_store chaos point, the store_outage bench phase, and
+    the restart tests."""
+    task = getattr(server, "_dcp_sweeper", None)
+    if task is not None and not task.done():
+        task.cancel()
+    store = getattr(server, "_dcp_store", None)
+    if store is not None:
+        store.close_journal()
+    server.close()
+    for w in list(getattr(server, "_dcp_conn_writers", ())):
+        transport = w.transport
+        if transport is not None:
+            transport.abort()

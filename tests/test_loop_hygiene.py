@@ -31,6 +31,7 @@ class _SlowCallbackCatcher(logging.Handler):
 
 @pytest.mark.slow
 async def test_control_plane_has_no_slow_loop_callbacks():
+    base = set(asyncio.all_tasks())  # harness wrapper tasks are not leaks
     loop = asyncio.get_running_loop()
     catcher = _SlowCallbackCatcher()
     alog = logging.getLogger("asyncio")
@@ -57,6 +58,16 @@ async def test_control_plane_has_no_slow_loop_callbacks():
             await server.wait_closed()
         # give debug-mode bookkeeping a tick to flush its warnings
         await asyncio.sleep(0.05)
+        # task hygiene: closing the server must cancel its sweeper, and
+        # closing a client must tear down its rx task — anything left is
+        # a leak that accumulates one 0.5s-cadence task per store in the
+        # suite's shared loop
+        leftover = [
+            t for t in asyncio.all_tasks()
+            if t not in base and t is not asyncio.current_task()
+            and not t.done()
+        ]
+        assert not leftover, f"stray tasks after close: {leftover}"
     finally:
         loop.set_debug(False)
         alog.removeHandler(catcher)

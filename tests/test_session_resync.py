@@ -1,0 +1,255 @@
+"""StoreSession resync tests (PR 15 tentpole, layer 2).
+
+A StoreSession duck-types KvClient but survives control-plane outages:
+it reconnects with backoff, reclaims (journal) or re-grants (fresh
+store) its leases, re-puts lease-bound registration keys, re-establishes
+watches/subscriptions, and synthesizes put/delete deltas for state that
+changed while it was down. ``Lease.lost`` is consumed by the session —
+a recoverable outage never surfaces it to the owner.
+"""
+import asyncio
+
+import pytest
+
+from dynamo_tpu.runtime.client import KvClient
+from dynamo_tpu.runtime.session import StoreSession
+from dynamo_tpu.runtime.store import KvStore, crash_store, serve_store
+
+
+async def _start(port=0, **kw):
+    server, store = await serve_store(port=port, sweep_interval_s=0.05, **kw)
+    return server, store, server.sockets[0].getsockname()[1]
+
+
+async def _wait_resynced(sess, n=1, rounds=400):
+    for _ in range(rounds):
+        if not sess.degraded and sess.resyncs >= n:
+            return True
+        await asyncio.sleep(0.02)
+    return False
+
+
+# ---------------------------------------------------------------------------
+# re-watch delta synthesis
+
+
+async def test_rewatch_synthesizes_put_and_delete_deltas():
+    server, store, port = await _start()
+    sess = await StoreSession(port=port).connect()
+    try:
+        await sess.put("p/stays", "same")
+        await sess.put("p/dies", "old")
+        await sess.put("p/changes", "v1")
+        watch = await sess.watch_prefix("p/")
+        assert {k for k, _, _ in watch.initial} == {
+            "p/stays", "p/dies", "p/changes"}
+
+        crash_store(server)
+        await asyncio.sleep(0.05)
+        # the replacement store saw writes while the session was down
+        s2 = KvStore()
+        s2.put("p/stays", "same")
+        s2.put("p/changes", "v2")
+        s2.put("p/born", "new")
+        server2, _, _ = await _start(port=port, store=s2)
+        assert await _wait_resynced(sess)
+
+        # synthesized deltas: delete for p/dies, puts for the changed and
+        # new keys, NOTHING for the unchanged key
+        events = []
+        for _ in range(3):
+            events.append(await asyncio.wait_for(
+                watch.queue.get(), timeout=2.0))
+        got = {(e["event"], e["key"]) for e in events}
+        assert got == {("delete", "p/dies"), ("put", "p/changes"),
+                       ("put", "p/born")}
+        assert all(e.get("synthetic") for e in events)
+        assert {e["key"]: e.get("value")
+                for e in events if e["event"] == "put"} == {
+            "p/changes": "v2", "p/born": "new"}
+        assert watch.queue.empty()
+
+        # the re-established watch is LIVE on the new store
+        s2.put("p/after", "x")
+        ev = await asyncio.wait_for(watch.queue.get(), timeout=2.0)
+        assert (ev["event"], ev["key"]) == ("put", "p/after")
+        assert not ev.get("synthetic")
+    finally:
+        await sess.close()
+        server2.close()
+
+
+async def test_rewatch_no_change_synthesizes_nothing():
+    jp_server, store, port = await _start()
+    sess = await StoreSession(port=port).connect()
+    try:
+        await sess.put("p/a", "1")
+        watch = await sess.watch_prefix("p/")
+        crash_store(jp_server)
+        await asyncio.sleep(0.05)
+        s2 = KvStore()
+        s2.put("p/a", "1")
+        server2, _, _ = await _start(port=port, store=s2)
+        assert await _wait_resynced(sess)
+        assert watch.synthesized_events == 0
+        assert watch.queue.empty()
+    finally:
+        await sess.close()
+        server2.close()
+
+
+# ---------------------------------------------------------------------------
+# lease reclaim / re-grant
+
+
+async def test_journaled_restart_reclaims_same_lease(tmp_path):
+    jp = str(tmp_path / "store.wal")
+    server, store, port = await _start(journal_path=jp)
+    sess = await StoreSession(port=port).connect()
+    try:
+        lease = await sess.lease_grant(0.6)
+        old_id = lease.id
+        key = f"dynamo://t/_components/c/e/{old_id}"
+        await sess.put(key, "reg", lease=old_id)
+
+        crash_store(server)
+        await asyncio.sleep(0.1)
+        server2, store2, _ = await _start(port=port, journal_path=jp)
+        assert await _wait_resynced(sess)
+
+        # journal replay + grace window -> the SAME lease was reclaimed:
+        # no registration churn, the key survived replay
+        assert lease.id == old_id
+        assert store2.replayed_keys == 1
+        assert (await sess.get(key)) == "reg"
+        assert not lease.lost.is_set()
+        # keepalives flow on the new connection: the key outlives the TTL
+        await asyncio.sleep(1.0)
+        assert (await sess.get(key)) == "reg"
+    finally:
+        await sess.close()
+        server2.close()
+        store2.close_journal()
+
+
+async def test_fresh_restart_regrants_and_reputs_keys():
+    server, store, port = await _start()
+    sess = await StoreSession(port=port).connect()
+    try:
+        lease = await sess.lease_grant(0.6)
+        old_id = lease.id
+        await sess.put(f"dynamo://t/_components/c/e/{old_id}", "reg",
+                       lease=old_id)
+        rekeys = []
+        lease.on_rekey.append(lambda o, n: rekeys.append((o, n)))
+
+        crash_store(server)
+        await asyncio.sleep(0.1)
+        server2, store2, _ = await _start(port=port)  # EMPTY store
+        assert await _wait_resynced(sess)
+
+        # a fresh store can re-issue a colliding id — don't assert
+        # inequality; assert the INVARIANT: exactly one registration key,
+        # bound to the session's current lease, value intact
+        regs = await sess.get_prefix("dynamo://t/_components/c/e/")
+        assert [(k, v) for k, v, _ in regs] == [
+            (f"dynamo://t/_components/c/e/{lease.id}", "reg")]
+        if lease.id != old_id:
+            assert rekeys == [(old_id, lease.id)]
+        assert not lease.lost.is_set()
+        # the re-granted lease is live server-side: revoking it through
+        # the session deletes the re-put key
+        await sess.lease_revoke(lease.id)
+        assert await sess.get_prefix("dynamo://t/_components/c/e/") == []
+    finally:
+        await sess.close()
+        server2.close()
+
+
+async def test_server_side_lease_loss_regrants_while_connected():
+    """Lease.lost is actionable (satellite c): if the server expires the
+    lease while the CONNECTION is healthy, the session re-grants and
+    re-puts instead of leaving the worker silently deregistered."""
+    server, store, port = await _start()
+    sess = await StoreSession(port=port).connect()
+    try:
+        lease = await sess.lease_grant(0.3)
+        key = f"dynamo://t/_components/c/e/{lease.id}"
+        await sess.put(key, "reg", lease=lease.id)
+        # authoritative server-side loss: next keepalive answers False
+        store.lease_revoke(lease.id)
+        assert store.get(key) is None
+        for _ in range(200):
+            regs = await sess.get_prefix("dynamo://t/_components/c/e/")
+            if regs:
+                break
+            await asyncio.sleep(0.02)
+        assert [(k, v) for k, v, _ in regs] == [
+            (f"dynamo://t/_components/c/e/{lease.id}", "reg")]
+    finally:
+        await sess.close()
+        server.close()
+
+
+# ---------------------------------------------------------------------------
+# degraded-state plumbing + client close
+
+
+async def test_state_listener_sees_degraded_window():
+    server, store, port = await _start()
+    sess = await StoreSession(port=port).connect()
+    states = []
+    sess.add_state_listener(states.append)
+    try:
+        assert states == [False]  # fires immediately with current state
+        crash_store(server)
+        for _ in range(200):
+            if sess.degraded:
+                break
+            await asyncio.sleep(0.02)
+        assert states[-1] is True
+        server2, _, _ = await _start(port=port)
+        assert await _wait_resynced(sess)
+        assert states[-1] is False
+    finally:
+        await sess.close()
+        server2.close()
+
+
+async def test_kvclient_close_awaits_writer_teardown():
+    server, store, port = await _start()
+    c = await KvClient(port=port).connect()
+    await c.put("k", "v")
+    await c.close()
+    assert c.closed.is_set()
+    assert c._writer is None
+    # double-close is safe, and no task is left pumping the dead socket
+    await c.close()
+    leftover = [t for t in asyncio.all_tasks()
+                if t is not asyncio.current_task() and not t.done()
+                and "sweeper" not in repr(t)]
+    server.close()
+    await server.wait_closed()
+    assert not [t for t in leftover if "KvClient" in repr(t)]
+
+
+async def test_session_close_leaves_no_stray_tasks():
+    base = set(asyncio.all_tasks())  # harness wrapper tasks are not leaks
+    server, store, port = await _start()
+    sess = await StoreSession(port=port).connect()
+    await sess.lease_grant(1.0)
+    watch = await sess.watch_prefix("p/")
+    sub = await sess.subscribe("topic.>")
+    await sess.close()
+    server.close()
+    await server.wait_closed()
+    await asyncio.sleep(0.05)
+    leftover = [t for t in asyncio.all_tasks()
+                if t not in base and t is not asyncio.current_task()
+                and not t.done()]
+    assert not leftover, f"stray tasks after close: {leftover}"
+    # closed watches/subs terminate their consumers
+    with pytest.raises(StopAsyncIteration):
+        await watch.__anext__()
+    with pytest.raises(StopAsyncIteration):
+        await sub.__anext__()

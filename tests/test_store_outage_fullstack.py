@@ -1,0 +1,139 @@
+"""Full-stack control-plane outage test (slow): the whole serving stack
+— journal-backed store, resync-enabled workers registered via
+register_llm, ModelWatcher frontend, HTTP chat — survives the store
+being killed and WAL-restarted on the same port.
+
+The contract under test (PR 15 tentpole, layer 3):
+  * in-flight and new HTTP requests keep succeeding THROUGH the outage
+    (streams flow worker<->frontend direct; degraded mode freezes the
+    health/load views instead of evicting the fleet),
+  * every session resyncs after the restart; leases are reclaimed from
+    the replayed journal so the registry never churns,
+  * greedy completions are token-identical before, during and after the
+    bounce (differential pin).
+"""
+import asyncio
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from dynamo_tpu.frontend import HttpService, ModelManager
+from dynamo_tpu.frontend.watcher import ModelEntry, ModelWatcher, register_llm
+from dynamo_tpu.mocker import MockerArgs, MockerEngine
+from dynamo_tpu.runtime.component import DistributedRuntime
+from dynamo_tpu.runtime.store import crash_store, serve_store
+
+BS = 4
+
+
+async def chat(client, content, max_tokens=6):
+    r = await client.post(
+        "/v1/chat/completions",
+        json={
+            "model": "mock-model",
+            "messages": [{"role": "user", "content": content}],
+            "max_tokens": max_tokens,
+        },
+    )
+    return r
+
+
+@pytest.mark.slow
+async def test_serving_survives_store_bounce(tmp_path):
+    jp = str(tmp_path / "store.wal")
+    server, store = await serve_store(
+        port=0, sweep_interval_s=0.05, journal_path=jp)
+    port = server.sockets[0].getsockname()[1]
+
+    workers = []
+    for _ in range(2):
+        rt = await DistributedRuntime.connect(port=port, resync=True)
+        eng = MockerEngine(
+            MockerArgs(speedup_ratio=100.0, page_size=BS, num_pages=64)
+        )
+        entry = ModelEntry(
+            name="mock-model", namespace="outage", component="backend",
+            block_size=BS, router_mode="kv",
+        )
+        served = await register_llm(rt, eng, entry, lease_ttl_s=1.0)
+        workers.append((rt, eng, served))
+    lease_ids = {served.lease_id for _, _, served in workers}
+
+    frontend_rt = await DistributedRuntime.connect(port=port, resync=True)
+    manager = ModelManager()
+    watcher = await ModelWatcher(
+        frontend_rt, manager, namespace="outage").start()
+    svc = HttpService(manager)
+    client = TestClient(TestServer(svc.app))
+    await client.start_server()
+    server2 = None
+    try:
+        for _ in range(200):
+            push = watcher._routers.get("mock-model")
+            if push is not None and len(push.workers) == 2:
+                break
+            await asyncio.sleep(0.02)
+        assert len(watcher._routers["mock-model"].workers) == 2
+
+        prompt = "w1 w2 w3 w4 w5"
+        r = await chat(client, prompt)
+        assert r.status == 200
+        ref = (await r.json())["choices"][0]["message"]["content"]
+
+        crash_store(server)
+        sessions = [rt.kv for rt, _, _ in workers] + [frontend_rt.kv]
+        for _ in range(200):
+            if all(s.degraded for s in sessions):
+                break
+            await asyncio.sleep(0.02)
+        assert all(s.degraded for s in sessions)
+
+        # DURING the outage: requests still route and stream (the
+        # degraded frontend serves from its last-known fleet view), and
+        # greedy output is identical
+        for _ in range(3):
+            r = await chat(client, prompt)
+            assert r.status == 200
+            body = await r.json()
+            assert body["choices"][0]["message"]["content"] == ref
+
+        # outage outlives the lease TTL: only the replay grace window
+        # (plus frozen frontend health) keeps the fleet registered
+        await asyncio.sleep(1.2)
+        server2, store2 = await serve_store(
+            port=port, sweep_interval_s=0.05, journal_path=jp)
+        assert store2.replayed_keys >= 2  # both registrations replayed
+
+        for _ in range(400):
+            if all(not s.degraded and s.resyncs >= 1 for s in sessions):
+                break
+            await asyncio.sleep(0.02)
+        assert all(not s.degraded and s.resyncs >= 1 for s in sessions)
+
+        # leases were RECLAIMED, not re-granted: same ids, no churn
+        assert {served.lease_id for _, _, served in workers} == lease_ids
+        regs = await frontend_rt.kv.get_prefix(
+            "dynamo://outage/_components/backend/")
+        assert {k.rsplit("/", 1)[1] for k, _, _ in regs} == {
+            str(i) for i in lease_ids}
+
+        # AFTER recovery: keepalives flow again — outlive a full TTL,
+        # the fleet stays registered, output still token-identical
+        await asyncio.sleep(1.2)
+        assert len(watcher._routers["mock-model"].workers) == 2
+        for _ in range(3):
+            r = await chat(client, prompt)
+            assert r.status == 200
+            body = await r.json()
+            assert body["choices"][0]["message"]["content"] == ref
+    finally:
+        await client.close()
+        await watcher.stop()
+        await frontend_rt.close()
+        for rt, eng, served in workers:
+            await served.shutdown()
+            await eng.stop()
+            await rt.close()
+        if server2 is not None:
+            server2.close()
+            store2.close_journal()

@@ -1,0 +1,175 @@
+"""Store WAL durability tests (PR 15 tentpole, layer 1).
+
+The journal is an append-only JSONL of state mutations (puts, deletes,
+lease grants/revokes, queue push/pop) compacted with the same
+tmp+fsync+rename discipline as the G3 manifest. A restarted store must
+serve IDENTICAL get_prefix/qpop answers — the differential pins below —
+and replayed leases get a post-restart grace window so workers can
+reclaim their registrations before the sweeper runs.
+"""
+import json
+
+from dynamo_tpu.runtime.store import KvStore
+
+
+def _reopen(path, **kw):
+    return KvStore(journal_path=str(path), **kw)
+
+
+# ---------------------------------------------------------------------------
+# replay differential: keys + queues
+
+
+def test_wal_replay_serves_identical_keys_and_queues(tmp_path):
+    jp = tmp_path / "store.wal"
+    s1 = _reopen(jp)
+    s1.put("a/1", "x")
+    s1.put("a/2", "y")
+    s1.put("b/1", "z")
+    s1.delete("b/1")
+    for i in range(5):
+        s1.qpush("q", f"item-{i}")
+    assert s1.qpop("q") == "item-0"  # journaled pop: not replayed twice
+    want_keys = [(k, v) for k, v, _ in s1.get_prefix("")]
+    s1.close_journal()
+
+    s2 = _reopen(jp)
+    assert [(k, v) for k, v, _ in s2.get_prefix("")] == want_keys
+    # FIFO order survives: exactly item-1..item-4 remain, in order
+    assert [s2.qpop("q") for _ in range(4)] == [
+        f"item-{i}" for i in range(1, 5)]
+    assert s2.qpop("q") is None
+    assert s2.replayed_keys == 2
+    assert s2.replayed_queue_items == 4
+    assert s2.torn_records == 0
+
+
+def test_wal_replay_lease_bound_keys_and_revokes(tmp_path):
+    jp = tmp_path / "store.wal"
+    s1 = _reopen(jp)
+    keep = s1.lease_grant(ttl=5.0)
+    gone = s1.lease_grant(ttl=5.0)
+    s1.put("w/keep", "a", lease=keep)
+    s1.put("w/gone", "b", lease=gone)
+    s1.lease_revoke(gone)  # revoke deletes the bound key — and is journaled
+    s1.close_journal()
+
+    s2 = _reopen(jp)
+    assert s2.get("w/keep") is not None
+    assert s2.get("w/gone") is None
+    # lease ids continue past the replayed max: no id reuse after restart
+    assert s2.lease_grant(ttl=1.0) > keep
+
+
+# ---------------------------------------------------------------------------
+# lease grace window after restart
+
+
+def test_wal_replay_grants_lease_grace(tmp_path):
+    jp = tmp_path / "store.wal"
+    now = [0.0]
+    s1 = KvStore(clock=lambda: now[0], journal_path=str(jp))
+    lease = s1.lease_grant(ttl=0.5)
+    s1.put("w/1", "alive", lease=lease)
+    s1.close_journal()
+
+    # restart long after the original TTL would have expired: the grace
+    # window (not the stale deadline) governs, so the worker has time to
+    # reconnect and reclaim before the sweeper evicts it
+    now[0] = 100.0
+    s2 = KvStore(clock=lambda: now[0], journal_path=str(jp),
+                 lease_grace_s=10.0)
+    assert s2.get("w/1") is not None
+    now[0] = 105.0
+    assert s2.sweep_leases() == []
+    assert s2.lease_keepalive(lease)  # reclaim refreshes to now + ttl...
+    now[0] = 105.4
+    assert s2.sweep_leases() == []
+    now[0] = 120.0  # ...so unclaimed grace does eventually expire
+    assert s2.sweep_leases() == [lease]
+    assert s2.get("w/1") is None
+
+
+# ---------------------------------------------------------------------------
+# torn tail
+
+
+def test_wal_torn_tail_is_skipped_not_fatal(tmp_path):
+    jp = tmp_path / "store.wal"
+    s1 = _reopen(jp)
+    s1.put("a/1", "x")
+    s1.put("a/2", "y")
+    s1.close_journal()
+    # a crash mid-write leaves a torn final record
+    with open(jp, "a", encoding="utf-8") as f:
+        f.write('{"op":"put","key":"a/3","val')
+
+    s2 = _reopen(jp)
+    assert s2.torn_records == 1
+    assert [k for k, _, _ in s2.get_prefix("a/")] == ["a/1", "a/2"]
+    # the reopened journal keeps accepting writes after the torn tail
+    s2.put("a/4", "w")
+    s2.close_journal()
+    s3 = _reopen(jp)
+    assert [k for k, _, _ in s3.get_prefix("a/")] == ["a/1", "a/2", "a/4"]
+
+
+# ---------------------------------------------------------------------------
+# compaction
+
+
+def test_wal_compaction_bounds_journal_size(tmp_path):
+    jp = tmp_path / "store.wal"
+    s1 = _reopen(jp)
+    for i in range(2000):
+        s1.put("hot/key", f"v{i}")
+    s1.close_journal()
+    # one live key: the journal must have folded the churn away instead
+    # of keeping 2000 dead put records
+    lines = jp.read_text(encoding="utf-8").splitlines()
+    assert len(lines) < 600, f"journal never compacted: {len(lines)} lines"
+    assert json.loads(lines[0]) == {"dcp_wal": 1}
+    s2 = _reopen(jp)
+    assert s2.get("hot/key") == ("v1999", s2.revision - 1) or \
+        s2.get("hot/key")[0] == "v1999"
+
+
+def test_wal_compaction_writes_grants_before_puts(tmp_path):
+    """Replay applies records in order — a lease-bound put must find its
+    lease already granted, whatever order the live store created them."""
+    jp = tmp_path / "store.wal"
+    s1 = _reopen(jp)
+    lease = s1.lease_grant(ttl=30.0)
+    s1.put("w/1", "v", lease=lease)
+    s1.compact_journal()
+    s1.close_journal()
+    ops = [json.loads(line).get("op")
+           for line in jp.read_text(encoding="utf-8").splitlines()[1:]]
+    assert ops.index("lease_grant") < ops.index("put")
+    s2 = _reopen(jp)
+    assert s2.get("w/1") is not None
+    s2.lease_revoke(lease)
+    assert s2.get("w/1") is None  # the replayed binding is real
+
+
+# ---------------------------------------------------------------------------
+# satellite: expired-but-unswept leases are authoritative inline
+
+
+def test_put_on_expired_lease_rejected_before_sweep():
+    """The sweep cadence must not open a race window: a put (or
+    keepalive) against a lease past its deadline is rejected inline even
+    if the sweeper has not run yet."""
+    now = [0.0]
+    s = KvStore(clock=lambda: now[0])
+    lease = s.lease_grant(ttl=1.0)
+    s.put("w/1", "v", lease=lease)
+    now[0] = 1.5  # past the deadline; sweeper has NOT run
+    try:
+        s.put("w/2", "v", lease=lease)
+        raise AssertionError("put on expired lease must raise")
+    except KeyError:
+        pass
+    assert not s.lease_keepalive(lease)
+    # the inline check also expired the lease for real: keys are gone
+    assert s.get("w/1") is None

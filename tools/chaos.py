@@ -114,7 +114,8 @@ def main() -> int:
     sub.add_parser("list", help="show points, arm state and counters")
     parm = sub.add_parser("arm", help="arm one injection point")
     parm.add_argument("point", choices=(
-        "kill_worker", "stall_stream", "drop_response", "delay"))
+        "kill_worker", "stall_stream", "drop_response", "delay",
+        "kill_store", "partition_store"))
     parm.add_argument("--probability", type=float, default=1.0)
     parm.add_argument("--delay", type=float, default=0.0,
                       help="seconds (stall_stream / delay points)")
