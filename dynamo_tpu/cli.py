@@ -46,7 +46,10 @@ def _run_cp(rest: list[str]) -> int:
     p.add_argument("--store-journal", metavar="PATH", default=None,
                    help="WAL journal path: keys/leases/queues survive a "
                         "store restart (replayed at startup with a lease "
-                        "grace window). Python store only.")
+                        "grace window). Python store only. Trade-off: "
+                        "every mutation appends+flushes synchronously "
+                        "(and compaction fsyncs), so peak mutation "
+                        "throughput drops vs the in-memory default.")
     args = p.parse_args(rest)
 
     native = os.path.join(

@@ -124,8 +124,9 @@ async def register_llm(
             def _rekey_kv(old: int, new: int,
                           pub=pub, allocator=allocator) -> None:
                 wid = str(new)
-                pub.worker_id = wid
-                pub.topic = f"{KV_EVENTS_TOPIC}.{wid}"
+                # rekey() also rewrites payloads already queued under the
+                # old id, so none go out on the new topic mis-attributed
+                pub.rekey(wid, f"{KV_EVENTS_TOPIC}.{wid}")
                 allocator.worker_id = wid
 
             on_rekey.append(_rekey_kv)
@@ -171,8 +172,7 @@ async def register_llm(
         if on_rekey is not None:
             def _rekey_metrics(old: int, new: int, mpub=mpub) -> None:
                 wid = str(new)
-                mpub.worker_id = wid
-                mpub.topic = f"{METRICS_TOPIC}.{wid}"
+                mpub.rekey(wid, f"{METRICS_TOPIC}.{wid}")
 
             on_rekey.append(_rekey_metrics)
     return served

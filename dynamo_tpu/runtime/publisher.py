@@ -64,6 +64,29 @@ class _TopicPublisher:
         except asyncio.QueueFull:
             log.warning("publisher queue full; dropping %s event", self.topic)
 
+    def rekey(self, worker_id: str, topic: str) -> None:
+        """Retarget the publisher after a session lease rekey. worker_id
+        is stamped into each payload at offer time but the topic is read
+        at drain time, so payloads already queued under the old id are
+        rewritten in place — they must not go out on the NEW topic still
+        carrying the OLD worker_id (routers attribute KV blocks by the
+        id inside the event, not the topic). Runs synchronously on the
+        publisher's loop, so it is atomic wrt the drain task."""
+        old = getattr(self, "worker_id", None)
+        self.worker_id = worker_id
+        self.topic = topic
+        requeued = []
+        while True:
+            try:
+                p = self.queue.get_nowait()
+            except asyncio.QueueEmpty:
+                break
+            if isinstance(p, dict) and p.get("worker_id") == old:
+                p = dict(p, worker_id=worker_id)
+            requeued.append(p)
+        for p in requeued:
+            self.queue.put_nowait(p)
+
     async def _drain(self) -> None:
         while True:
             payload = await self.queue.get()
@@ -112,6 +135,11 @@ class WorkerMetricsPublisher(_TopicPublisher):
             self._flush_task.cancel()
             self._flush_task = None
         await super().stop()
+
+    def rekey(self, worker_id: str, topic: str) -> None:
+        super().rekey(worker_id, topic)
+        if self._pending is not None:  # throttled trailing sample
+            self._pending = dict(self._pending, worker_id=worker_id)
 
     def __call__(self, metrics: ForwardPassMetrics) -> None:
         import time

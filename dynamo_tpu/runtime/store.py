@@ -122,7 +122,8 @@ class KvStore:
                 ks.discard(key)
         self._kv[key] = (value, lease)
         self.revision += 1
-        self._wal({"op": "put", "key": key, "value": value, "lease": lease})
+        self._wal({"op": "put", "key": key, "value": value, "lease": lease,
+                   "rev": self.revision})
         self._notify("put", key, value)
         return self.revision
 
@@ -143,7 +144,7 @@ class KvStore:
             if ks is not None:
                 ks.discard(key)
         self.revision += 1
-        self._wal({"op": "delete", "key": key})
+        self._wal({"op": "delete", "key": key, "rev": self.revision})
         self._notify("delete", key, None)
         return 1
 
@@ -353,7 +354,10 @@ class KvStore:
         tmp = self.journal_path + ".tmp"
         lines = 1
         with open(tmp, "w", encoding="utf-8") as f:
-            f.write(json.dumps({"dcp_wal": 1}) + "\n")
+            # meta line carries the revision: compaction folds away the
+            # put/delete records whose "rev" fields would otherwise
+            # restore it on replay
+            f.write(json.dumps({"dcp_wal": 1, "rev": self.revision}) + "\n")
             # leases first so replayed puts find their lease registered
             for lease, ttl in self._lease_ttl.items():
                 f.write(json.dumps(
@@ -385,6 +389,7 @@ class KvStore:
             return
         now = self._clock()
         max_lease = 0
+        rev_hi = 0  # highest journaled revision (meta line + per-record)
         with open(self.journal_path, "r", encoding="utf-8") as f:
             for line in f:
                 line = line.strip()
@@ -396,10 +401,19 @@ class KvStore:
                     self.torn_records += 1
                     continue
                 op = rec.get("op")
+                rev_hi = max(rev_hi, int(rec.get("rev", 0)))
                 if op == "put":
                     lease = rec.get("lease", 0)
                     if lease and lease not in self._leases:
                         continue  # lease revoked later in the log
+                    old = self._kv.get(rec["key"])
+                    if old is not None and old[1] and old[1] != lease:
+                        # mirror live put(): the key moved off its old
+                        # lease — a later revoke/expiry of THAT lease
+                        # must not delete the new binding
+                        ks = self._lease_keys.get(old[1])
+                        if ks is not None:
+                            ks.discard(rec["key"])
                     self._kv[rec["key"]] = (rec.get("value", ""), lease)
                     if lease:
                         self._lease_keys.setdefault(lease, set()).add(
@@ -438,7 +452,9 @@ class KvStore:
         self.replayed_keys = len(self._kv)
         self.replayed_queue_items = sum(
             len(q) for q in self._queues.values())
-        self.revision = self.replayed_keys
+        # revision must not move backwards across a bounce: restore the
+        # highest journaled rev (pre-rev journals fall back to key count)
+        self.revision = max(rev_hi, self.replayed_keys)
         if self.replayed_keys:
             STORE.inc("dynamo_store_replayed_keys_total", self.replayed_keys)
         if self.replayed_queue_items:

@@ -253,3 +253,35 @@ async def test_session_close_leaves_no_stray_tasks():
         await watch.__anext__()
     with pytest.raises(StopAsyncIteration):
         await sub.__anext__()
+
+
+async def test_publisher_rekey_rewrites_queued_payloads():
+    """A KV event offered before a rekey must not be published on the
+    NEW worker's topic still carrying the OLD worker_id — routers
+    attribute blocks by the id inside the event, so that pairing would
+    briefly credit the new worker's topic stream to the old worker."""
+    import json
+
+    from dynamo_tpu.kv_router.protocols import KvCacheEvent, KvEventKind
+    from dynamo_tpu.runtime.publisher import KvEventPublisher
+
+    class _Kv:
+        def __init__(self):
+            self.published = []
+
+        async def publish(self, topic, value):
+            self.published.append((topic, json.loads(value)))
+
+    kv = _Kv()
+    pub = KvEventPublisher(kv, "111")
+    pub(KvCacheEvent(kind=KvEventKind.REMOVED, removed_hashes=[7]))
+    # the rekey lands while the event is still queued (drain not started)
+    pub.rekey("222", "kv_events.222")
+    pub.start()
+    for _ in range(100):
+        if kv.published:
+            break
+        await asyncio.sleep(0.01)
+    await pub.stop()
+    assert [(t, p["worker_id"]) for t, p in kv.published] == [
+        ("kv_events.222", "222")]

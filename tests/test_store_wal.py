@@ -65,6 +65,71 @@ def test_wal_replay_lease_bound_keys_and_revokes(tmp_path):
 # lease grace window after restart
 
 
+def test_wal_replay_key_rebound_across_leases(tmp_path):
+    """Replay regression: `put K lease A; put K lease B; lease_revoke A`
+    in the log must not delete K — replay mirrors live put()'s old-lease
+    bookkeeping, so the old lease's revoke only sweeps keys it still
+    owns."""
+    jp = tmp_path / "store.wal"
+    s1 = _reopen(jp)
+    a = s1.lease_grant(ttl=30.0)
+    b = s1.lease_grant(ttl=30.0)
+    s1.put("w/k", "v1", lease=a)
+    s1.put("w/k", "v2", lease=b)  # rebound: A no longer owns w/k
+    s1.lease_revoke(a)
+    assert s1.get("w/k") == ("v2", b)
+    s1.close_journal()
+
+    s2 = _reopen(jp)
+    assert s2.get("w/k") == ("v2", b)  # live/replay differential
+
+
+def test_wal_replay_rebound_key_survives_post_restart_revoke(tmp_path):
+    """Same rebind, but the old lease dies AFTER the restart: the
+    replayed _lease_keys set for the old lease must not still claim the
+    key, or its expiry/revoke silently drops a live registration."""
+    jp = tmp_path / "store.wal"
+    s1 = _reopen(jp)
+    a = s1.lease_grant(ttl=30.0)
+    b = s1.lease_grant(ttl=30.0)
+    s1.put("w/k", "v1", lease=a)
+    s1.put("w/k", "v2", lease=b)
+    s1.put("w/free", "u", lease=a)
+    s1.put("w/free", "u")  # rebound to no lease at all
+    s1.close_journal()
+
+    s2 = _reopen(jp)
+    s2.lease_revoke(a)
+    assert s2.get("w/k") == ("v2", b)
+    assert s2.get("w/free") == ("u", 0)
+    s2.lease_revoke(b)
+    assert s2.get("w/k") is None  # the b-binding is still real
+
+
+def test_wal_replay_restores_revision(tmp_path):
+    """Revision must not move backwards across a bounce: deletes and
+    overwrites bump it live, so key-count alone undercounts. Per-record
+    `rev` fields restore it on replay; compaction folds the records away
+    but carries the revision on the meta line."""
+    jp = tmp_path / "store.wal"
+    s1 = _reopen(jp)
+    s1.put("a/1", "x")
+    s1.put("a/1", "y")   # overwrite: rev 2, still one live key
+    s1.put("a/2", "z")
+    s1.delete("a/2")     # delete: rev 4
+    want = s1.revision
+    assert want == 4
+    s1.close_journal()
+
+    s2 = _reopen(jp)    # replays per-record revs, then compacts
+    assert s2.revision == want
+    s2.close_journal()
+
+    s3 = _reopen(jp)    # compacted journal: meta line carries the rev
+    assert s3.revision == want
+    assert s3.put("a/3", "w") == want + 1  # keeps counting forward
+
+
 def test_wal_replay_grants_lease_grace(tmp_path):
     jp = tmp_path / "store.wal"
     now = [0.0]
@@ -128,7 +193,7 @@ def test_wal_compaction_bounds_journal_size(tmp_path):
     # of keeping 2000 dead put records
     lines = jp.read_text(encoding="utf-8").splitlines()
     assert len(lines) < 600, f"journal never compacted: {len(lines)} lines"
-    assert json.loads(lines[0]) == {"dcp_wal": 1}
+    assert json.loads(lines[0])["dcp_wal"] == 1
     s2 = _reopen(jp)
     assert s2.get("hot/key") == ("v1999", s2.revision - 1) or \
         s2.get("hot/key")[0] == "v1999"
