@@ -382,9 +382,11 @@ def _routing_mode_fields() -> dict:
     try:
         env = dict(os.environ, JAX_PLATFORMS="cpu")
         env.pop("PYTHONWARNINGS", None)
+        # fleet_sim (1k-worker storm + 3 autoscaling arms) roughly
+        # doubles the subprocess runtime vs the pre-fleetsim phase set
         out = subprocess.run(
             [sys.executable, "-m", "dynamo_tpu.bench_modes"],
-            capture_output=True, text=True, timeout=420, env=env,
+            capture_output=True, text=True, timeout=840, env=env,
         )
         return json.loads(out.stdout.strip().splitlines()[-1])
     except Exception as e:  # noqa: BLE001 — secondary metric only
@@ -815,7 +817,33 @@ def main():
               "store_outage_resyncs", "store_outage_reconnects",
               "store_outage_replayed_keys",
               "store_outage_replayed_queue_items",
-              "store_outage_workers_after", "store_outage_error"):
+              "store_outage_workers_after", "store_outage_error",
+              # fleet_sim phase (bench_modes.fleet_sim_experiment):
+              # 1k-worker registration storm + bursty replay through the
+              # real control plane, then the autoscaling differential
+              # (SLA-violation minutes: predictive < static required)
+              "fleet_sim_workers", "fleet_sim_register_s",
+              "fleet_sim_discover_s", "fleet_sim_store_mutations_per_s",
+              "fleet_sim_wal_batched_syncs",
+              "fleet_sim_decision_p50_ms", "fleet_sim_decision_p99_ms",
+              "fleet_sim_storm_requests", "fleet_sim_storm_failed",
+              "fleet_sim_workers_after",
+              "fleet_sim_static_sla_violation_minutes",
+              "fleet_sim_static_ttft_p50_s", "fleet_sim_static_ttft_p99_s",
+              "fleet_sim_static_peak_replicas",
+              "fleet_sim_static_scale_events", "fleet_sim_static_failed",
+              "fleet_sim_reactive_sla_violation_minutes",
+              "fleet_sim_reactive_ttft_p50_s",
+              "fleet_sim_reactive_ttft_p99_s",
+              "fleet_sim_reactive_peak_replicas",
+              "fleet_sim_reactive_scale_events",
+              "fleet_sim_reactive_failed",
+              "fleet_sim_predictive_sla_violation_minutes",
+              "fleet_sim_predictive_ttft_p50_s",
+              "fleet_sim_predictive_ttft_p99_s",
+              "fleet_sim_predictive_peak_replicas",
+              "fleet_sim_predictive_scale_events",
+              "fleet_sim_predictive_failed", "fleet_sim_error"):
         v = stats.get(k)
         if v is None and k.endswith("_error"):
             continue

@@ -1174,6 +1174,324 @@ async def store_outage_experiment(
     }
 
 
+async def _fleet_sim_policy_run(
+    policy: str,
+    trace,
+    sim_rate: float,
+    sla_ttft_s: float,
+    base_replicas: int = 2,
+    max_replicas: int = 6,
+    streams_per_replica: float = 4.0,
+    bucket_s: float = 15.0,
+) -> dict:
+    """One autoscaling-policy arm of the fleet_sim differential: replay
+    ``trace`` (virtual-time arrivals) through a live store + watcher +
+    router against a SimFleet under ``policy``:
+
+    - ``static``     fixed ``base_replicas``, no planner
+    - ``reactive``   real Planner, constant predictor (sizes the fleet
+                     for the CURRENT stream count — scales after load)
+    - ``predictive`` real Planner, AR predictor (sizes the fleet for the
+                     FORECAST — scales ahead of the wave)
+
+    SLA-violation minutes = total duration of ``bucket_s`` arrival
+    buckets containing at least one request whose virtual-time TTFT
+    exceeded ``sla_ttft_s``."""
+    from dynamo_tpu.fleetsim.clock import VirtualClock
+    from dynamo_tpu.fleetsim.sim import SimConnector, SimFleet
+    from dynamo_tpu.frontend import ModelManager
+    from dynamo_tpu.frontend.watcher import ModelEntry, ModelWatcher
+    from dynamo_tpu.kv_router.scheduler import KvRouterConfig
+    from dynamo_tpu.mocker import MockerArgs
+    from dynamo_tpu.planner import Planner, PlannerConfig
+    from dynamo_tpu.protocols.common import (
+        PreprocessedRequest,
+        StopConditions,
+    )
+    from dynamo_tpu.runtime.component import DistributedRuntime
+    from dynamo_tpu.runtime.store import serve_store
+
+    bs = 16
+    ns = f"fleetsim_{policy}"
+    vclock = VirtualClock(rate=sim_rate)
+    server, store = await serve_store(port=0, sweep_interval_s=0.5)
+    port = server.sockets[0].getsockname()[1]
+    rt = await DistributedRuntime.connect(port=port)
+    entry = ModelEntry(name="sim-model", namespace=ns,
+                       component="backend", block_size=bs, router_mode="kv")
+
+    def make_args(idx: int) -> "MockerArgs":
+        # ~1.05 virtual-seconds service time (0.26s prefill of a 128-token
+        # prompt + 16 x 50ms decode), 4 slots -> ~3.8 streams/s/replica
+        return MockerArgs(
+            num_pages=256, page_size=bs, max_decode_slots=4,
+            prefill_time_per_token_s=0.002, decode_time_per_step_s=0.05,
+        )
+
+    fleet = SimFleet(rt, entry, make_args, clock=vclock,
+                     lease_ttl_s=600.0, metrics_interval_s=0.1)
+    frontend_rt = await DistributedRuntime.connect(port=port)
+    manager = ModelManager()
+    watcher = await ModelWatcher(
+        frontend_rt, manager, namespace=ns,
+        router_config=KvRouterConfig(router_temperature=0.0),
+        engine_factory=fleet.engine_factory,
+    ).start()
+    await fleet.scale_to(base_replicas)
+    push = None
+    for _ in range(400):
+        push = watcher._routers.get("sim-model")
+        if push is not None and len(push.workers) >= base_replicas:
+            break
+        await asyncio.sleep(0.02)
+    if push is None or len(push.workers) < base_replicas:
+        raise RuntimeError(f"{policy}: base fleet never discovered")
+
+    connector = SimConnector(fleet)
+    planner = None
+    planner_rt = None
+    if policy != "static":
+        cfg = PlannerConfig(
+            adjustment_interval_s=10.0,
+            min_replicas=base_replicas, max_replicas=max_replicas,
+            stable_intervals=3, metrics_stale_after_s=30.0,
+            predictor="ar" if policy == "predictive" else "constant",
+            predictive=True, streams_per_replica=streams_per_replica,
+        )
+        planner_rt = await DistributedRuntime.connect(port=port)
+        planner = await Planner(planner_rt.kv, connector, cfg,
+                                clock=vclock,
+                                load_view=watcher.load).start()
+
+    ttfts: list[float] = []
+    viol_buckets: set[int] = set()
+    failed = 0
+
+    async def one(tr) -> None:
+        nonlocal failed
+        req = PreprocessedRequest(
+            token_ids=list(tr.token_ids),
+            stop_conditions=StopConditions(max_tokens=tr.max_tokens,
+                                           ignore_eos=True),
+        )
+        t0 = vclock.monotonic()
+        first = None
+        # dynlint: disable=DTL007 — the bench counts arbitrary stream
+        # failures against the SLA instead of crashing on the first one
+        try:
+            async for o in push.generate(req):
+                if first is None and o.token_ids:
+                    first = vclock.monotonic()
+        except Exception:  # noqa: BLE001 — a failed stream is an SLA miss
+            failed += 1
+            viol_buckets.add(int(tr.arrival_s // bucket_s))
+            return
+        ttft = (first if first is not None else vclock.monotonic()) - t0
+        ttfts.append(ttft)
+        if ttft > sla_ttft_s:
+            viol_buckets.add(int(tr.arrival_s // bucket_s))
+
+    t_start = vclock.monotonic()
+    tasks = []
+    for tr in trace:
+        delay = tr.arrival_s - (vclock.monotonic() - t_start)
+        if delay > 0:
+            await vclock.sleep(delay)
+        tasks.append(asyncio.ensure_future(one(tr)))
+    await asyncio.gather(*tasks)
+
+    peak = max(connector.calls, default=base_replicas)
+    if planner is not None:
+        await planner.stop()
+    await watcher.stop()
+    await fleet.stop()
+    for r in (planner_rt, frontend_rt, rt):
+        if r is not None:
+            await r.close()
+    server.close()
+    arr = np.asarray(ttfts) if ttfts else np.asarray([0.0])
+    return {
+        "sla_violation_minutes": round(len(viol_buckets) * bucket_s / 60, 2),
+        "ttft_p50_s": round(float(np.percentile(arr, 50)), 3),
+        "ttft_p99_s": round(float(np.percentile(arr, 99)), 3),
+        "peak_replicas": peak,
+        "scale_events": len(connector.calls),
+        "failed": failed,
+    }
+
+
+async def fleet_sim_experiment(
+    storm_workers: int = 1024,
+    storm_requests: int = 192,
+    sim_rate: float = 20.0,
+    trace_duration_s: float = 240.0,
+    sla_ttft_s: float = 2.0,
+) -> dict:
+    """Fleet flight simulator (the ISSUE 16 tentpole exit artifact), two
+    sub-phases through the REAL store/watcher/router/planner planes:
+
+    1. **Registration storm at 1k+ workers** (real clock, batch-fsync
+       journal): a SimFleet registers ``storm_workers`` in-process mocker
+       workers against a live journal-backed store; once the watcher has
+       discovered the full fleet, a bursty (MMPP) trace replays through
+       the real KvPushRouter. Reports registration + discovery wall
+       times, store mutation rate (revision/s over the storm), router
+       decision latency p50/p99 at fleet scale, WAL batched-sync count,
+       and survival (full fleet still routed, zero failed streams).
+
+    2. **Autoscaling differential** (virtual clock, ``sim_rate``x
+       compression): the same bursty trace replayed against static vs
+       reactive vs predictive planner arms (_fleet_sim_policy_run),
+       reporting SLA-violation minutes for each — the predictive arm
+       must strictly beat static on the bursty trace."""
+    import shutil
+    import tempfile
+
+    from dynamo_tpu.fleetsim.sim import SimFleet
+    from dynamo_tpu.fleetsim.traces import PromptPopulation, mmpp_trace
+    from dynamo_tpu.frontend import ModelManager
+    from dynamo_tpu.frontend.watcher import ModelEntry, ModelWatcher
+    from dynamo_tpu.kv_router.scheduler import KvRouterConfig
+    from dynamo_tpu.mocker import MockerArgs
+    from dynamo_tpu.protocols.common import (
+        PreprocessedRequest,
+        StopConditions,
+    )
+    from dynamo_tpu.runtime.component import DistributedRuntime
+    from dynamo_tpu.runtime.store import serve_store
+    from dynamo_tpu.runtime.store_metrics import STORE
+
+    bs = 16
+    out: dict = {}
+
+    # ---- sub-phase 1: registration storm + fleet-scale routing ----
+    tmp = tempfile.mkdtemp(prefix="dynamo-bench-fleetsim-")
+    server, store = await serve_store(
+        port=0, sweep_interval_s=0.5,
+        journal_path=f"{tmp}/store.wal", fsync_mode="batch",
+    )
+    port = server.sockets[0].getsockname()[1]
+    rt = await DistributedRuntime.connect(port=port)
+    entry = ModelEntry(name="sim-model", namespace="bench_fleetstorm",
+                       component="backend", block_size=bs, router_mode="kv")
+
+    def storm_args(idx: int) -> "MockerArgs":
+        # near-instant streams: the storm measures control-plane and
+        # routing scale, not stream duration
+        return MockerArgs(num_pages=64, page_size=bs, max_decode_slots=4,
+                          prefill_time_per_token_s=2e-6,
+                          decode_time_per_step_s=2e-5)
+
+    fleet = SimFleet(rt, entry, storm_args,
+                     lease_ttl_s=120.0, metrics_interval_s=2.0)
+    frontend_rt = await DistributedRuntime.connect(port=port)
+    watcher = await ModelWatcher(
+        frontend_rt, ModelManager(), namespace="bench_fleetstorm",
+        router_config=KvRouterConfig(router_temperature=0.0),
+        engine_factory=fleet.engine_factory,
+    ).start()
+
+    syncs0 = STORE.get("dynamo_store_wal_batched_syncs_total")
+    rev0 = store.revision
+    t0 = time.monotonic()
+    await fleet.scale_to(storm_workers)
+    t_reg = time.monotonic()
+    push = None
+    for _ in range(2400):
+        push = watcher._routers.get("sim-model")
+        if push is not None and len(push.workers) >= storm_workers:
+            break
+        await asyncio.sleep(0.05)
+    t_disc = time.monotonic()
+    if push is None or len(push.workers) < storm_workers:
+        raise RuntimeError(
+            f"storm fleet never fully discovered "
+            f"({0 if push is None else len(push.workers)}/{storm_workers})"
+        )
+    mutation_rate = (store.revision - rev0) / max(t_disc - t0, 1e-9)
+
+    decisions: list[float] = []
+    push.on_decision = decisions.append
+    pop = PromptPopulation(n_prefixes=8, prefix_len=64, suffix_len=16,
+                           seed=11)
+    storm_trace = mmpp_trace(
+        duration_s=60.0, calm_rps=2.0, burst_rps=12.0,
+        p_calm_to_burst=0.2, p_burst_to_calm=0.1, seed=11,
+        population=pop, max_tokens=4,
+    )[:storm_requests]
+    storm_errors: list[str] = []
+    sem = asyncio.Semaphore(64)
+
+    async def one_storm(tr) -> None:
+        req = PreprocessedRequest(
+            token_ids=list(tr.token_ids),
+            stop_conditions=StopConditions(max_tokens=tr.max_tokens,
+                                           ignore_eos=True),
+        )
+        async with sem:
+            try:
+                async for _ in push.generate(req):
+                    pass
+            except Exception as e:  # noqa: BLE001 — survival phase:
+                # every failure is recorded and asserted zero below
+                storm_errors.append(f"{type(e).__name__}: {e}")
+
+    await asyncio.gather(*[one_storm(tr) for tr in storm_trace])
+    storm_failed = len(storm_errors)
+    fleet_after = len(push.workers)
+    batched_syncs = (STORE.get("dynamo_store_wal_batched_syncs_total")
+                     - syncs0)
+    d = np.asarray(decisions) if decisions else np.asarray([0.0])
+    out.update({
+        "fleet_sim_workers": storm_workers,
+        "fleet_sim_register_s": round(t_reg - t0, 2),
+        "fleet_sim_discover_s": round(t_disc - t0, 2),
+        "fleet_sim_store_mutations_per_s": round(mutation_rate, 1),
+        "fleet_sim_wal_batched_syncs": int(batched_syncs),
+        "fleet_sim_decision_p50_ms": round(
+            float(np.percentile(d, 50)) * 1e3, 3),
+        "fleet_sim_decision_p99_ms": round(
+            float(np.percentile(d, 99)) * 1e3, 3),
+        "fleet_sim_storm_requests": len(storm_trace),
+        "fleet_sim_storm_failed": storm_failed,
+        "fleet_sim_workers_after": fleet_after,
+    })
+    await watcher.stop()
+    await fleet.stop()
+    await frontend_rt.close()
+    await rt.close()
+    server.close()
+    store.close_journal()
+    shutil.rmtree(tmp, ignore_errors=True)
+    if storm_failed or fleet_after < storm_workers:
+        raise RuntimeError(
+            f"registration storm not survived: {storm_failed} failed "
+            f"streams, {fleet_after}/{storm_workers} workers routed"
+            + (f"; first error: {storm_errors[0]}" if storm_errors else "")
+        )
+
+    # ---- sub-phase 2: predictive-vs-static-vs-reactive differential ----
+    trace = mmpp_trace(
+        duration_s=trace_duration_s, calm_rps=2.0, burst_rps=14.0,
+        p_calm_to_burst=0.03, p_burst_to_calm=0.02, seed=23,
+        population=PromptPopulation(seed=23), max_tokens=16,
+    )
+    for policy in ("static", "reactive", "predictive"):
+        res = await _fleet_sim_policy_run(
+            policy, trace, sim_rate, sla_ttft_s)
+        for k, v in res.items():
+            out[f"fleet_sim_{policy}_{k}"] = v
+    if (out["fleet_sim_predictive_sla_violation_minutes"]
+            >= out["fleet_sim_static_sla_violation_minutes"]):
+        raise RuntimeError(
+            "predictive planner did not beat static: "
+            f"{out['fleet_sim_predictive_sla_violation_minutes']} vs "
+            f"{out['fleet_sim_static_sla_violation_minutes']} "
+            "SLA-violation minutes"
+        )
+    return out
+
+
 def main():
     out = asyncio.run(routing_experiment())
     out.update(asyncio.run(fault_experiment()))
@@ -1207,6 +1525,10 @@ def main():
         out.update(asyncio.run(store_outage_experiment()))
     except Exception as e:  # noqa: BLE001 — best-effort phase
         out["store_outage_error"] = str(e)[:200]
+    try:
+        out.update(asyncio.run(fleet_sim_experiment()))
+    except Exception as e:  # noqa: BLE001 — best-effort phase
+        out["fleet_sim_error"] = str(e)[:200]
     print(json.dumps(out))
 
 

@@ -50,6 +50,15 @@ def _run_cp(rest: list[str]) -> int:
                         "every mutation appends+flushes synchronously "
                         "(and compaction fsyncs), so peak mutation "
                         "throughput drops vs the in-memory default.")
+    p.add_argument("--store-fsync", choices=("always", "batch"),
+                   default="always",
+                   help="WAL durability mode: 'always' flushes per "
+                        "mutation (default); 'batch' coalesces all "
+                        "mutations landed in one event-loop drain into a "
+                        "single write+flush+fsync — registration storms "
+                        "cost one sync per drain instead of one per "
+                        "worker, at the price of losing at most one "
+                        "drain's mutations on a crash.")
     args = p.parse_args(rest)
 
     native = os.path.join(
@@ -69,7 +78,8 @@ def _run_cp(rest: list[str]) -> int:
 
     async def _serve():
         server, store = await serve_store(
-            port=args.port, journal_path=args.store_journal
+            port=args.port, journal_path=args.store_journal,
+            fsync_mode=args.store_fsync,
         )
         extra = ""
         if args.store_journal:
@@ -395,6 +405,15 @@ def _run_planner(rest: list[str]) -> int:
                    choices=("constant", "moving_average", "ar", "arima"),
                    help="load forecaster filtering observations before "
                         "scaling decisions (reference load_predictor.py)")
+    p.add_argument("--predictive", action="store_true",
+                   help="forecast next-interval concurrent streams and "
+                        "size the fleet for the forecast (scale ahead of "
+                        "the wave; pair with --predictor ar and "
+                        "--streams-per-replica)")
+    p.add_argument("--streams-per-replica", type=float, default=0.0,
+                   help="per-replica stream capacity the predictive "
+                        "forecast divides by (from a profile sweep or "
+                        "the engine's decode-slot count)")
     p.add_argument("--connector", default="local",
                    choices=("local", "kubernetes"),
                    help="scale actuator: spawn local worker subprocesses, "

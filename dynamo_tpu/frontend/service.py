@@ -276,6 +276,7 @@ class HttpService:
         from dynamo_tpu.kv_integrity import KV_INTEGRITY
         from dynamo_tpu.kv_quant import KV_QUANT
         from dynamo_tpu.kv_transfer_metrics import KV_TRANSFER
+        from dynamo_tpu.planner_metrics import PLANNER
         from dynamo_tpu.resilience.metrics import RESILIENCE
         from dynamo_tpu.runtime.store_metrics import STORE
         from dynamo_tpu.telemetry.prof import PROF
@@ -295,7 +296,8 @@ class HttpService:
                 + KV_INTEGRITY.render().encode()
                 + OVERLOAD.render().encode()
                 + PROF.render().encode()
-                + STORE.render().encode())
+                + STORE.render().encode()
+                + PLANNER.render().encode())
         return web.Response(
             body=body, content_type=CONTENT_TYPE_LATEST.split(";")[0]
         )

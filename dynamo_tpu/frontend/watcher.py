@@ -190,6 +190,7 @@ class ModelWatcher:
         kv_recorder: Optional[Any] = None,  # KvRecorder: tees kv_events
         health: Optional[Any] = None,       # WorkerHealthTracker override
         heartbeat_ttl_s: Optional[float] = None,
+        engine_factory: Optional[Any] = None,  # (client, Instance) -> engine
     ):
         from dynamo_tpu.resilience.health import WorkerHealthTracker
 
@@ -198,6 +199,10 @@ class ModelWatcher:
         self.namespace = namespace
         self.router_config = router_config
         self.kv_recorder = kv_recorder
+        # fleet simulator hook: routes to in-process engines (keyed by the
+        # instance discovered from the store) instead of spawning a
+        # RemoteWorkerEngine TCP client per worker. None = production path.
+        self.engine_factory = engine_factory
         # one health tracker shared by every model's router: per-worker
         # circuit breakers, plus heartbeats off the load-metrics plane
         # when ``heartbeat_ttl_s`` is set (each ForwardPassMetrics
@@ -412,9 +417,10 @@ class ModelWatcher:
                 for inst in instances:
                     wid = str(inst.id)
                     if wid not in push.workers:
-                        push.add_worker(
-                            wid, RemoteWorkerEngine(client, inst.id)
-                        )
+                        eng = (self.engine_factory(client, inst)
+                               if self.engine_factory is not None
+                               else RemoteWorkerEngine(client, inst.id))
+                        push.add_worker(wid, eng)
                         added = True
                 if added:
                     self._replay_unclaimed()

@@ -10,7 +10,7 @@ from __future__ import annotations
 
 import time
 from dataclasses import dataclass, field
-from typing import Optional
+from typing import Callable, Optional
 
 from dynamo_tpu.kv_router.protocols import ForwardPassMetrics
 
@@ -46,18 +46,25 @@ class ProcessedEndpoints:
 class MetricsAggregator:
     """Latest ForwardPassMetrics per worker, with staleness eviction."""
 
-    def __init__(self, stale_after_s: Optional[float] = None):
+    def __init__(
+        self,
+        stale_after_s: Optional[float] = None,
+        clock: Callable[[], float] = time.monotonic,
+    ):
         self.stale_after_s = stale_after_s
+        # injectable for the fleet simulator: staleness must be judged in
+        # sim time or a compressed run ages every worker out instantly
+        self.clock = clock
         self._latest: dict[str, tuple[float, ForwardPassMetrics]] = {}
 
     def update(self, metrics: ForwardPassMetrics) -> None:
-        self._latest[metrics.worker_id] = (time.monotonic(), metrics)
+        self._latest[metrics.worker_id] = (self.clock(), metrics)
 
     def remove_worker(self, worker_id: str) -> None:
         self._latest.pop(worker_id, None)
 
     def snapshot(self) -> ProcessedEndpoints:
-        now = time.monotonic()
+        now = self.clock()
         out: dict[str, ForwardPassMetrics] = {}
         for w, (t, m) in list(self._latest.items()):
             if self.stale_after_s is not None and now - t > self.stale_after_s:

@@ -141,6 +141,10 @@ class KvPushRouter:
         )
         self.migrations = 0       # replays dispatched (instance-local)
         self.reroutes = 0         # pre-first-token re-routes
+        # observability hook: called with the wall seconds each successful
+        # routing decision took (the fleet simulator's decision-latency
+        # probe; None = no overhead on the hot path)
+        self.on_decision: Optional[Callable[[float], None]] = None
         self.router.update_workers(list(self.workers))
 
     def add_worker(self, worker_id: WorkerId, engine: Any) -> None:
@@ -255,6 +259,8 @@ class KvPushRouter:
                 worker_id, overlap = self._route(rid, cur, tried)
             except NoEndpoints:
                 break
+            if self.on_decision is not None:
+                self.on_decision(time.monotonic() - t_route)
             cur.estimated_prefix_hit_num_blocks = overlap
             # trace context: the routing decision + KV-match score, onto
             # the frontend's span tree when it lives in this process

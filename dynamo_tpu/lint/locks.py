@@ -66,6 +66,13 @@ GUARDED_BY: dict[str, dict[str, str]] = {
         "_session_leases": "_mu",
         "_session_watches": "_mu",
     },
+    "fleetsim/sim.py": {
+        # simulated fleet roster: resized by the planner's connector AND
+        # the bench driver — concurrent asyncio tasks, and scale_to
+        # awaits mid-resize (spawn/drain), so an unguarded access reads
+        # a half-resized fleet
+        "_workers": "_mu",
+    },
 }
 
 _EXEMPT_FUNCTIONS = ("__init__",)

@@ -92,9 +92,17 @@ class MockerEngine:
         *,
         on_kv_event: Optional[Callable[[KvCacheEvent], None]] = None,
         on_metrics: Optional[Callable[[ForwardPassMetrics], None]] = None,
+        clock: Optional["Clock"] = None,
     ):
+        from dynamo_tpu.fleetsim.clock import REAL_CLOCK
+
         self.args = args or MockerArgs()
         self.on_metrics = on_metrics
+        # every sim-visible timestamp (queue waits, deadlines, idle-beat
+        # cadence, simulated prefill/decode sleeps) reads THIS clock, so
+        # a fleetsim VirtualClock compresses the whole engine; the real
+        # clock default keeps production behavior byte-identical
+        self.clock = clock or REAL_CLOCK
         self.allocator = PageAllocator(
             self.args.num_pages,
             self.args.page_size,
@@ -174,7 +182,7 @@ class MockerEngine:
         if not request.token_ids:
             raise ValueError("empty prompt")
         if (request.deadline is not None
-                and time.time() > request.deadline):
+                and self.clock.time() > request.deadline):
             from dynamo_tpu.overload import OVERLOAD
 
             self.sheds += 1
@@ -207,6 +215,7 @@ class MockerEngine:
             out=asyncio.Queue(),
             orig_prompt=list(request.token_ids),
             prompt=list(request.token_ids),
+            enqueue_time=self.clock.monotonic(),
         )
         self._waiting.append(r)
         self._wake.set()
@@ -256,7 +265,7 @@ class MockerEngine:
         same contract as TpuEngine's idle heartbeat."""
         if self.on_metrics is None:
             return
-        now = time.monotonic()
+        now = self.clock.monotonic()
         if now - self._last_idle_beat >= 0.5:
             self._last_idle_beat = now
             self.on_metrics(self.metrics())
@@ -278,18 +287,22 @@ class MockerEngine:
                     # asyncio.wait propagates outer cancellation always.
                     waiter = asyncio.ensure_future(self._wake.wait())
                     try:
-                        await asyncio.wait({waiter}, timeout=0.5)
+                        # park timeout is 0.5s of ENGINE time (idle beats
+                        # must keep their cadence under compression)
+                        await asyncio.wait(
+                            {waiter}, timeout=self.clock.to_wall(0.5)
+                        )
                     finally:
                         if not waiter.done():
                             waiter.cancel()
                 else:
                     # waiting but unadmittable (page pressure): idle-tick
-                    await asyncio.sleep(
+                    await self.clock.sleep(
                         a.decode_time_per_step_s / a.speedup_ratio
                     )
                 continue
             # one simulated decode step for the whole batch
-            await asyncio.sleep(a.decode_time_per_step_s / a.speedup_ratio)
+            await self.clock.sleep(a.decode_time_per_step_s / a.speedup_ratio)
             self.step_count += 1
             for r in list(self._active):
                 self._decode_one(r)
@@ -307,7 +320,7 @@ class MockerEngine:
         # deadline-aware shedding: drop still-WAITING requests whose
         # deadline passed (zero tokens, DEADLINE finish) — never one
         # that already produced output (preemption re-queues those)
-        now = time.time()
+        now = self.clock.time()
         kept = []
         for r in self._waiting:
             if (r.produced == 0 and not r.prefilling
@@ -322,7 +335,7 @@ class MockerEngine:
                     annotations={"shed": {
                         "reason": "deadline",
                         "queued_s": round(
-                            time.monotonic() - r.enqueue_time, 3),
+                            self.clock.monotonic() - r.enqueue_time, 3),
                     }},
                 ))
             else:
@@ -348,7 +361,7 @@ class MockerEngine:
                 return  # head-of-line blocks until space frees
             r.pages = matched + fresh
             r.prefilling = True
-            self._queue_waits.append(time.monotonic() - r.enqueue_time)
+            self._queue_waits.append(self.clock.monotonic() - r.enqueue_time)
             self._waiting.pop(0)
             self._active.append(r)
             # simulated prefill cost for the non-cached suffix
@@ -366,7 +379,7 @@ class MockerEngine:
 
     async def _emit_first(self, r: _MockRequest, delay: float) -> None:
         if delay > 0:
-            await asyncio.sleep(delay)
+            await self.clock.sleep(delay)
         r.prefilling = False
         if r.cancelled or r not in self._active:
             return  # preempted mid-prefill; readmission re-schedules

@@ -48,6 +48,19 @@ class PlannerConfig:
     # "moving_average", "ar"/"arima" (trend-following forecast;
     # reference load_predictor.py:159)
     predictor: str = "constant"
+    # predictive mode (fleetsim tentpole): additionally forecast the
+    # next-interval concurrent-stream count with the configured predictor
+    # and size the fleet for the FORECAST — with a trend-following
+    # predictor ("ar") the planner scales ahead of a rising wave instead
+    # of after the queue already built. ``streams_per_replica`` is the
+    # per-replica capacity the forecast divides by (from a profile sweep
+    # or the mocker's decode-slot count); predictive mode is inert at 0.
+    predictive: bool = False
+    streams_per_replica: float = 0.0
+    # live queue-wait scale-up trigger: when a WorkerLoadView is wired
+    # and any worker's estimated admission wait exceeds this, scale up
+    # even if KV usage and queue depth look fine (0 = disabled)
+    queue_wait_scale_up_s: float = 0.0
 
 
 class Connector(Protocol):
@@ -66,11 +79,17 @@ class LocalConnector:
     warm KV and live streams survive scale-down. SIGKILL only lands
     after ``drain_grace_s`` as the unresponsive-worker backstop."""
 
-    def __init__(self, worker_cmd: list[str], drain_grace_s: float = 30.0):
+    def __init__(self, worker_cmd: list[str], drain_grace_s: float = 30.0,
+                 clock: Optional[Any] = None):
+        from dynamo_tpu.fleetsim.clock import REAL_CLOCK
+
         # e.g. [sys.executable, "-m", "dynamo_tpu.cli", "run",
         #       "in=endpoint", "out=mocker", "--control-plane", addr, ...]
         self.worker_cmd = list(worker_cmd)
         self.drain_grace_s = drain_grace_s
+        # drain-grace deadlines are sim-visible: under a compressed clock
+        # the grace window must compress too (real clock default)
+        self.clock = clock or REAL_CLOCK
         self.procs: list[subprocess.Popen] = []
         self.drains_started = 0
         # retiring workers: drained out of self.procs but possibly still
@@ -89,9 +108,9 @@ class LocalConnector:
         """SIGTERM -> wait out the drain grace -> SIGKILL backstop."""
         try:
             proc.terminate()
-            deadline = time.monotonic() + self.drain_grace_s
-            while proc.poll() is None and time.monotonic() < deadline:
-                await asyncio.sleep(0.1)
+            deadline = self.clock.monotonic() + self.drain_grace_s
+            while proc.poll() is None and self.clock.monotonic() < deadline:
+                await self.clock.sleep(0.1)
             if proc.poll() is None:
                 log.warning(
                     "planner: worker pid %d ignored drain for %.0fs; "
@@ -214,13 +233,23 @@ class Planner:
         connector: Connector,
         config: Optional[PlannerConfig] = None,
         sla: Optional[Any] = None,  # profiler.SlaCapacity -> SLA mode
+        *,
+        clock: Optional[Any] = None,       # fleetsim Clock (real default)
+        load_view: Optional[Any] = None,   # overload.WorkerLoadView tap
     ):
+        from dynamo_tpu.fleetsim.clock import REAL_CLOCK
+
         self.kv = kv
         self.connector = connector
         self.config = config or PlannerConfig()
         self.sla = sla
+        self.clock = clock or REAL_CLOCK
+        # live queue-wait view (overload plane): when wired, decide()
+        # reads estimated admission waits as an extra scale-up signal
+        self.load_view = load_view
         self.aggregator = MetricsAggregator(
-            stale_after_s=self.config.metrics_stale_after_s
+            stale_after_s=self.config.metrics_stale_after_s,
+            clock=self.clock.monotonic,
         )
         self.decisions: list[tuple[float, int]] = []  # (ts, target) history
         self._low_streak = 0
@@ -261,11 +290,50 @@ class Planner:
 
     async def _loop(self) -> None:
         while True:
-            await asyncio.sleep(self.config.adjustment_interval_s)
+            await self.clock.sleep(self.config.adjustment_interval_s)
             try:
                 await self.adjust()
             except Exception:  # noqa: BLE001 — the loop must survive
                 log.exception("planner adjustment failed")
+
+    def _streams(self, snap) -> int:
+        """Concurrent streams across the fleet (active + queued)."""
+        return sum(
+            m.worker_stats.request_active_slots
+            + m.worker_stats.num_requests_waiting
+            for m in snap.metrics.values()
+        )
+
+    def _predictive_target(self, snap, current: int) -> int:
+        """Forecast next-interval stream count; size the fleet for the
+        forecast. With a trend-following predictor the target rises
+        BEFORE the wave peaks — the point of predictive mode."""
+        import math
+
+        from dynamo_tpu.planner_metrics import PLANNER
+
+        c = self.config
+        self._pred_streams.add_data_point(self._streams(snap))
+        forecast = self._pred_streams.predict_next()
+        PLANNER.set("dynamo_planner_predicted_load", forecast)
+        if c.streams_per_replica <= 0:
+            return current
+        return max(c.min_replicas, min(
+            c.max_replicas,
+            math.ceil(forecast / c.streams_per_replica),
+        ))
+
+    def _queue_wait_high(self, snap) -> bool:
+        """Live overload-plane trigger: any worker's estimated admission
+        wait beyond the configured bound."""
+        c = self.config
+        if self.load_view is None or c.queue_wait_scale_up_s <= 0:
+            return False
+        for wid in snap.metrics:
+            est = self.load_view.est_wait_s(wid)
+            if est is not None and est > c.queue_wait_scale_up_s:
+                return True
+        return False
 
     def decide(self) -> int:
         """Pure decision from the current snapshot (unit-testable)."""
@@ -278,13 +346,11 @@ class Planner:
             # Scale-up is immediate (SLA protection); scale-down steps one
             # replica per stable_intervals of consistently-lower targets so
             # a stale/empty metrics snapshot can't collapse the fleet.
-            streams = sum(
-                m.worker_stats.request_active_slots
-                + m.worker_stats.num_requests_waiting
-                for m in snap.metrics.values()
-            )
-            self._pred_streams.add_data_point(streams)
+            from dynamo_tpu.planner_metrics import PLANNER
+
+            self._pred_streams.add_data_point(self._streams(snap))
             streams = self._pred_streams.predict_next()
+            PLANNER.set("dynamo_planner_predicted_load", streams)
             target = min(c.max_replicas,
                          self.sla.replicas_for(streams, c.min_replicas))
             if target >= current:
@@ -303,7 +369,8 @@ class Planner:
         usage = self._pred_usage.predict_next()
         waiting = self._pred_waiting.predict_next()
         target = current
-        if usage > c.kv_usage_scale_up or waiting > c.waiting_scale_up:
+        if (usage > c.kv_usage_scale_up or waiting > c.waiting_scale_up
+                or self._queue_wait_high(snap)):
             target = current + 1
             self._low_streak = 0
         elif usage < c.kv_usage_scale_down and waiting < 0.5:
@@ -313,15 +380,33 @@ class Planner:
                 self._low_streak = 0
         else:
             self._low_streak = 0
+        if not c.predictive:
+            from dynamo_tpu.planner_metrics import PLANNER
+
+            PLANNER.set("dynamo_planner_predicted_load", usage)
+        else:
+            # predictive floor: never below what the forecast needs, and
+            # a forecast above current load cancels a pending downscale
+            pred = self._predictive_target(snap, current)
+            if pred > target:
+                target = pred
+                self._low_streak = 0
         return max(c.min_replicas, min(c.max_replicas, target))
 
     async def adjust(self) -> int:
+        from dynamo_tpu.planner_metrics import PLANNER
+
         target = self.decide()
         current = self.connector.current_replicas()
+        PLANNER.inc("dynamo_planner_decisions_total")
+        PLANNER.set("dynamo_planner_replicas", target)
         if target != current:
             log.info("planner: scaling %d -> %d", current, target)
+            PLANNER.inc("dynamo_planner_scale_ups_total"
+                        if target > current
+                        else "dynamo_planner_scale_downs_total")
             await self.connector.set_replicas(target)
-        self.decisions.append((time.monotonic(), target))
+        self.decisions.append((self.clock.monotonic(), target))
         return target
 
 
@@ -353,6 +438,8 @@ async def run_planner(args) -> None:
         min_replicas=args.min_replicas,
         max_replicas=args.max_replicas,
         predictor=getattr(args, "predictor", "constant"),
+        predictive=getattr(args, "predictive", False),
+        streams_per_replica=getattr(args, "streams_per_replica", 0.0),
     )
     if connector.current_replicas() < cfg.min_replicas:
         await connector.set_replicas(cfg.min_replicas)

@@ -61,7 +61,12 @@ class KvStore:
         clock: Callable[[], float] = time.monotonic,
         journal_path: Optional[str] = None,
         lease_grace_s: float = 10.0,
+        fsync_mode: str = "always",
     ):
+        if fsync_mode not in ("always", "batch"):
+            raise ValueError(
+                f"fsync_mode must be 'always' or 'batch', got {fsync_mode!r}"
+            )
         self._clock = clock
         self._kv: dict[str, tuple[str, int]] = {}       # key -> (value, lease)
         self._leases: dict[int, float] = {}             # lease -> deadline
@@ -78,8 +83,13 @@ class KvStore:
         # -- WAL (off when journal_path is None) --
         self.journal_path = journal_path
         self.lease_grace_s = lease_grace_s
+        self.fsync_mode = fsync_mode
         self._journal = None
         self._journal_lines = 0
+        # batch mode: records buffered here until the scheduled
+        # end-of-event-loop-drain flush (one write+flush+fsync per drain)
+        self._wal_pending: list[str] = []
+        self._wal_drain_scheduled = False
         self.replayed_keys = 0
         self.replayed_queue_items = 0
         self.torn_records = 0
@@ -336,11 +346,47 @@ class KvStore:
             if fresh:
                 self._journal.write(json.dumps({"dcp_wal": 1}) + "\n")
                 self._journal_lines = 1
-        self._journal.write(json.dumps(rec) + "\n")
-        self._journal.flush()
+        line = json.dumps(rec) + "\n"
         self._journal_lines += 1
+        if self.fsync_mode == "batch":
+            self._wal_pending.append(line)
+            self._schedule_wal_drain()
+        else:
+            self._journal.write(line)
+            self._journal.flush()
         if self._journal_lines > max(_WAL_SLACK * self._live_entries(), 256):
             self.compact_journal()
+
+    def _schedule_wal_drain(self) -> None:
+        if self._wal_drain_scheduled:
+            return
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            # no event loop (direct-call tests, replay): degrade to an
+            # immediate synced write so batch mode loses no durability
+            self._drain_wal()
+            return
+        # call_soon runs after every callback already queued this drain —
+        # all mutations landed by concurrent connections coalesce into one
+        # write + flush + fsync instead of a flush per record
+        self._wal_drain_scheduled = True
+        loop.call_soon(self._drain_wal)
+
+    def _drain_wal(self) -> None:
+        self._wal_drain_scheduled = False
+        if not self._wal_pending:
+            return
+        if self._journal is None:
+            # compaction folded the pending records into its snapshot (or
+            # the journal was closed) before the drain fired
+            self._wal_pending.clear()
+            return
+        self._journal.write("".join(self._wal_pending))
+        self._wal_pending.clear()
+        self._journal.flush()
+        os.fsync(self._journal.fileno())
+        STORE.inc("dynamo_store_wal_batched_syncs_total")
 
     def compact_journal(self) -> None:
         """Rewrite the journal as a snapshot of live state: meta line, then
@@ -348,6 +394,9 @@ class KvStore:
         queued item. Crash-safe: tmp + fsync + atomic rename."""
         if self.journal_path is None:
             return
+        # pending batched records are superseded by the snapshot (it is
+        # written from live in-memory state, which already includes them)
+        self._wal_pending.clear()
         if self._journal is not None:
             self._journal.close()
             self._journal = None
@@ -471,8 +520,11 @@ class KvStore:
 
     def close_journal(self) -> None:
         if self._journal is not None:
+            if self._wal_pending:
+                self._drain_wal()
             self._journal.close()
             self._journal = None
+        self._wal_pending.clear()
 
 
 class _Conn:
@@ -575,9 +627,11 @@ async def serve_store(
     store: Optional[KvStore] = None,
     sweep_interval_s: float = 0.5,
     journal_path: Optional[str] = None,
+    fsync_mode: str = "always",
 ) -> tuple[asyncio.AbstractServer, KvStore]:
     """Run the Python control-plane server. Returns (server, store)."""
-    store = store or KvStore(journal_path=journal_path)
+    store = store or KvStore(journal_path=journal_path,
+                             fsync_mode=fsync_mode)
     conn_writers: set[asyncio.StreamWriter] = set()
 
     async def on_conn(reader: asyncio.StreamReader, writer: asyncio.StreamWriter):

@@ -27,6 +27,9 @@ FAMILIES: tuple[tuple[str, str, str], ...] = (
     ("dynamo_store_degraded", "gauge",
      "1 while this process serves from last-known control-plane state "
      "(store unreachable, stale-while-revalidate)"),
+    ("dynamo_store_wal_batched_syncs_total", "counter",
+     "coalesced WAL flush+fsync drains in --store-fsync batch mode (each "
+     "covers every mutation landed in one event-loop drain)"),
 )
 
 # process-wide registry: the store server, sessions and watchers in one

@@ -160,13 +160,14 @@ class SystemServer:
         from dynamo_tpu.kv_quant import KV_QUANT
         from dynamo_tpu.kv_transfer_metrics import KV_TRANSFER
         from dynamo_tpu.overload import OVERLOAD
+        from dynamo_tpu.planner_metrics import PLANNER
         from dynamo_tpu.runtime.store_metrics import STORE
         from dynamo_tpu.telemetry.prof import PROF
 
         return ("\n".join(lines) + "\n" + RESILIENCE.render()
                 + KV_TRANSFER.render() + KV_QUANT.render()
                 + KV_INTEGRITY.render() + OVERLOAD.render()
-                + PROF.render() + STORE.render())
+                + PROF.render() + STORE.render() + PLANNER.render())
 
     async def handle_metrics(self, request: web.Request) -> web.Response:
         return web.Response(text=self.render(), content_type="text/plain")

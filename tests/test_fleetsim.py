@@ -1,0 +1,548 @@
+"""Fleet flight simulator tests (ISSUE 16 tentpole + satellites).
+
+Covers: seeded trace generators are replay-identical (+ JSONL round
+trip), virtual clock invariants (monotonicity, compression, sleep
+advance), SimConnector scale-up/drain against a LIVE store, the
+predictive-vs-reactive planner differential on a synthetic rising wave,
+WAL fsync batching, calibrate_mocker inversion, and a ~32-worker
+fleet_sim smoke through the real store/watcher/router planes.
+"""
+import asyncio
+import json
+import os
+import sys
+import time
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+
+from calibrate_mocker import mocker_args_from_profile  # noqa: E402
+
+from dynamo_tpu.fleetsim.clock import REAL_CLOCK, Clock, VirtualClock
+from dynamo_tpu.fleetsim.sim import SimConnector, SimFleet
+from dynamo_tpu.fleetsim.traces import (
+    PromptPopulation,
+    TraceRequest,
+    diurnal_trace,
+    load_jsonl,
+    mmpp_trace,
+    save_jsonl,
+)
+from dynamo_tpu.kv_router.protocols import (
+    ForwardPassMetrics,
+    KvStats,
+    WorkerStats,
+)
+from dynamo_tpu.planner import Planner, PlannerConfig
+from dynamo_tpu.runtime.client import KvClient
+from dynamo_tpu.runtime.component import DistributedRuntime
+from dynamo_tpu.runtime.store import KvStore, serve_store
+
+
+# ---------------------------------------------------------------- clock
+
+
+def test_real_clock_is_default_and_passthrough():
+    assert REAL_CLOCK.rate == 1.0
+    before = time.monotonic()
+    mid = REAL_CLOCK.monotonic()
+    after = time.monotonic()
+    assert before <= mid <= after
+    assert REAL_CLOCK.to_wall(7.5) == 7.5
+
+
+def test_virtual_clock_rejects_nonpositive_rate():
+    with pytest.raises(ValueError):
+        VirtualClock(rate=0)
+    with pytest.raises(ValueError):
+        VirtualClock(rate=-3)
+
+
+def test_virtual_clock_monotonic_never_regresses():
+    clk = VirtualClock(rate=50.0)
+    prev = clk.monotonic()
+    for _ in range(200):
+        cur = clk.monotonic()
+        assert cur >= prev
+        prev = cur
+
+
+async def test_virtual_clock_compression():
+    clk = VirtualClock(rate=40.0)
+    v0, w0 = clk.monotonic(), time.monotonic()
+    await clk.sleep(2.0)  # 2 virtual seconds = 50ms wall
+    v1, w1 = clk.monotonic(), time.monotonic()
+    assert v1 - v0 >= 2.0                 # virtual time advanced by >= v
+    assert w1 - w0 < 1.0                  # ...in far less wall time
+    assert clk.to_wall(40.0) == pytest.approx(1.0)
+
+
+def test_clock_subclass_contract():
+    # components accept any Clock; a trivial override must satisfy the
+    # same surface REAL_CLOCK does
+    class Frozen(Clock):
+        def monotonic(self):
+            return 123.0
+
+    assert Frozen().monotonic() == 123.0
+    assert Frozen().to_wall(5.0) == 5.0
+
+
+# --------------------------------------------------------------- traces
+
+
+def test_trace_generators_replay_identical():
+    for gen in (
+        lambda s: diurnal_trace(60, 1.0, 6.0, 40.0, seed=s),
+        lambda s: mmpp_trace(60, 1.0, 8.0, seed=s),
+    ):
+        a, b = gen(5), gen(5)
+        assert [r.__dict__ for r in a] == [r.__dict__ for r in b]
+        c = gen(6)
+        assert [r.__dict__ for r in a] != [r.__dict__ for r in c]
+
+
+def test_trace_arrivals_sorted_and_bounded():
+    trace = mmpp_trace(30, 2.0, 10.0, seed=3)
+    arr = [r.arrival_s for r in trace]
+    assert arr == sorted(arr)
+    assert all(0 <= t < 30 for t in arr)
+    ids = [r.request_id for r in trace]
+    assert len(set(ids)) == len(ids)
+
+
+def test_prompt_population_shares_prefixes():
+    import random
+
+    pop = PromptPopulation(n_prefixes=4, prefix_len=32, suffix_len=8,
+                           seed=1)
+    rng = random.Random(2)
+    prompts = [pop.sample(rng) for _ in range(64)]
+    assert all(len(p) == 40 for p in prompts)
+    heads = {tuple(p[:32]) for p in prompts}
+    # Zipf-hot prefixes: far fewer distinct heads than prompts
+    assert len(heads) <= 4
+    tails = {tuple(p[32:]) for p in prompts}
+    assert len(tails) > len(heads)
+
+
+def test_trace_jsonl_round_trip(tmp_path):
+    trace = diurnal_trace(20, 1.0, 4.0, 15.0, seed=9)
+    p = str(tmp_path / "trace.jsonl")
+    save_jsonl(p, trace)
+    back = load_jsonl(p)
+    assert [r.__dict__ for r in back] == [r.__dict__ for r in trace]
+    assert isinstance(back[0], TraceRequest)
+
+
+# ----------------------------------------------------- WAL fsync batching
+
+
+def test_store_rejects_unknown_fsync_mode():
+    with pytest.raises(ValueError):
+        KvStore(fsync_mode="sometimes")
+
+
+def test_wal_batch_mode_coalesces_and_survives_restart(tmp_path):
+    from dynamo_tpu.runtime.store_metrics import STORE
+
+    journal = str(tmp_path / "wal.jsonl")
+    before = STORE.get("dynamo_store_wal_batched_syncs_total")
+    s = KvStore(journal_path=journal, fsync_mode="batch")
+    # no running loop here: batch mode degrades to immediate synced
+    # writes, so durability never regresses below `always`
+    s.put("a", "1")
+    s.put("b", "2")
+    lease = s.lease_grant(30.0)
+    s.put("c", "3", lease=lease)
+    s.close_journal()
+    assert STORE.get("dynamo_store_wal_batched_syncs_total") > before
+
+    s2 = KvStore(journal_path=journal, fsync_mode="batch")
+    assert s2.get("a")[0] == "1"
+    assert s2.get("b")[0] == "2"
+    assert s2.get("c")[0] == "3"
+    s2.close_journal()
+
+
+async def test_wal_batch_mode_one_fsync_per_drain(tmp_path):
+    from dynamo_tpu.runtime.store_metrics import STORE
+
+    journal = str(tmp_path / "wal.jsonl")
+    s = KvStore(journal_path=journal, fsync_mode="batch")
+    before = STORE.get("dynamo_store_wal_batched_syncs_total")
+    # a burst of mutations inside one event-loop drain...
+    for i in range(32):
+        s.put(f"k{i}", str(i))
+    assert s._wal_pending  # buffered, not yet flushed
+    await asyncio.sleep(0)  # let the scheduled drain run
+    after = STORE.get("dynamo_store_wal_batched_syncs_total")
+    assert after == before + 1  # ...coalesced into ONE flush+fsync
+    assert not s._wal_pending
+    s.close_journal()
+    s2 = KvStore(journal_path=journal)
+    assert s2.get("k31")[0] == "31"
+    s2.close_journal()
+
+
+def test_wal_always_mode_unchanged(tmp_path):
+    journal = str(tmp_path / "wal.jsonl")
+    s = KvStore(journal_path=journal)
+    assert s.fsync_mode == "always"
+    s.put("x", "y")
+    # always mode never buffers: the record is on disk before put returns
+    assert not s._wal_pending
+    with open(journal) as f:
+        assert any('"x"' in line for line in f)
+    s.close_journal()
+
+
+# ----------------------------------------------- calibrate_mocker (tool)
+
+
+def _profile(ttft=0.128, itl=0.02, isl=64, slots=8):
+    return {
+        "isl": isl, "osl": 32,
+        "configs": [{
+            "name": "cfg-a",
+            "config": {"max_decode_slots": slots},
+            "points": [
+                {"concurrency": 1, "ttft_p50_s": ttft, "ttft_p99_s": ttft,
+                 "itl_p50_s": itl, "itl_p99_s": itl, "tok_s": 100.0},
+                {"concurrency": 4, "ttft_p50_s": ttft * 3,
+                 "ttft_p99_s": ttft * 4, "itl_p50_s": itl * 2,
+                 "itl_p99_s": itl * 3, "tok_s": 300.0},
+            ],
+        }],
+    }
+
+
+def test_calibrate_mocker_inverts_concurrency_one_point():
+    out = mocker_args_from_profile(_profile())
+    assert out["prefill_time_per_token_s"] == pytest.approx(0.128 / 64)
+    assert out["decode_time_per_step_s"] == pytest.approx(0.02)
+    assert out["max_decode_slots"] == 8
+
+
+def test_calibrate_mocker_config_selection_and_errors():
+    prof = _profile()
+    assert mocker_args_from_profile(prof, config_name="cfg-a")
+    with pytest.raises(ValueError):
+        mocker_args_from_profile(prof, config_name="nope")
+    with pytest.raises(ValueError):
+        mocker_args_from_profile({"isl": 0, "configs": []})
+    with pytest.raises(ValueError):
+        mocker_args_from_profile(_profile(ttft=0.0))
+
+
+def test_calibrate_mocker_cli(tmp_path):
+    from calibrate_mocker import main as cal_main
+
+    prof_path = str(tmp_path / "prof.json")
+    out_path = str(tmp_path / "args.json")
+    with open(prof_path, "w") as f:
+        json.dump(_profile(), f)
+    assert cal_main([prof_path, "-o", out_path]) == 0
+    with open(out_path) as f:
+        out = json.load(f)
+    assert out["decode_time_per_step_s"] == pytest.approx(0.02)
+
+
+# ------------------------------- planner: predictive vs reactive (unit)
+
+
+class FakeConnector:
+    def __init__(self, n: int = 1):
+        self.n = n
+        self.calls: list[int] = []
+
+    def current_replicas(self) -> int:
+        return self.n
+
+    async def set_replicas(self, n: int) -> None:
+        self.calls.append(n)
+        self.n = n
+
+
+def _streams_metrics(worker, active, waiting=0):
+    return ForwardPassMetrics(
+        worker_id=worker,
+        worker_stats=WorkerStats(request_active_slots=active,
+                                 num_requests_waiting=waiting),
+        kv_stats=KvStats(gpu_cache_usage_perc=0.5),
+    )
+
+
+def _make_planner(predictor, conn):
+    return Planner(
+        kv=None, connector=conn,
+        config=PlannerConfig(
+            min_replicas=2, max_replicas=12, stable_intervals=3,
+            predictor=predictor, predictive=True,
+            streams_per_replica=4.0,
+        ),
+    )
+
+
+def test_predictive_scales_ahead_of_rising_wave():
+    """Feed both arms the same synthetic rising stream counts; the AR
+    arm's target must exceed the constant (reactive) arm's BEFORE the
+    wave peaks — that is the whole point of predictive mode."""
+    wave = [4, 8, 12, 16, 20, 24, 28]  # rising, peaks later at 40
+    targets = {}
+    for predictor in ("constant", "ar"):
+        conn = FakeConnector(2)
+        planner = _make_planner(predictor, conn)
+        seq = []
+        for streams in wave:
+            planner.aggregator._latest.clear()
+            planner.aggregator.update(
+                _streams_metrics("w0", active=streams))
+            seq.append(planner.decide())
+        targets[predictor] = seq
+    # reactive sizes for the CURRENT count: last point 28/4 = 7
+    assert targets["constant"][-1] == 7
+    # predictive extrapolates the +4/interval trend: 32/4 = 8
+    # (earlier points run on the AR warm-up mean fallback, which trails a
+    # rising series — only the fitted tail demonstrates look-ahead)
+    assert targets["ar"][-1] > targets["constant"][-1]
+
+
+def test_predictive_inert_without_capacity():
+    conn = FakeConnector(2)
+    planner = Planner(
+        kv=None, connector=conn,
+        config=PlannerConfig(min_replicas=1, max_replicas=8,
+                             predictor="ar", predictive=True,
+                             streams_per_replica=0.0),
+    )
+    planner.aggregator.update(_streams_metrics("w0", active=30))
+    # no capacity model -> the predictive floor cannot fire; thresholds
+    # alone decide (usage 0.5 is in-band, waiting 0 -> hold)
+    assert planner.decide() == 2
+
+
+async def test_planner_adjust_emits_metrics():
+    from dynamo_tpu.planner_metrics import PLANNER
+
+    conn = FakeConnector(2)
+    planner = _make_planner("constant", conn)
+    planner.aggregator.update(_streams_metrics("w0", active=24))
+    before = PLANNER.get("dynamo_planner_scale_ups_total")
+    decisions_before = PLANNER.get("dynamo_planner_decisions_total")
+    target = await planner.adjust()
+    assert target == 6
+    assert conn.calls == [6]
+    assert PLANNER.get("dynamo_planner_replicas") == 6
+    assert PLANNER.get("dynamo_planner_decisions_total") \
+        == decisions_before + 1
+    assert PLANNER.get("dynamo_planner_scale_ups_total") == before + 1
+    assert PLANNER.get("dynamo_planner_predicted_load") == 24
+
+
+def test_queue_wait_trigger_scales_up():
+    from dynamo_tpu.overload.load import WorkerLoadView
+
+    class FakeView:
+        def est_wait_s(self, wid):
+            return 9.0
+
+    conn = FakeConnector(2)
+    planner = Planner(
+        kv=None, connector=conn,
+        config=PlannerConfig(min_replicas=1, max_replicas=8,
+                             queue_wait_scale_up_s=2.0),
+        load_view=FakeView(),
+    )
+    planner.aggregator.update(_streams_metrics("w0", active=1))
+    assert planner.decide() == 3  # +1 despite in-band usage/waiting
+    assert isinstance(WorkerLoadView(), WorkerLoadView)  # import sanity
+
+
+# ------------------------------------ sim fleet against a live store
+
+
+async def _discover(watcher, name, n, tries=400):
+    push = None
+    for _ in range(tries):
+        push = watcher._routers.get(name)
+        if push is not None and len(push.workers) >= n:
+            return push
+        await asyncio.sleep(0.02)
+    raise AssertionError(
+        f"fleet never discovered ({0 if push is None else len(push.workers)}"
+        f"/{n})")
+
+
+def _sim_stack(port, namespace, clock=REAL_CLOCK):
+    from dynamo_tpu.frontend.watcher import ModelEntry
+    from dynamo_tpu.mocker import MockerArgs
+
+    entry = ModelEntry(name="sim-model", namespace=namespace,
+                       component="backend", block_size=16,
+                       router_mode="kv")
+
+    def make_args(idx):
+        return MockerArgs(num_pages=64, page_size=16, max_decode_slots=4,
+                          prefill_time_per_token_s=1e-5,
+                          decode_time_per_step_s=1e-4)
+
+    return entry, make_args
+
+
+async def test_sim_connector_scales_and_drains_live_store():
+    from dynamo_tpu.frontend import ModelManager
+    from dynamo_tpu.frontend.watcher import ModelWatcher
+    from dynamo_tpu.kv_router.scheduler import KvRouterConfig
+
+    server, store = await serve_store(port=0, sweep_interval_s=0.1)
+    port = server.sockets[0].getsockname()[1]
+    rt = await DistributedRuntime.connect(port=port)
+    entry, make_args = _sim_stack(port, "fleetsim_test")
+    fleet = SimFleet(rt, entry, make_args, lease_ttl_s=30.0,
+                     metrics_interval_s=5.0)
+    frontend_rt = await DistributedRuntime.connect(port=port)
+    watcher = await ModelWatcher(
+        frontend_rt, ModelManager(), namespace="fleetsim_test",
+        router_config=KvRouterConfig(router_temperature=0.0),
+        engine_factory=fleet.engine_factory,
+    ).start()
+    conn = SimConnector(fleet)
+    try:
+        await conn.set_replicas(4)
+        assert conn.current_replicas() == 4
+        # registrations are REAL: leased instance keys live in the store
+        prefix = "dynamo://fleetsim_test/_components/backend/generate/"
+        assert len(store.get_prefix(prefix)) == 4
+        push = await _discover(watcher, "sim-model", 4)
+
+        # scale down: newest-first drain revokes leases -> keys vanish
+        await conn.set_replicas(1)
+        assert conn.current_replicas() == 1
+        assert len(store.get_prefix(prefix)) == 1
+        for _ in range(200):
+            if len(push.workers) == 1:
+                break
+            await asyncio.sleep(0.02)
+        assert len(push.workers) == 1
+        assert conn.calls == [4, 1]
+    finally:
+        await watcher.stop()
+        await fleet.stop()
+        await frontend_rt.close()
+        await rt.close()
+        server.close()
+
+
+async def test_fleet_sim_smoke_32_workers():
+    """Tier-1 smoke: 32 in-process workers register against a live
+    batch-fsync store, the watcher discovers them all, and a burst of
+    requests routes through the real KvPushRouter with zero failures."""
+    import tempfile
+
+    from dynamo_tpu.frontend import ModelManager
+    from dynamo_tpu.frontend.watcher import ModelWatcher
+    from dynamo_tpu.kv_router.scheduler import KvRouterConfig
+    from dynamo_tpu.protocols.common import (
+        PreprocessedRequest,
+        StopConditions,
+    )
+
+    n = 32
+    tmp = tempfile.mkdtemp(prefix="fleetsim-smoke-")
+    server, store = await serve_store(
+        port=0, sweep_interval_s=0.5,
+        journal_path=f"{tmp}/wal.jsonl", fsync_mode="batch")
+    port = server.sockets[0].getsockname()[1]
+    rt = await DistributedRuntime.connect(port=port)
+    entry, make_args = _sim_stack(port, "fleetsim_smoke")
+    entry.namespace = "fleetsim_smoke"
+    fleet = SimFleet(rt, entry, make_args, lease_ttl_s=30.0,
+                     metrics_interval_s=5.0)
+    frontend_rt = await DistributedRuntime.connect(port=port)
+    watcher = await ModelWatcher(
+        frontend_rt, ModelManager(), namespace="fleetsim_smoke",
+        router_config=KvRouterConfig(router_temperature=0.0),
+        engine_factory=fleet.engine_factory,
+    ).start()
+    try:
+        rev0 = store.revision
+        await fleet.scale_to(n)
+        assert store.revision > rev0
+        push = await _discover(watcher, "sim-model", n)
+
+        decisions = []
+        push.on_decision = decisions.append
+        trace = mmpp_trace(5.0, 4.0, 16.0, seed=2, max_tokens=4,
+                           population=PromptPopulation(
+                               n_prefixes=4, prefix_len=32, suffix_len=8,
+                               seed=2))
+        failed = 0
+
+        async def one(tr):
+            nonlocal failed
+            req = PreprocessedRequest(
+                token_ids=list(tr.token_ids),
+                stop_conditions=StopConditions(max_tokens=tr.max_tokens,
+                                               ignore_eos=True))
+            # dynlint: disable=DTL007 — the smoke counts failures
+            try:
+                async for _ in push.generate(req):
+                    pass
+            except Exception:  # noqa: BLE001 — counted, asserted zero
+                failed += 1
+
+        await asyncio.gather(*[one(tr) for tr in trace])
+        assert failed == 0
+        assert len(push.workers) == n
+        assert decisions and all(d >= 0 for d in decisions)
+    finally:
+        await watcher.stop()
+        await fleet.stop()
+        await frontend_rt.close()
+        await rt.close()
+        server.close()
+        store.close_journal()
+        import shutil
+
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+async def test_mocker_on_virtual_clock_compresses_decode():
+    """A mocker generating on a 50x clock finishes a stream whose
+    simulated decode time is ~1.6 virtual seconds in well under that
+    wall time — and the token stream is identical to a real-clock run."""
+    from dynamo_tpu.mocker import MockerArgs, MockerEngine
+    from dynamo_tpu.protocols.common import (
+        PreprocessedRequest,
+        StopConditions,
+    )
+
+    def make(clock=None):
+        return MockerEngine(MockerArgs(
+            num_pages=64, page_size=16, max_decode_slots=4,
+            prefill_time_per_token_s=0.001,
+            decode_time_per_step_s=0.1,
+        ), clock=clock)
+
+    def req():
+        return PreprocessedRequest(
+            token_ids=list(range(1, 33)),
+            stop_conditions=StopConditions(max_tokens=16,
+                                           ignore_eos=True))
+
+    async def run(eng):
+        toks = []
+        async for out in eng.generate(req()):
+            toks.extend(out.token_ids)
+        await eng.stop()
+        return toks
+
+    vclock = VirtualClock(rate=50.0)
+    t0 = time.monotonic()
+    fast = await run(make(clock=vclock))
+    fast_wall = time.monotonic() - t0
+    assert fast_wall < 1.0  # 1.6+ virtual seconds compressed ~50x
+    slow = await run(make())  # real clock default
+    assert fast == slow  # determinism: clock changes timing, not tokens
