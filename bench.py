@@ -808,6 +808,17 @@ def main():
               "integrity_flips_injected", "integrity_quarantined",
               "integrity_recomputed", "integrity_token_divergence",
               "integrity_error",
+              # prefix_economy phase (bench_modes
+              # .prefix_economy_experiment): cold worker joins mid-storm
+              # — warm-start prefetch must beat the prefetch-off arm's
+              # cold-start TTFT p99 with zero token divergence
+              "prefix_economy_on_ttft_p99_ms",
+              "prefix_economy_off_ttft_p99_ms",
+              "prefix_economy_prefetched_blocks",
+              "prefix_economy_recompute_avoided",
+              "prefix_economy_warm_starts",
+              "prefix_economy_token_divergence",
+              "prefix_economy_error",
               # store_outage phase (bench_modes.store_outage_experiment):
               # store killed + WAL-restarted mid-storm — zero failed
               # requests, sessions resync, leases reclaimed from replay

@@ -37,6 +37,18 @@ mechanics). Reports remote-prefill TTFT chunk-streamed vs monolithic,
 compute / total transfer seconds), and greedy token equality of the
 chunked, monolithic and pure-local paths.
 
+``prefix_economy`` — the fleet KV prefix-economy experiment (the
+cross-worker dedup + router-driven prefetch tentpole): one warm worker
+serves a storm of hot shared prefixes, feeding a live ``KvIndexer``;
+a COLD worker then joins mid-storm. The prefetch-ON arm is warm-started
+by the ``KvPrefetchController`` (fleet-hot chains pushed into its G2
+host tier before any request) and pulls one late-breaking hot prefix
+through dedup-by-hash admission instead of recomputing it; the
+prefetch-OFF arm recomputes everything. Reports cold-start TTFT p99 for
+both arms (ON must be strictly better), the prefetched / recompute-
+avoided block counts (both must be positive), the warm-start count, and
+greedy token divergence between the arms — which must be ZERO.
+
 ``store_outage`` — the control-plane survivability experiment (PR 15
 tentpole): a journal-backed store under a full watcher/router stack is
 killed mid-storm (``crash_store``) and restarted from its WAL on the
@@ -998,6 +1010,221 @@ async def integrity_experiment(n_new: int = 6) -> dict:
     }
 
 
+async def prefix_economy_experiment(
+    n_hot: int = 5, blocks_per_prefix: int = 12, n_new: int = 4
+) -> dict:
+    """Fleet prefix-economy experiment: cold worker joins mid-storm.
+
+    One warm TpuEngine serves ``n_hot`` hot shared prefixes, its KV
+    events feeding a live KvIndexer (the same state the frontend's
+    router holds). Two cold workers then join:
+
+      * prefetch ON — one KvPrefetchController tick warm-starts it
+        (fleet-hot chains land in its G2 host tier before any request),
+        and a prefix that turns hot AFTER the warm-start is pulled via
+        dedup-by-hash admission instead of recomputed;
+      * prefetch OFF — the legacy join: recompute everything.
+
+    Timed: cold-start TTFT over the hot set (first hot serve per arm is
+    the onboard/prefill compile warmup and is untimed). The ON arm's
+    p99 must be STRICTLY better, the prefetched and recompute-avoided
+    counters must be positive, and every ON stream must be greedy
+    token-identical to the OFF arm — the economy moves bytes, never
+    changes tokens."""
+    from dynamo_tpu.engine.config import EngineConfig
+    from dynamo_tpu.engine.engine import TpuEngine
+    from dynamo_tpu.kv_fleet_metrics import KV_FLEET
+    from dynamo_tpu.kv_router.fleet import FleetKvView
+    from dynamo_tpu.kv_router.indexer import KvIndexer
+    from dynamo_tpu.kv_router.prefetch import (
+        KvPrefetchController,
+        PrefetchConfig,
+    )
+    from dynamo_tpu.kv_transfer import (
+        BlockTransferServer,
+        RemoteKvFetcher,
+    )
+    from dynamo_tpu.models import llama
+    from dynamo_tpu.models.config import ModelConfig
+    from dynamo_tpu.parallel.mesh import MeshConfig
+    from dynamo_tpu.protocols.common import (
+        PreprocessedRequest,
+        StopConditions,
+    )
+    from dynamo_tpu.runtime.client import KvClient
+    from dynamo_tpu.runtime.store import serve_store
+    from dynamo_tpu.tokens import compute_block_hashes
+
+    ps = 16
+    plen = ps * blocks_per_prefix + 3  # full blocks + a tail
+    cfg = ModelConfig.tiny(dtype="float32")
+    params = llama.init_params(cfg, 0)
+    idx = KvIndexer(ps, freq_halflife_s=600.0)
+
+    def ecfg(worker_id, host_pages=0):
+        return EngineConfig(
+            num_pages=128, page_size=ps,
+            max_pages_per_seq=blocks_per_prefix + 4,
+            max_decode_slots=2, prefill_buckets=(64, plen + 29),
+            cache_dtype="float32", flush_every=2, max_inflight_rounds=1,
+            host_offload_pages=host_pages, worker_id=worker_id,
+        )
+
+    warm = TpuEngine(cfg, ecfg("warm"), params=params,
+                     mesh_config=MeshConfig(tp=1),
+                     on_kv_event=idx.apply_event)
+    cold_on = TpuEngine(cfg, ecfg("cold_on", host_pages=96),
+                        params=params, mesh_config=MeshConfig(tp=1))
+    cold_off = TpuEngine(cfg, ecfg("cold_off", host_pages=96),
+                         params=params, mesh_config=MeshConfig(tp=1))
+
+    def prompt_for(i):
+        return [(i * 7919 + j) % 30000 + 1 for j in range(plen)]
+
+    def req_for(p):
+        return PreprocessedRequest(
+            token_ids=list(p),
+            stop_conditions=StopConditions(max_tokens=n_new,
+                                           ignore_eos=True),
+        )
+
+    async def run(eng, p):
+        t0 = time.monotonic()
+        ttft, toks = None, []
+        async for out in eng.generate(req_for(p)):
+            if out.token_ids and ttft is None:
+                ttft = time.monotonic() - t0
+            toks.extend(out.token_ids)
+        return ttft, toks
+
+    server, _store = await serve_store(port=0, sweep_interval_s=0.1)
+    port = server.sockets[0].getsockname()[1]
+    kv_a = await KvClient(port=port).connect()
+    kv_b = await KvClient(port=port).connect()
+    srv = None
+    try:
+        # ---- the storm: warm worker serves the hot set, router-side
+        # queries build each prefix's access heat ----
+        hot = [prompt_for(i) for i in range(n_hot)]
+        warm_toks = []
+        for p in hot:
+            _, toks = await run(warm, p)
+            warm_toks.append(toks)
+            for _ in range(3):
+                idx.find_matches(compute_block_hashes(p, ps))
+
+        # warm worker's sealed pool on the transfer plane
+        srv = BlockTransferServer(
+            read_fn=warm.export_pages,
+            read_hashes_fn=warm.export_pages_by_hash,
+        )
+        host, sport = await publish_srv(srv, kv_a, cfg, ps)
+
+        # ---- cold join, prefetch ON: one controller tick warm-starts
+        # the empty worker from the fleet hot set ----
+        cold_on.remote_kv = RemoteKvFetcher(kv_b, "pe", "cold_on")
+        view = FleetKvView(idx)
+        ctrl = KvPrefetchController(
+            view,
+            lambda: {"warm": warm, "cold_on": cold_on},
+            # hot_k generously above the hot-set size so every hot
+            # family's full leaf chain is examined and pushed
+            PrefetchConfig(replication_target=2, hot_k=n_hot * 10,
+                           max_blocks_per_tick=1024),
+        )
+        before = KV_FLEET.snapshot()
+        await ctrl.tick()
+        cold_on._drain_host_ingest()  # land queued pages deterministically
+
+        # ---- timed cold-start TTFT, both arms. The first hot serve on
+        # each arm compiles the onboard/prefill paths and is untimed. ----
+        warm_seed = prompt_for(900)  # compiles prefill+decode, both arms
+        await run(cold_on, warm_seed)
+        await run(cold_off, warm_seed)
+        on_toks, off_toks, on_ttfts, off_ttfts = [], [], [], []
+        for j, p in enumerate(hot):
+            t_on, toks_on = await run(cold_on, p)
+            t_off, toks_off = await run(cold_off, p)
+            on_toks.append(toks_on)
+            off_toks.append(toks_off)
+            if j > 0:  # j == 0 is the compile warmup
+                on_ttfts.append(t_on)
+                off_ttfts.append(t_off)
+
+        # ---- a prefix that turns hot AFTER the warm-start: dedup
+        # admission pulls it from the fleet instead of recomputing ----
+        late = prompt_for(7000)
+        _, late_warm = await run(warm, late)
+        for _ in range(3):
+            idx.find_matches(compute_block_hashes(late, ps))
+        cold_on.apply_fleet_hints(view.digest())  # refreshed holder map
+        _, late_on = await run(cold_on, late)
+        _, late_off = await run(cold_off, late)
+        after = KV_FLEET.snapshot()
+
+        divergence = 0
+        for a, b in zip(on_toks + [late_on],
+                        off_toks + [late_off]):
+            divergence += sum(x != y for x, y in zip(a, b)) + abs(
+                len(a) - len(b))
+        on_p99 = sorted(on_ttfts)[-1]
+        off_p99 = sorted(off_ttfts)[-1]
+        out = {
+            "prefix_economy_on_ttft_p99_ms": round(on_p99 * 1e3, 2),
+            "prefix_economy_off_ttft_p99_ms": round(off_p99 * 1e3, 2),
+            "prefix_economy_prefetched_blocks": int(
+                after["dynamo_kv_fleet_prefetched_blocks_total"]
+                - before["dynamo_kv_fleet_prefetched_blocks_total"]),
+            "prefix_economy_recompute_avoided": int(
+                after["dynamo_kv_fleet_recompute_avoided_blocks_total"]
+                - before["dynamo_kv_fleet_recompute_avoided_blocks_total"]),
+            "prefix_economy_warm_starts": int(
+                after["dynamo_kv_fleet_warm_starts_total"]
+                - before["dynamo_kv_fleet_warm_starts_total"]),
+            "prefix_economy_token_divergence": int(divergence),
+        }
+        if out["prefix_economy_prefetched_blocks"] <= 0:
+            raise RuntimeError("warm-start prefetch landed no blocks")
+        if out["prefix_economy_recompute_avoided"] <= 0:
+            raise RuntimeError("dedup admission avoided no recompute")
+        if divergence:
+            raise RuntimeError(
+                f"token divergence between arms: {divergence}")
+        if on_p99 >= off_p99:
+            raise RuntimeError(
+                "prefetch-on cold-start TTFT p99 not better: "
+                f"{out['prefix_economy_on_ttft_p99_ms']}ms on vs "
+                f"{out['prefix_economy_off_ttft_p99_ms']}ms off")
+        return out
+    finally:
+        if srv is not None:
+            await srv.stop()
+        for e in (warm, cold_on, cold_off):
+            await e.stop()
+        await kv_a.close()
+        await kv_b.close()
+        server.close()
+
+
+async def publish_srv(srv, kv, cfg, ps):
+    """Start a BlockTransferServer + publish its descriptor as 'warm'."""
+    from dynamo_tpu.kv_transfer import (
+        BlocksetDescriptor,
+        KvCacheLayout,
+        publish_descriptor,
+    )
+
+    host, sport = await srv.start()
+    await publish_descriptor(kv, "pe", BlocksetDescriptor(
+        worker_id="warm", host=host, port=sport,
+        layout=KvCacheLayout(
+            num_layers=cfg.num_layers, num_kv_heads=cfg.num_kv_heads,
+            page_size=ps, head_dim=cfg.head_dim, dtype="float32",
+        ),
+    ))
+    return host, sport
+
+
 async def store_outage_experiment(
     n_workers: int = 2,
     n_requests: int = 8,
@@ -1521,6 +1748,18 @@ def main():
         out.update(asyncio.run(integrity_experiment()))
     except Exception as e:  # noqa: BLE001 — best-effort phase
         out["integrity_error"] = str(e)[:200]
+    try:
+        # same retry rationale as disagg: the on/off TTFT ordering is a
+        # wall-clock race on shared CPU; a real regression loses 3/3
+        for attempt in range(3):
+            try:
+                out.update(asyncio.run(prefix_economy_experiment()))
+                break
+            except RuntimeError:
+                if attempt == 2:
+                    raise
+    except Exception as e:  # noqa: BLE001 — best-effort phase
+        out["prefix_economy_error"] = str(e)[:200]
     try:
         out.update(asyncio.run(store_outage_experiment()))
     except Exception as e:  # noqa: BLE001 — best-effort phase

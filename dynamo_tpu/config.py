@@ -68,6 +68,16 @@ class RuntimeConfig:
     # stalled (pinned gather handles/page refs freed after this long
     # without progress)
     kv_transfer_stream_idle_timeout_s: float = 15.0
+    # fleet prefix economy (kv_router/fleet.py + prefetch.py): desired
+    # fleet copies of a hot block (<= 1 disables the replication
+    # controller), top-K hot chains examined/pushed per tick, the
+    # controller tick period, the indexer's access-heat decay half-life
+    # (0 = raw undecayed counters), and the dedup-admission gate
+    kv_replication_target: int = 2
+    kv_prefetch_hot_k: int = 8
+    kv_prefetch_interval_s: float = 2.0
+    kv_freq_halflife_s: float = 600.0
+    kv_dedup_admission: bool = True
     # overload plane (dynamo_tpu/overload/): bounded admission budgets
     # (0 = unbounded) + the running-preemption flag
     max_waiting_requests: int = 0

@@ -219,6 +219,13 @@ class EngineConfig:
     # the differential harness (tests/test_kv_quant).
     kv_quant: str = "none"
 
+    # fleet prefix economy (kv_router/fleet.py): when the frontend's
+    # hint digest is applied, dedup-by-hash admission consults it before
+    # a G4 probe round — fleet-known holders are probed first, and a
+    # demand miss whose blocks the fleet hot set doesn't know at all
+    # skips the probe entirely. False restores hint-blind G4.
+    kv_dedup_admission: bool = True
+
     # identity on the control plane
     worker_id: str = ""
 

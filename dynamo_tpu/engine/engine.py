@@ -61,6 +61,7 @@ from dynamo_tpu.engine.cache import PageAllocator
 from dynamo_tpu.engine.config import EngineConfig, pow2_cover  # noqa: F401
 # (pow2_cover re-exported: engine.engine was its historical home)
 from dynamo_tpu.engine import sampling
+from dynamo_tpu.kv_fleet_metrics import KV_FLEET
 from dynamo_tpu.kv_integrity import KV_INTEGRITY, KvQuarantine
 from dynamo_tpu.kv_quant import KV_QUANT, QuantizedPages, to_pool_dtype
 from dynamo_tpu.kv_router.protocols import (
@@ -515,6 +516,11 @@ class TpuEngine:
         self.remote_kv: Any = None
         self._host_ingest: queue_mod.Queue = queue_mod.Queue()
         self.remote_onboard_blocks = 0
+        # fleet prefix economy: the frontend's replica/holder hint digest
+        # (kv_router/fleet.py FleetHints), applied via apply_fleet_hints;
+        # consulted by dedup admission and tier eviction. None until the
+        # first hint push arrives.
+        self.fleet_hints: Any = None
         self._waiting: list[_Request] = []
         # overload plane (dynamo_tpu/overload/): bounded admission over
         # the not-yet-prefilling backlog. The token counter is updated
@@ -2582,6 +2588,24 @@ class TpuEngine:
         missing = matchable[i:]
         if not missing:
             return
+        # dedup-by-hash admission: consult the fleet hint digest before
+        # probing. Fleet-known holders are probed first; a miss whose
+        # blocks the fleet hot set doesn't know at all skips the probe
+        # round (recomputing a fleet-unique prefix is the right call —
+        # probing every peer for it is pure wasted wire).
+        holder_hint: Optional[list[str]] = None
+        hints = self.fleet_hints
+        if (self.ecfg.kv_dedup_admission and hints is not None
+                and hints.applied):
+            known = [h for b in missing
+                     for h in hints.holders(b.block_hash)]
+            if known:
+                # dedupe, first-seen order (leading blocks first)
+                holder_hint = list(dict.fromkeys(known))
+            elif all(hints.replicas(b.block_hash) is None
+                     for b in missing):
+                KV_FLEET.inc("dynamo_kv_fleet_dedup_skipped_probes_total")
+                return
         t_fetch = time.monotonic()
         chunk_spans: list[dict] = []
         t_prev = t_fetch
@@ -2615,12 +2639,18 @@ class TpuEngine:
             # always None here
             found, _ = await self.remote_kv.fetch(
                 [b.block_hash for b in missing], on_chunk=land,
+                holders=holder_hint,
             )
         except Exception:  # noqa: BLE001 — G4 is best-effort
             log.exception("G4 remote fetch failed")
             return
         if not found:
             return
+        # every fetched block is a prefill block this worker did NOT
+        # recompute — the dedup economy's headline counter
+        KV_FLEET.inc(
+            "dynamo_kv_fleet_recompute_avoided_blocks_total", int(found)
+        )
         # trace the peer-pool fetch (with its chunk children): rides the
         # request's worker-side span list so migration replays / disagg
         # flows show the G4 hop end-to-end in /debug/trace/{request_id}
@@ -2634,6 +2664,8 @@ class TpuEngine:
         r.trace_spans.append(sp)
 
     def _drain_host_ingest(self) -> None:
+        from dynamo_tpu.resilience.chaos import CHAOS
+
         while True:
             try:
                 hashes, parents, data = self._host_ingest.get_nowait()
@@ -2643,6 +2675,75 @@ class TpuEngine:
                 return
             n = self.offload.put_batch(hashes, parents, data)
             self.remote_onboard_blocks += n
+            if n and CHAOS.fire("corrupt_prefetch"):
+                # rot a just-landed page AFTER its crc was sealed at put
+                # (silent DRAM corruption of prefetched content): the
+                # onboard-admission verify must quarantine it before it
+                # can reach the device pool
+                self.offload.rot_page(hashes[0])
+
+    def apply_fleet_hints(self, digest: dict) -> None:
+        """Frontend hint push (kv_router/prefetch.py): retain the fleet
+        replica/holder digest for dedup admission and wire replica counts
+        into G2/G3 eviction. Hint maps are swapped wholesale, so the
+        engine thread racing a push sees the old or the new digest, never
+        a torn one."""
+        from dynamo_tpu.kv_router.fleet import FleetHints
+
+        if self.fleet_hints is None:
+            self.fleet_hints = FleetHints(digest)
+        else:
+            self.fleet_hints.apply(digest)
+        if self.offload is not None:
+            self.offload.fleet_replicas = self.fleet_hints.replicas
+            if getattr(self.offload, "spill", None) is not None:
+                self.offload.spill.fleet_replicas = (
+                    self.fleet_hints.replicas
+                )
+
+    async def prefetch_hashes(
+        self, hashes: list[int], parents: Optional[list[int]] = None
+    ) -> int:
+        """Fleet replication push (kv_router/prefetch.py): pull the given
+        chained-hash run from peer pools into the G2 host tier AHEAD of
+        demand. Blocks already held in G1/G2/G3 are skipped; fetched
+        pages ride the same host-ingest queue as demand G4 fetches.
+        Returns blocks landed."""
+        off = self.offload
+        if self.remote_kv is None or off is None or not hashes:
+            return 0
+        if parents is None:
+            # best-effort chain: within the run each block's parent is
+            # its predecessor; the head's true parent is unknown here
+            parents = [0, *hashes[:-1]]
+        par = dict(zip(hashes, parents))
+        missing = [
+            h for h in hashes
+            if h not in off
+            and (off.spill is None or h not in off.spill)
+            and self.allocator.page_for_hash(h) is None
+        ]
+        if not missing:
+            return 0
+
+        def land(offset: int, arr: np.ndarray) -> None:
+            n = int(arr.shape[3])
+            sub = missing[offset:offset + n]
+            payload = to_pool_dtype(arr, self.kv_quant, off.dtype)
+            if not isinstance(payload, QuantizedPages):
+                payload = np.asarray(payload, dtype=off.dtype)
+            self._host_ingest.put((sub, [par[h] for h in sub], payload))
+            self._wake_evt.set()
+
+        try:
+            found, _ = await self.remote_kv.fetch(missing, on_chunk=land)
+        except Exception:  # noqa: BLE001 — prefetch is best-effort
+            log.exception("fleet prefetch fetch failed")
+            return 0
+        found = int(found or 0)
+        if found:
+            KV_FLEET.inc("dynamo_kv_fleet_prefetched_blocks_total", found)
+        return found
 
     # ---- admission / prefill ----
 

@@ -40,15 +40,17 @@ This module owns only the host pools + hash registries; the device side
 """
 from __future__ import annotations
 
+import itertools
 import json
 import logging
 import os
 import tempfile
 from collections import OrderedDict
-from typing import Optional
+from typing import Callable, Optional
 
 import numpy as np
 
+from dynamo_tpu.kv_fleet_metrics import KV_FLEET
 from dynamo_tpu.kv_integrity import (
     KV_INTEGRITY,
     KvQuarantine,
@@ -60,6 +62,11 @@ log = logging.getLogger(__name__)
 # journal compaction threshold: rewrite the manifest once the journal
 # carries this many times more lines than live entries could need
 _JOURNAL_SLACK = 4
+
+# replication-aware eviction scans this many LRU-oldest entries for a
+# well-replicated victim before falling back to the plain LRU head —
+# bounded so eviction stays O(1)-ish under pressure
+_EVICT_SCAN = 8
 
 
 def _chaos():
@@ -100,6 +107,10 @@ class _PageTier:
         # shared deny-list: hashes that failed verification are refused
         # (puts no-op, lookups miss) until their quarantine TTL lapses
         self.quarantine = quarantine
+        # fleet prefix economy: when wired (engine.apply_fleet_hints),
+        # maps hash -> known fleet replica count (None = unknown) and
+        # eviction prefers well-replicated blocks over the last copy
+        self.fleet_replicas: Optional[Callable[[int], Optional[int]]] = None
         # counters
         self.pages_offloaded = 0
         self.onboard_hits = 0
@@ -137,9 +148,44 @@ class _PageTier:
     def _on_drop(self, h: int) -> None:
         pass
 
+    def _pick_victim(self) -> int:
+        """Choose the hash to evict. Plain LRU head unless the fleet
+        replica hook is wired: then scan the ``_EVICT_SCAN`` oldest
+        entries and evict the best-replicated one (>= 2 known fleet
+        copies, oldest wins ties), so the fleet's LAST copy of a warm
+        block outlives the eighth copy of the same system prompt."""
+        head = next(iter(self._index))
+        fn = self.fleet_replicas
+        if fn is None:
+            return head
+        best_h = None
+        best_r = 1
+        for h in itertools.islice(self._index, _EVICT_SCAN):
+            try:
+                r = fn(h)
+            except Exception:  # noqa: BLE001 — stale hints must not block eviction
+                log.debug("fleet replica lookup failed for %#x", h,
+                          exc_info=True)
+                r = None
+            if r is not None and r > best_r:
+                best_h, best_r = h, r
+        if best_h is not None:
+            KV_FLEET.inc("dynamo_kv_fleet_replicated_evictions_total")
+            return best_h
+        try:
+            head_r = fn(head)
+        except Exception:  # noqa: BLE001 — stale hints must not block eviction
+            log.debug("fleet replica lookup failed for %#x", head,
+                      exc_info=True)
+            head_r = None
+        if head_r is not None and head_r <= 1:
+            KV_FLEET.inc("dynamo_kv_fleet_last_copy_evictions_total")
+        return head
+
     def _evict_one(self) -> None:
-        """Drop the LRU entry to free a slot (hook point for spill)."""
-        old_h, (old_slot, _, _) = self._index.popitem(last=False)
+        """Drop one entry to free a slot (hook point for spill)."""
+        old_h = self._pick_victim()
+        old_slot, _, _ = self._index.pop(old_h)
         self._free.append(old_slot)
         self._on_drop(old_h)
 
@@ -266,6 +312,23 @@ class _PageTier:
         if not self.scale_shape:
             return None
         return self._ensure_scales()[..., self._index[block_hash][0]]
+
+    def rot_page(self, block_hash: int) -> bool:
+        """Flip one byte of the POOL-RESIDENT copy of a page WITHOUT
+        touching its sealed crc — models silent post-seal rot (DRAM
+        flip, torn disk write). The next gather+verify_pages over the
+        block fails closed: this is what the ``corrupt_prefetch`` chaos
+        point fires on fleet-prefetched pages."""
+        ent = self._index.get(block_hash)
+        if ent is None:
+            return False
+        pool = self._ensure_pool()
+        view = pool[:, :, :, ent[0]]
+        idx = (0,) * view.ndim
+        raw = bytearray(np.asarray(view[idx]).tobytes())
+        raw[0] ^= 0x01
+        view[idx] = np.frombuffer(bytes(raw), dtype=self.dtype)[0]
+        return True
 
     def drop(self, block_hash: int) -> None:
         ent = self._index.pop(block_hash, None)
@@ -603,9 +666,8 @@ class HostOffloadTier(_PageTier):
         return self._pool
 
     def _evict_one(self) -> None:
-        old_h, (old_slot, old_parent, old_crc) = self._index.popitem(
-            last=False
-        )
+        old_h = self._pick_victim()
+        old_slot, old_parent, old_crc = self._index.pop(old_h)
         if self.spill is not None:
             # the crc travels with the block down the spill: G3 inherits
             # G2's seal-time checksum instead of re-minting over bytes

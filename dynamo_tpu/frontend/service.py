@@ -215,6 +215,11 @@ class HttpService:
         self.manager = manager if manager is not None else ModelManager()
         self.host = host
         self.port = port
+        # fleet prefix economy: per-model FleetKvView registry served at
+        # /debug/kv_fleet (tools/kv_fleet.py reads it). The launch path
+        # points this at the ModelWatcher's live dict so discovered
+        # kv-routed models appear without re-wiring.
+        self.fleet_views: dict[str, Any] = {}
         self.metrics = ServiceMetrics()
         # request-latency histograms (TTFT / ITL / E2E), observed at the
         # frontend's measurement points and appended to /metrics
@@ -237,6 +242,7 @@ class HttpService:
                 web.get("/debug/trace", self.handle_trace_index),
                 web.get("/debug/trace/{request_id}", self.handle_trace),
                 web.get("/debug/flight", self.handle_flight),
+                web.get("/debug/kv_fleet", self.handle_kv_fleet),
             ]
         )
         self._runner: Optional[web.AppRunner] = None
@@ -273,6 +279,7 @@ class HttpService:
         return web.json_response(model_list_response(self.manager.list_models()))
 
     async def handle_metrics(self, request: web.Request) -> web.Response:
+        from dynamo_tpu.kv_fleet_metrics import KV_FLEET
         from dynamo_tpu.kv_integrity import KV_INTEGRITY
         from dynamo_tpu.kv_quant import KV_QUANT
         from dynamo_tpu.kv_transfer_metrics import KV_TRANSFER
@@ -297,10 +304,35 @@ class HttpService:
                 + OVERLOAD.render().encode()
                 + PROF.render().encode()
                 + STORE.render().encode()
-                + PLANNER.render().encode())
+                + PLANNER.render().encode()
+                + KV_FLEET.render().encode())
         return web.Response(
             body=body, content_type=CONTENT_TYPE_LATEST.split(";")[0]
         )
+
+    async def handle_kv_fleet(self, request: web.Request) -> web.Response:
+        """GET /debug/kv_fleet[?model=NAME][&top=K] — the live fleet
+        prefix economy per kv-routed model: replica map and top-K hot
+        prefixes (kv_router/fleet.py FleetKvView.to_dict)."""
+        try:
+            top = int(request.query.get("top", 32))
+        except ValueError:
+            top = 32
+        want = request.query.get("model")
+        views = self.fleet_views
+        if want is not None:
+            if want not in views:
+                return web.json_response(
+                    {"error": f"no fleet view for model {want!r}"},
+                    status=404,
+                )
+            views = {want: views[want]}
+        return web.json_response({
+            "models": {
+                name: view.to_dict(top=top)
+                for name, view in sorted(views.items())
+            },
+        })
 
     # ------------------------------------------------------------------
     # debug plane: span trees + flight recorders of in-process engines

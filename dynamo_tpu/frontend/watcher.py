@@ -160,6 +160,39 @@ async def register_llm(
             served.kv_resync_task = asyncio.get_running_loop().create_task(
                 resync_loop()
             )
+        if hasattr(engine, "apply_fleet_hints"):
+            # fleet prefix economy: receive the frontend controller's
+            # hint digests and prefetch pushes (kv_router/prefetch.py
+            # publishes on kv_fleet.{worker_id} when the worker isn't
+            # in-process). Follows the lease id like the event topics.
+            from dynamo_tpu.kv_router.prefetch import KV_FLEET_TOPIC
+
+            async def fleet_loop():
+                wid = str(served.lease_id)
+                sub = await rt.kv.subscribe(f"{KV_FLEET_TOPIC}.{wid}")
+                async for ev in sub:
+                    try:
+                        msg = json.loads(ev["value"])
+                    except (KeyError, ValueError, TypeError):
+                        continue
+                    try:
+                        if msg.get("hints") is not None:
+                            engine.apply_fleet_hints(msg["hints"])
+                        pf = msg.get("prefetch")
+                        if pf and hasattr(engine, "prefetch_hashes"):
+                            await engine.prefetch_hashes(
+                                [int(h) for h in pf.get("hashes", [])],
+                                parents=[
+                                    int(p) for p in pf.get("parents", [])
+                                ] or None,
+                            )
+                    except Exception:  # noqa: BLE001 — one bad payload
+                        # must not end fleet-hint delivery
+                        log.exception("fleet payload failed for %s", wid)
+
+            served.kv_fleet_task = asyncio.get_running_loop().create_task(
+                fleet_loop()
+            )
     # load-metrics plane (planner + standalone exporter consume this)
     if hasattr(engine, "on_metrics"):
         from dynamo_tpu.runtime.publisher import METRICS_TOPIC, \
@@ -191,6 +224,8 @@ class ModelWatcher:
         health: Optional[Any] = None,       # WorkerHealthTracker override
         heartbeat_ttl_s: Optional[float] = None,
         engine_factory: Optional[Any] = None,  # (client, Instance) -> engine
+        prefetch_config: Optional[Any] = None,  # PrefetchConfig: fleet
+        # replication controller per kv-routed model (None = reactive only)
     ):
         from dynamo_tpu.resilience.health import WorkerHealthTracker
 
@@ -199,6 +234,7 @@ class ModelWatcher:
         self.namespace = namespace
         self.router_config = router_config
         self.kv_recorder = kv_recorder
+        self.prefetch_config = prefetch_config
         # fleet simulator hook: routes to in-process engines (keyed by the
         # instance discovered from the store) instead of spawning a
         # RemoteWorkerEngine TCP client per worker. None = production path.
@@ -228,6 +264,11 @@ class ModelWatcher:
         self._kv_sub_task: Optional[asyncio.Task] = None
         self._metrics_sub_task: Optional[asyncio.Task] = None
         self._routers: dict[str, KvPushRouter] = {}
+        # fleet prefix economy: per-kv-model read view over the router's
+        # indexer (serves /debug/kv_fleet) + the replication controller
+        # pushing hints/prefetches into workers (when configured)
+        self.fleet_views: dict[str, Any] = {}
+        self._prefetchers: dict[str, Any] = {}
         # KV events that raced worker discovery, replayed on sync
         self._unclaimed_events: deque = deque(maxlen=4096)
         # downloaded card artifacts, cached per card_ref: worker churn must
@@ -280,6 +321,9 @@ class ModelWatcher:
         if self._breaker_board is not None:
             await self._breaker_board.stop()
             self._breaker_board = None
+        for ctrl in list(self._prefetchers.values()):
+            await ctrl.stop()
+        self._prefetchers.clear()
         for t in (self._task, self._kv_sub_task, self._metrics_sub_task):
             if t is not None:
                 t.cancel()
@@ -399,6 +443,27 @@ class ModelWatcher:
             push = KvPushRouter(router, health=self.health,
                                 load=self.load)
             self._routers[name] = push
+            from dynamo_tpu.kv_router.fleet import FleetKvView
+
+            view = FleetKvView(router.indexer)
+            self.fleet_views[name] = view
+            if self.prefetch_config is not None:
+                from dynamo_tpu.kv_router.prefetch import (
+                    KV_FLEET_TOPIC,
+                    KvPrefetchController,
+                )
+
+                async def _publish(wid: str, msg: dict) -> None:
+                    await self.rt.kv.publish(
+                        f"{KV_FLEET_TOPIC}.{wid}", json.dumps(msg)
+                    )
+
+                ctrl = KvPrefetchController(
+                    view, lambda push=push: push.workers,
+                    self.prefetch_config, publish=_publish,
+                )
+                self._prefetchers[name] = ctrl
+                ctrl.start()
 
             def sync_workers(instances: list[Instance], push=push,
                              client=client, name=name):
@@ -493,6 +558,10 @@ class ModelWatcher:
         log.info("model %s removed (last instance gone)", name)
         chain_client = self._chains.pop(name, None)
         self._routers.pop(name, None)
+        self.fleet_views.pop(name, None)
+        ctrl = self._prefetchers.pop(name, None)
+        if ctrl is not None:
+            await ctrl.stop()
         self.manager.unregister(name)
         if chain_client is not None:
             await chain_client[1].stop()

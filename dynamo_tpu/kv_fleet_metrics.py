@@ -1,0 +1,40 @@
+"""Fleet prefix-economy metrics: one process-wide registry, three scrape
+surfaces.
+
+The fleet-wide content-addressed KV planes — dedup-by-hash admission
+(engine consults fleet hints before recomputing a prefix miss), the
+router-driven replication/prefetch controller (kv_router/prefetch.py) and
+replication-aware tier eviction (engine/offload.py) — all count here. The
+frontend ``/metrics``, the per-worker system server and the aggregating
+exporter each append ``render()``'s Prometheus text (same pattern as
+kv_transfer_metrics.py), so the series exist on every surface, and every
+family is documented in README's Observability section — the
+metrics-contract lint (DTL005) enforces both.
+"""
+from __future__ import annotations
+
+from dynamo_tpu.telemetry.metrics import CounterRegistry
+
+# (name, type, help) — the fixed counter family set.
+FAMILIES: tuple[tuple[str, str, str], ...] = (
+    ("dynamo_kv_fleet_recompute_avoided_blocks_total", "counter",
+     "prefix blocks pulled from a peer by hash instead of recomputed"),
+    ("dynamo_kv_fleet_dedup_skipped_probes_total", "counter",
+     "G4 fetch rounds skipped because fleet hints showed no peer holder"),
+    ("dynamo_kv_fleet_prefetched_blocks_total", "counter",
+     "blocks pushed into a worker host tier by the replication controller"),
+    ("dynamo_kv_fleet_prefetch_rounds_total", "counter",
+     "replication-controller passes that examined the fleet hot set"),
+    ("dynamo_kv_fleet_warm_starts_total", "counter",
+     "cold workers warm-started from the fleet top-K hot prefixes"),
+    ("dynamo_kv_fleet_hint_pushes_total", "counter",
+     "fleet replica/holder hint digests delivered to workers"),
+    ("dynamo_kv_fleet_replicated_evictions_total", "counter",
+     "tier evictions that chose a fleet-replicated block over unique ones"),
+    ("dynamo_kv_fleet_last_copy_evictions_total", "counter",
+     "tier evictions forced to drop the last known fleet copy of a block"),
+)
+
+# process-wide registry: the frontend controller and the worker-side
+# admission/eviction hooks in one process share it
+KV_FLEET = CounterRegistry(FAMILIES, label="kv-fleet")

@@ -10,16 +10,29 @@ tree: following a request's chained-hash list in order IS the root-to-leaf
 path, and a worker holding chain hash h_i necessarily stored it with the
 full prefix chain. Same scoring semantics, O(1) per level, no tree
 maintenance.
+
+Access heat is an EWMA, not a raw counter: each touch adds 1 and the value
+halves every ``freq_halflife_s`` seconds, so the hot-set ranking the fleet
+economy (kv_router/fleet.py, kv_router/prefetch.py) builds on tracks the
+CURRENT workload instead of all history, and cold entries decay to where
+the periodic prune drops them instead of accumulating forever.
 """
 from __future__ import annotations
 
 import time
 from dataclasses import dataclass, field
-from typing import Optional
+from typing import Callable, Optional
 
 from dynamo_tpu.kv_router.protocols import KvCacheEvent, KvEventKind
 
 WorkerId = str
+
+# decayed heat below this is indistinguishable from never-touched; the
+# periodic prune drops such entries so _freq stays bounded by the live
+# hot set rather than every hash ever queried
+_HEAT_EPSILON = 1.0 / 64.0
+# apply_event calls between opportunistic heat prunes
+_PRUNE_EVERY = 1024
 
 
 @dataclass
@@ -41,15 +54,34 @@ class KvIndexer:
     Single-threaded by design (the reference runs it on one tokio worker and
     talks to it via channels; in asyncio everything already serializes on
     the event loop).
+
+    ``freq_halflife_s`` sets the access-heat decay half-life (None = no
+    decay, raw counters). ``clock`` is injectable for tests; it must be
+    monotonic-seconds compatible.
     """
 
-    def __init__(self, block_size: int, expiration_s: Optional[float] = None):
+    def __init__(
+        self,
+        block_size: int,
+        expiration_s: Optional[float] = None,
+        *,
+        freq_halflife_s: Optional[float] = None,
+        clock: Callable[[], float] = time.monotonic,
+    ):
         self.block_size = block_size
         self.expiration_s = expiration_s
+        self.freq_halflife_s = freq_halflife_s
+        self._clock = clock
         self._workers: dict[int, set[WorkerId]] = {}       # hash -> workers
         self._by_worker: dict[WorkerId, set[int]] = {}     # worker -> hashes
         self._inserted: dict[int, float] = {}              # hash -> store time
-        self._freq: dict[int, int] = {}                    # hash -> access count
+        # hash -> (EWMA heat at last touch, last-touch time)
+        self._freq: dict[int, tuple[float, float]] = {}
+        # hash -> parent chain hash, learned from STORED events that carry
+        # parent_hash — lets the fleet view reconstruct a hot block's whole
+        # prefix chain for prefetch. Best-effort: batched snapshot events
+        # (cache.py snapshot_stored_events) omit parents and leave gaps.
+        self._parent: dict[int, int] = {}
         self.events_applied = 0
 
     # ---- event plane ----
@@ -59,16 +91,30 @@ class KvIndexer:
         w = event.worker_id
         self.events_applied += 1
         if event.kind == KvEventKind.STORED:
-            now = time.monotonic()
+            now = self._clock()
+            parent = event.parent_hash
             for blk in event.blocks:
-                self._workers.setdefault(blk.block_hash, set()).add(w)
-                self._by_worker.setdefault(w, set()).add(blk.block_hash)
-                self._inserted[blk.block_hash] = now  # (re)store refreshes TTL
+                h = blk.block_hash
+                if self.expiration_s is not None:
+                    # a store that lands after the previous copy's TTL
+                    # lapsed (but before a query swept it) is a NEW life
+                    # for the hash: stale heat must not carry over
+                    t = self._inserted.get(h)
+                    if t is not None and now - t > self.expiration_s:
+                        self._freq.pop(h, None)
+                self._workers.setdefault(h, set()).add(w)
+                self._by_worker.setdefault(w, set()).add(h)
+                self._inserted[h] = now  # (re)store refreshes TTL
+                if parent is not None:
+                    self._parent[h] = parent
+                parent = h
         elif event.kind == KvEventKind.REMOVED:
             for h in event.removed_hashes:
                 self._remove(w, h)
         elif event.kind == KvEventKind.CLEARED:
             self.remove_worker(w)
+        if self.events_applied % _PRUNE_EVERY == 0:
+            self._prune_heat()
 
     def total_blocks(self) -> int:
         """Distinct block hashes currently indexed (observability)."""
@@ -82,21 +128,83 @@ class KvIndexer:
             if ws is not None:
                 ws.discard(worker_id)
                 if not ws:
-                    del self._workers[h]
-                    self._inserted.pop(h, None)
-                    self._freq.pop(h, None)
+                    self._forget(h)
 
     def _remove(self, worker_id: WorkerId, h: int) -> None:
         ws = self._workers.get(h)
         if ws is not None:
             ws.discard(worker_id)
             if not ws:
-                del self._workers[h]
-                self._inserted.pop(h, None)
-                self._freq.pop(h, None)
+                self._forget(h)
         hs = self._by_worker.get(worker_id)
         if hs is not None:
             hs.discard(h)
+
+    def _forget(self, h: int) -> None:
+        """Last holder gone — drop every per-hash record."""
+        del self._workers[h]
+        self._inserted.pop(h, None)
+        self._freq.pop(h, None)
+        self._parent.pop(h, None)
+
+    # ---- heat (EWMA-decayed access frequency) ----
+
+    def _decayed(self, h: int, now: float) -> float:
+        e = self._freq.get(h)
+        if e is None:
+            return 0.0
+        v, last = e
+        hl = self.freq_halflife_s
+        if hl is not None and hl > 0 and now > last:
+            v *= 2.0 ** (-(now - last) / hl)
+        return v
+
+    def _touch(self, h: int, now: float) -> float:
+        """Decay-then-increment; returns the PRE-touch heat (matching the
+        old read-before-increment counter semantics)."""
+        v = self._decayed(h, now)
+        self._freq[h] = (v + 1.0, now)
+        return v
+
+    def _prune_heat(self) -> None:
+        if self.freq_halflife_s is None:
+            return
+        now = self._clock()
+        dead = [h for h in self._freq if self._decayed(h, now) < _HEAT_EPSILON]
+        for h in dead:
+            self._freq.pop(h, None)
+
+    def heat(self, h: int) -> float:
+        """Current decayed access heat of a block (read-only: no touch)."""
+        return self._decayed(h, self._clock())
+
+    def replicas(self, h: int) -> int:
+        """How many workers hold this block right now (never negative:
+        holder sets are discard-based and dropped when empty)."""
+        return len(self._workers.get(h, ()))
+
+    def holders(self, h: int) -> set[WorkerId]:
+        return set(self._workers.get(h, ()))
+
+    def parent_of(self, h: int) -> Optional[int]:
+        return self._parent.get(h)
+
+    def worker_block_count(self, worker_id: WorkerId) -> int:
+        """Blocks this worker currently holds in the fleet view (the
+        prefetch controller's cold-worker / least-loaded signal)."""
+        return len(self._by_worker.get(worker_id, ()))
+
+    def hot_blocks(self, k: int) -> list[tuple[int, float]]:
+        """Top-k currently-held blocks by decayed heat, hottest first."""
+        now = self._clock()
+        scored = [
+            (h, self._decayed(h, now))
+            for h in self._freq
+            if h in self._workers
+        ]
+        scored = [(h, v) for h, v in scored if v >= _HEAT_EPSILON]
+        scored.sort(key=lambda hv: (-hv[1], hv[0]))
+        return scored[:k]
 
     # ---- query plane ----
 
@@ -106,7 +214,7 @@ class KvIndexer:
         """Walk the chained hashes; stop at the first block no worker holds
         (indexer.rs:239). `early_exit` stops at the first score found."""
         scores = OverlapScores()
-        now = time.monotonic()
+        now = self._clock()
         for h in block_hashes:
             ws = self._workers.get(h)
             if not ws:
@@ -119,10 +227,9 @@ class KvIndexer:
                     for w in list(ws):
                         self._remove(w, h)
                     break
-            freq = self._freq.get(h, 0)
-            self._freq[h] = freq + 1
-            if freq:
-                scores.frequencies.append(freq)
+            freq = self._touch(h, now)
+            if freq >= 1.0:
+                scores.frequencies.append(int(freq))
             scores.update(ws)
             if early_exit and scores.scores:
                 break

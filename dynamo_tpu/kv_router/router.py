@@ -66,7 +66,12 @@ class KvRouter:
         on_hit_rate: Optional[Callable[[KVHitRateEvent], None]] = None,
     ):
         self.block_size = block_size
-        self.indexer = KvIndexer(block_size)
+        self.indexer = KvIndexer(
+            block_size,
+            freq_halflife_s=(
+                config.freq_halflife_s if config is not None else None
+            ),
+        )
         self.sequences = ActiveSequencesMultiWorker(block_size, [])
         self.scheduler = KvScheduler(
             block_size,

@@ -22,6 +22,10 @@ class KvRouterConfig:
 
     overlap_score_weight: float = 1.0
     router_temperature: float = 0.5
+    # access-heat EWMA decay half-life for the indexer's per-block
+    # frequency counters (None = raw counters, no decay) — the hot-set
+    # ranking the fleet prefix economy builds on
+    freq_halflife_s: Optional[float] = 600.0
 
 
 @dataclass

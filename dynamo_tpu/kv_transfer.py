@@ -885,6 +885,7 @@ class RemoteKvFetcher:
     async def fetch(
         self, hashes: list[int],
         on_chunk: Optional[Callable[[int, np.ndarray], None]] = None,
+        holders: Optional[list[str]] = None,
     ) -> tuple[int, Optional[np.ndarray]]:
         """Probe every peer CONCURRENTLY; the longest returned prefix
         wins. (0, None) if no peer holds anything. timeout_s bounds the
@@ -892,11 +893,30 @@ class RemoteKvFetcher:
         request-submit path, so dead peers must cost one timeout total,
         never one timeout each. With ``on_chunk`` the winning run is
         delivered incrementally as (page_offset, array) and the returned
-        data is None."""
+        data is None. ``holders`` is the fleet view's hint of which
+        worker ids hold the run: hinted peers are consulted alone first
+        and the rest of the fleet is only probed when the hint turns out
+        stale — dedup admission stops paying a fleet-wide probe round
+        for content whose holders are already known."""
         self.fetches += 1
         peers = await self._peers()
         if not peers:
             return 0, None
+        if holders:
+            hinted_ids = set(holders)
+            hinted = [d for d in peers if d.worker_id in hinted_ids]
+            rest = [d for d in peers if d.worker_id not in hinted_ids]
+            if hinted:
+                got = await self._fetch_from(hinted, hashes, on_chunk)
+                if got[0] or not rest:
+                    return got
+                peers = rest  # stale hint: fall back to un-hinted peers
+        return await self._fetch_from(peers, hashes, on_chunk)
+
+    async def _fetch_from(
+        self, peers: list[BlocksetDescriptor], hashes: list[int],
+        on_chunk: Optional[Callable[[int, np.ndarray], None]] = None,
+    ) -> tuple[int, Optional[np.ndarray]]:
         if self.chunk_pages > 0 and on_chunk is not None:
             got = await self._fetch_chunked(peers, hashes, on_chunk)
             if got is not None:

@@ -247,6 +247,29 @@ def build_parser() -> argparse.ArgumentParser:
                         "peers and fall through the local tiers to peer "
                         "pools on prefix misses (requires --control-plane "
                         "and a G2 tier via --host-offload-pages)")
+    # fleet prefix economy (kv_router/fleet.py + prefetch.py)
+    p.add_argument("--kv-replication-target", type=int,
+                   default=cfg.kv_replication_target,
+                   help="desired fleet copies of a hot KV block: the "
+                        "frontend's replication controller pushes "
+                        "under-replicated hot prefix chains into workers' "
+                        "G2 tiers ahead of demand and warm-starts cold "
+                        "joiners from the fleet hot set (<= 1 disables "
+                        "the controller)")
+    p.add_argument("--kv-prefetch-hot-k", type=int,
+                   default=cfg.kv_prefetch_hot_k,
+                   help="hot prefix chains examined per controller tick "
+                        "and pushed to a cold joiner")
+    p.add_argument("--kv-prefetch-interval", type=float,
+                   default=cfg.kv_prefetch_interval_s, metavar="SECONDS",
+                   help="replication-controller tick period")
+    p.add_argument("--kv-freq-halflife", type=float,
+                   default=cfg.kv_freq_halflife_s, metavar="SECONDS",
+                   help="KV indexer access-heat decay half-life (0 = raw "
+                        "undecayed counters, the legacy behavior)")
+    p.add_argument("--no-kv-dedup-admission", action="store_true",
+                   help="disable dedup-by-hash admission hints: G4 "
+                        "probes ignore the fleet holder digest")
     p.add_argument("--prefill-timeout", type=float, default=60.0,
                    help="decode-side wait for remote prefill before local "
                         "fallback")
@@ -550,6 +573,9 @@ def build_chain(args) -> "Any":
             slo_ttft_target_s=args.slo_ttft_target,
             slo_itl_target_s=args.slo_itl_target,
             slo_objective=args.slo_objective,
+            kv_dedup_admission=not getattr(
+                args, "no_kv_dedup_admission", False
+            ),
         )
         draft_cfg = None
         if args.speculative == "draft":
@@ -967,6 +993,8 @@ async def _serve_http_dynamic(args) -> None:
     local chain (reference EngineConfig::Dynamic, input/common.rs:55-90)."""
     from dynamo_tpu.frontend import HttpService, ModelManager
     from dynamo_tpu.frontend.watcher import ModelWatcher
+    from dynamo_tpu.kv_router.prefetch import PrefetchConfig
+    from dynamo_tpu.kv_router.scheduler import KvRouterConfig
     from dynamo_tpu.runtime.component import DistributedRuntime
 
     host, port = _cp_addr(args)
@@ -979,12 +1007,26 @@ async def _serve_http_dynamic(args) -> None:
         from dynamo_tpu.recorder import KvRecorder
 
         kv_recorder = KvRecorder(args.record_kv_events)
+    router_config = KvRouterConfig(
+        freq_halflife_s=(args.kv_freq_halflife or None),
+    )
+    # replication target <= 1 means "one copy is enough": no controller
+    prefetch_config = None
+    if args.kv_replication_target > 1:
+        prefetch_config = PrefetchConfig(
+            replication_target=args.kv_replication_target,
+            hot_k=args.kv_prefetch_hot_k,
+            interval_s=args.kv_prefetch_interval,
+        )
     watcher = await ModelWatcher(
         rt, manager, namespace=args.namespace, kv_recorder=kv_recorder,
         heartbeat_ttl_s=args.health_heartbeat_ttl,
+        router_config=router_config, prefetch_config=prefetch_config,
     ).start()
     svc = HttpService(manager, host=args.http_host, port=args.http_port,
                       trace_sample_rate=args.trace_sample_rate)
+    # /debug/kv_fleet serves the watcher's live per-model fleet views
+    svc.fleet_views = watcher.fleet_views
     await svc.start()
     print(
         f"dynamic frontend on http://{args.http_host}:{args.http_port} "

@@ -172,6 +172,7 @@ class MetricsExporter:
               "workers in the last load-plane snapshot", len(snap.metrics))
         # resilience + KV-transfer + overload planes: process-local
         # counters, same families on every scrape surface
+        from dynamo_tpu.kv_fleet_metrics import KV_FLEET
         from dynamo_tpu.kv_integrity import KV_INTEGRITY
         from dynamo_tpu.kv_quant import KV_QUANT
         from dynamo_tpu.kv_transfer_metrics import KV_TRANSFER
@@ -184,7 +185,8 @@ class MetricsExporter:
         return ("\n".join(lines) + "\n" + RESILIENCE.render()
                 + KV_TRANSFER.render() + KV_QUANT.render()
                 + KV_INTEGRITY.render() + OVERLOAD.render()
-                + PROF.render() + STORE.render() + PLANNER.render())
+                + PROF.render() + STORE.render() + PLANNER.render()
+                + KV_FLEET.render())
 
     async def handle_metrics(self, request: web.Request) -> web.Response:
         return web.Response(

@@ -36,6 +36,11 @@ is the only way back to correct tokens):
   truncate_g3    zero the tail half of the G3 pool before a gather —
                  lost/torn disk writes (a live ftruncate would SIGBUS
                  through the active mmap)
+  corrupt_prefetch  rot one byte of a fleet-PREFETCHED page after it
+                 lands in the host tier (post-crc-seal, in the pool:
+                 _PageTier.rot_page) — proves a bad prefetched block is
+                 quarantined at onboard verify instead of serving
+                 divergent tokens
 
 Control-plane points (runtime/store.py serving loop — the store process
 itself as the fault domain):
@@ -67,7 +72,7 @@ log = logging.getLogger(__name__)
 
 POINT_NAMES = ("kill_worker", "stall_stream", "drop_response", "delay",
                "storm", "flip_kv_bits", "corrupt_frame", "truncate_g3",
-               "kill_store", "partition_store")
+               "corrupt_prefetch", "kill_store", "partition_store")
 
 
 class ChaosInjectedError(ConnectionResetError):

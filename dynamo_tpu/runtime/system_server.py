@@ -65,6 +65,7 @@ class SystemServer:
             web.get("/health", self.handle_health),
             web.get("/live", self.handle_health),
             web.get("/debug/flight", self.handle_flight),
+            web.get("/debug/kv_fleet", self.handle_kv_fleet),
             web.get("/debug/prof", self.handle_prof),
             web.get("/debug/trace", self.handle_trace_index),
             web.get("/debug/trace/{request_id}", self.handle_trace),
@@ -156,6 +157,7 @@ class SystemServer:
                     ))
         # resilience + KV-transfer + overload planes: counters of THIS
         # process
+        from dynamo_tpu.kv_fleet_metrics import KV_FLEET
         from dynamo_tpu.kv_integrity import KV_INTEGRITY
         from dynamo_tpu.kv_quant import KV_QUANT
         from dynamo_tpu.kv_transfer_metrics import KV_TRANSFER
@@ -167,7 +169,8 @@ class SystemServer:
         return ("\n".join(lines) + "\n" + RESILIENCE.render()
                 + KV_TRANSFER.render() + KV_QUANT.render()
                 + KV_INTEGRITY.render() + OVERLOAD.render()
-                + PROF.render() + STORE.render() + PLANNER.render())
+                + PROF.render() + STORE.render() + PLANNER.render()
+                + KV_FLEET.render())
 
     async def handle_metrics(self, request: web.Request) -> web.Response:
         return web.Response(text=self.render(), content_type="text/plain")
@@ -190,6 +193,19 @@ class SystemServer:
             "recorded_total": flight.recorded_total,
             "events": flight.snapshot(),
         })
+
+    async def handle_kv_fleet(self, request: web.Request) -> web.Response:
+        """GET /debug/kv_fleet — this WORKER's view of the fleet prefix
+        economy: the last hint digest the frontend controller applied
+        (the frontend's own /debug/kv_fleet serves the full fleet map)."""
+        hints = getattr(self.engine, "fleet_hints", None)
+        if hints is None:
+            return web.json_response(
+                {"worker_id": self.worker_id, "hints": None}
+            )
+        return web.json_response(
+            {"worker_id": self.worker_id, "hints": hints.to_dict()}
+        )
 
     async def handle_prof(self, request: web.Request) -> web.Response:
         """GET /debug/prof[?top=N] — host-round attribution: per-segment
