@@ -780,6 +780,15 @@ def main():
               "overload_gave_up", "overload_admitted_on",
               "overload_admitted_off", "overload_token_equal",
               "overload_error",
+              # forensics phase (bench_modes.forensics_experiment):
+              # SLO-breach dossier capture under the storm — every
+              # breaching request joins spans+KV path under its id,
+              # capture overhead A/B'd, fleet-merged p99s from the
+              # summed worker histograms
+              "forensics_dossiers", "forensics_breaches",
+              "forensics_join_ok", "forensics_overhead_frac",
+              "forensics_fleet_ttft_p99_ms",
+              "forensics_fleet_queue_p99_ms", "forensics_error",
               # disagg chunk-pipeline phase (bench_modes.
               # disagg_experiment): how much transfer the overlap hides
               "disagg_chunked_ttft_ms", "disagg_mono_ttft_ms",

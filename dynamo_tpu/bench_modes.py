@@ -27,6 +27,14 @@ retriable-end-to-end story), and whether every admitted stream's
 tokens match an unloaded run of the same prompt (exactly-once: no
 duplicate or lost tokens through bounce/retry).
 
+``forensics`` — the tail-latency-forensics experiment (telemetry/
+forensics.py): the overload-style storm with SLO-breach dossier capture
+on — every breaching request must land a dossier joining its merged
+span tree and KV path under its request id — A/B'd against the same
+storm with capture off (overhead fraction), plus fleet-merged TTFT /
+queue-wait p99s from the summed worker histograms
+(telemetry/fleet_feed.py).
+
 ``disagg`` — the chunk-pipelined KV-transfer experiment (DistServe /
 Mooncake overlap claim): real tiny TpuEngines on CPU, remote prefill
 through the durable queue + block-transfer plane, with the data plane
@@ -391,6 +399,141 @@ async def overload_experiment(
         "overload_admitted_on": on["admitted"],
         "overload_admitted_off": off["admitted"],
         "overload_token_equal": on["token_equal"] and off["token_equal"],
+    }
+
+
+async def forensics_experiment(
+    n_workers: int = 2,
+    n_requests: int = 32,
+    prompt_tokens: int = 96,
+    out_tokens: int = 16,
+    block_size: int = 16,
+    ttft_target_s: float = 0.05,
+) -> dict:
+    """Tail-latency forensics under the overload-style storm: every
+    SLO-breaching request must yield a dossier joining its merged span
+    tree and KV path under its request id, the fleet-merged latency
+    feed must see the storm (p99s from summed worker histograms), and
+    the always-on capture path must cost ~nothing — the same storm is
+    A/B'd with forensics on vs off and the wall-time delta reported."""
+    from dynamo_tpu.kv_router.router import KvPushRouter, KvRouter
+    from dynamo_tpu.kv_router.scheduler import KvRouterConfig
+    from dynamo_tpu.mocker import MockerArgs, MockerEngine
+    from dynamo_tpu.protocols.common import (
+        PreprocessedRequest,
+        StopConditions,
+    )
+    from dynamo_tpu.telemetry.fleet_feed import FleetLatencyFeed
+    from dynamo_tpu.telemetry.forensics import (
+        DossierRing,
+        ForensicsCapture,
+    )
+    from dynamo_tpu.telemetry.trace import TRACES
+
+    rng = np.random.RandomState(29)
+    prompts = [rng.randint(1, 10_000, size=prompt_tokens).tolist()
+               for _ in range(n_requests)]
+
+    def make_fleet():
+        router = KvRouter(block_size,
+                          KvRouterConfig(router_temperature=0.0))
+        push = KvPushRouter(router)
+        engines = []
+        for i in range(n_workers):
+            eng = MockerEngine(MockerArgs(
+                num_pages=1024, page_size=block_size, max_decode_slots=2,
+                worker_id=f"w{i}",
+                prefill_time_per_token_s=0.0004,
+                decode_time_per_step_s=0.001,
+            ), on_kv_event=router.indexer.apply_event)
+            engines.append(eng)
+            push.add_worker(f"w{i}", eng)
+        return push, engines
+
+    async def storm(fc, tag: str):
+        """One full storm; returns (wall_s, breached rids, engines)."""
+        push, engines = make_fleet()
+        breached: list[str] = []
+
+        async def one(idx: int) -> None:
+            rid = f"fx-{tag}-{idx}"
+            req = PreprocessedRequest(
+                token_ids=list(prompts[idx]), request_id=rid,
+                stop_conditions=StopConditions(max_tokens=out_tokens,
+                                               ignore_eos=True),
+                annotations=["trace_detail"],
+            )
+            # unsampled shell, exactly like a high-QPS frontend: the
+            # route spans buffer and only a breach promotion keeps them
+            TRACES.start(rid, sampled=False)
+            t0 = time.monotonic()
+            first = None
+            timing: dict = {}
+            async for out in push.generate(req):
+                if first is None and out.token_ids:
+                    first = time.monotonic() - t0
+                ann = out.annotations or {}
+                spans = (ann.get("trace") or {}).get("spans")
+                if spans:
+                    TRACES.merge(rid, spans)
+                if ann.get("timing"):
+                    timing = ann["timing"]
+            e2e = time.monotonic() - t0
+            if fc is not None:
+                reason = fc.on_finish(
+                    rid, ttft_s=first, e2e_s=e2e,
+                    queue_s=timing.get("queue_s"), timing=dict(timing))
+                if reason is not None:
+                    breached.append(rid)
+            tr = TRACES.finish(rid)
+            if fc is not None:
+                fc.on_trace_finished(rid, tr)
+
+        t_start = time.monotonic()
+        wave = max(1, n_requests // 3)
+        tasks = []
+        for w in range(0, n_requests, wave):
+            tasks += [asyncio.ensure_future(one(i))
+                      for i in range(w, min(w + wave, n_requests))]
+            await asyncio.sleep(0.03)
+        await asyncio.gather(*tasks)
+        wall = time.monotonic() - t_start
+        return wall, breached, engines
+
+    ring = DossierRing(capacity=n_requests)
+    fc = ForensicsCapture(ring, ttft_target_s=ttft_target_s,
+                          itl_target_s=10.0)
+    wall_on, breached, engines = await storm(fc, "on")
+    # fleet-merged feed over the storm fleet's shipped histograms
+    feed = FleetLatencyFeed()
+    for eng in engines:
+        feed.observe(eng.metrics())
+    ttft_p99 = feed.percentile("dynamo_fleet_request_ttft_seconds", 0.99)
+    queue_p99 = feed.percentile("dynamo_fleet_request_queue_seconds", 0.99)
+    for eng in engines:
+        await eng.stop()
+    # join check: EVERY breaching request has a dossier whose trace
+    # carries spans (route + worker path) under the breaching id
+    join_ok = bool(breached) and all(
+        (d := ring.get(rid)) is not None
+        and d.trace.get("trace_id") == rid
+        and (d.trace.get("spans") or [])
+        and d.kv_path.get("worker")
+        for rid in breached
+    )
+    wall_off, _, engines_off = await storm(None, "off")
+    for eng in engines_off:
+        await eng.stop()
+    return {
+        "forensics_dossiers": ring.captured_total,
+        "forensics_breaches": len(breached),
+        "forensics_join_ok": join_ok,
+        "forensics_overhead_frac": round(
+            max(0.0, (wall_on - wall_off) / wall_off), 4),
+        "forensics_fleet_ttft_p99_ms": (
+            round(ttft_p99 * 1e3, 2) if ttft_p99 is not None else None),
+        "forensics_fleet_queue_p99_ms": (
+            round(queue_p99 * 1e3, 2) if queue_p99 is not None else None),
     }
 
 
@@ -1484,6 +1627,13 @@ async def _fleet_sim_policy_run(
             stable_intervals=3, metrics_stale_after_s=30.0,
             predictor="ar" if policy == "predictive" else "constant",
             predictive=True, streams_per_replica=streams_per_replica,
+            # the predictive arm ALSO consumes the fleet-merged latency
+            # feed (telemetry/fleet_feed.py): interval-delta TTFT p99
+            # over the SLA bound scales up even when the stream count
+            # alone looks servable — the reactive arm keeps the
+            # stream-count-only view as the differential baseline
+            fleet_ttft_scale_up_s=(
+                sla_ttft_s if policy == "predictive" else 0.0),
         )
         planner_rt = await DistributedRuntime.connect(port=port)
         planner = await Planner(planner_rt.kv, connector, cfg,
@@ -1726,6 +1876,10 @@ def main():
         out.update(asyncio.run(overload_experiment()))
     except Exception as e:  # noqa: BLE001 — best-effort phase
         out["overload_error"] = str(e)[:200]
+    try:
+        out.update(asyncio.run(forensics_experiment()))
+    except Exception as e:  # noqa: BLE001 — best-effort phase
+        out["forensics_error"] = str(e)[:200]
     try:
         # retries before declaring the phase failed: the speedup floor
         # is a real-time measurement on a shared (often single-core)

@@ -414,6 +414,15 @@ def _run_planner(rest: list[str]) -> int:
                    help="per-replica stream capacity the predictive "
                         "forecast divides by (from a profile sweep or "
                         "the engine's decode-slot count)")
+    p.add_argument("--fleet-ttft-scale-up", type=float, default=0.0,
+                   metavar="SECONDS",
+                   help="scale up when the fleet-merged TTFT p99 over "
+                        "the last decide interval exceeds this (catches "
+                        "latency waves stream counts miss; 0 = off)")
+    p.add_argument("--fleet-queue-scale-up", type=float, default=0.0,
+                   metavar="SECONDS",
+                   help="same trigger on the fleet-merged admission "
+                        "queue-wait p99 (0 = off)")
     p.add_argument("--connector", default="local",
                    choices=("local", "kubernetes"),
                    help="scale actuator: spawn local worker subprocesses, "

@@ -94,6 +94,11 @@ class RuntimeConfig:
     slo_ttft_target_s: float = 0.5
     slo_itl_target_s: float = 0.05
     slo_objective: float = 0.99
+    # tail-latency forensics (telemetry/forensics.py): SLO breaches are
+    # ALWAYS captured into the /debug/outliers dossier ring; this adds a
+    # coin-flip sample of healthy requests as a comparison baseline
+    # (0 = breaches only)
+    forensics_sample_rate: float = 0.0
 
     @property
     def store_host_port(self) -> tuple[str, int]:

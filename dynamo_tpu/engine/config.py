@@ -201,6 +201,12 @@ class EngineConfig:
     slo_ttft_target_s: float = 0.5
     slo_itl_target_s: float = 0.05
     slo_objective: float = 0.99
+    # tail-latency forensics (telemetry/forensics.py): fraction of
+    # NON-breaching finishes that still get a dossier captured worker-side
+    # when no in-process frontend owns the request's trace. SLO breaches
+    # are always captured; this adds a healthy-baseline sample for
+    # comparison. 0 disables sampling (breach capture stays on).
+    forensics_sample_rate: float = 0.0
 
     # model memory
     cache_dtype: str = "bfloat16"

@@ -105,6 +105,10 @@ _FIRST_TOKEN_KEY_TAG = 0x46697273  # distinct PRNG stream for first tokens
 # capped (a 10k-token generation must not grow a 10k-entry span list);
 # the total decode-round count still travels in the timing annotation
 _MAX_ROUND_SPANS = 24
+# requests flagged "trace_detail" by the frontend (forensics candidates —
+# every request, since breach status is only known at finish) keep a much
+# deeper round-span ring so a late promotion yields a complete dossier
+_MAX_ROUND_SPANS_DETAIL = 256
 
 
 def _span_dict(name: str, t0_monotonic: float, **attrs) -> dict:
@@ -193,6 +197,10 @@ class _Request:
     spec_counts: Optional[np.ndarray] = None
     spec_proposed: int = 0
     spec_accepted: int = 0
+    # forensics: frontend marks candidates with a "trace_detail"
+    # annotation — lifts the round-span cap so late (finish-time) trace
+    # promotion still sees the full decode path
+    trace_detail: bool = False
 
     @property
     def prompt_len(self) -> int:
@@ -454,6 +462,16 @@ class TpuEngine:
         self.prof = RoundProf(enabled=e.prof_attribution)
         PROF.configure(e.slo_ttft_target_s, e.slo_itl_target_s,
                        e.slo_objective)
+        # tail-latency forensics (telemetry/forensics.py): worker-side
+        # breach capture for remote-worker mode — dossiers assembled
+        # straight from this engine's prof/flight rings into OUTLIERS
+        from dynamo_tpu.telemetry.forensics import ForensicsCapture
+        self._forensics = ForensicsCapture(
+            sample_rate=e.forensics_sample_rate,
+            ttft_target_s=e.slo_ttft_target_s,
+            itl_target_s=e.slo_itl_target_s,
+            engines_fn=lambda: [self],
+        )
 
         B = e.max_decode_slots
         self._B = B
@@ -918,6 +936,7 @@ class TpuEngine:
             out=asyncio.Queue(),
             loop=asyncio.get_running_loop(),
             tokens=list(request.token_ids),
+            trace_detail="trace_detail" in (request.annotations or []),
         )
         if self.remote_kv is not None and self.offload is not None:
             await self._remote_prefetch(r)
@@ -2252,12 +2271,15 @@ class TpuEngine:
         now = time.monotonic()
         if r.t_last_emit is not None:
             gap = (now - r.t_last_emit) / n_tokens
-            self._h_itl.observe(gap, n_tokens)
+            self._h_itl.observe(gap, n_tokens,
+                                exemplar_id=r.req.request_id or None)
             if len(r.itl_gaps) < 4096:
                 r.itl_gaps.append((gap, n_tokens))
         r.t_last_emit = now
         r.decode_rounds += 1
-        if (len(r.trace_spans) + len(r.round_spans) < _MAX_ROUND_SPANS
+        cap = (_MAX_ROUND_SPANS_DETAIL if r.trace_detail
+               else _MAX_ROUND_SPANS)
+        if (len(r.trace_spans) + len(r.round_spans) < cap
                 and entry.t_dispatch):
             # annotate diet: the hot loop records one raw tuple; the
             # span dicts (and spec draft/verify children) are built
@@ -2285,7 +2307,7 @@ class TpuEngine:
         ann = self._spec_annotations(r)
         now = time.monotonic()
         e2e = now - r.enqueue_time
-        self._h_e2e.observe(e2e)
+        self._h_e2e.observe(e2e, exemplar_id=r.req.request_id or None)
         timing: dict[str, Any] = {
             "e2e_s": round(e2e, 6),
             "output_tokens": r.produced,
@@ -2335,6 +2357,15 @@ class TpuEngine:
             rid = r.req.request_id
             if rid and not TRACES.has_active(rid):
                 TRACES.record_remote(rid, r.trace_spans)
+                # worker-side forensics: in remote-worker mode no
+                # in-process frontend sees this finish, so the breach /
+                # sample decision runs here and the dossier is assembled
+                # directly from the engine's own rings
+                self._forensics.worker_finish(
+                    rid, timing=timing,
+                    worker_id=str(self.ecfg.worker_id),
+                    trace_spans=r.trace_spans,
+                )
         return ann
 
     def _spec_annotations(self, r: _Request) -> dict:
@@ -2930,7 +2961,8 @@ class TpuEngine:
         if r.t_prefill_start is not None:
             return
         now = time.monotonic()
-        self._h_queue.observe(now - r.enqueue_time)
+        self._h_queue.observe(now - r.enqueue_time,
+                              exemplar_id=r.req.request_id or None)
         r.trace_spans.append(_span_dict("queue", r.enqueue_time))
         r.t_prefill_start = now
 
@@ -3298,7 +3330,8 @@ class TpuEngine:
         if r.first_token_time is None:
             r.first_token_time = time.monotonic()
             r.t_last_emit = r.first_token_time
-            self._h_ttft.observe(r.first_token_time - r.enqueue_time)
+            self._h_ttft.observe(r.first_token_time - r.enqueue_time,
+                                 exemplar_id=r.req.request_id or None)
         sc = r.req.stop_conditions
         if not sc.ignore_eos and tok in (sc.stop_token_ids or []) and (
             sc.min_tokens is None or r.produced >= sc.min_tokens

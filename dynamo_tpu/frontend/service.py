@@ -56,7 +56,20 @@ from dynamo_tpu.telemetry import (
     request_histograms,
 )
 from dynamo_tpu.telemetry import metrics as tmetrics
+from dynamo_tpu.telemetry.fleet_feed import FLEET_FEED
+from dynamo_tpu.telemetry.forensics import FORENSICS, OUTLIERS, ForensicsCapture
+from dynamo_tpu.telemetry.timeline import to_chrome_trace
 from dynamo_tpu.telemetry.trace import span_now
+
+# OpenMetrics content negotiation: exemplars only ship to scrapers that
+# ask for the OpenMetrics exposition format; plain Prometheus text stays
+# byte-identical for everyone else
+OPENMETRICS_CONTENT_TYPE = (
+    "application/openmetrics-text; version=1.0.0; charset=utf-8")
+
+
+def wants_openmetrics(request: web.Request) -> bool:
+    return "application/openmetrics-text" in request.headers.get("Accept", "")
 
 log = logging.getLogger(__name__)
 
@@ -137,6 +150,7 @@ class _RequestTiming:
         self.t_last: dict[int, float] = {}
         self.tok_counts: dict[int, int] = {}
         self.gaps: list[tuple[float, int]] = []   # (gap_s, n) all streams
+        self.worker_timing: dict[str, Any] = {}   # last timing annotation
         self._finished = False
 
     def on_output(self, i: int, out: LLMEngineOutput) -> None:
@@ -146,15 +160,18 @@ class _RequestTiming:
             n = len(out.token_ids)
             if prev is not None:
                 gap = (now - prev) / n
-                self.svc._h_itl.observe(gap, n)
+                self.svc._h_itl.observe(gap, n, exemplar_id=self.rid)
                 if len(self.gaps) < 4096:  # percentile fidelity cap
                     self.gaps.append((gap, n))
             self.t_last[i] = now
             self.t_first.setdefault(i, now)
             self.tok_counts[i] = self.tok_counts.get(i, 0) + n
-        spans = ((out.annotations or {}).get("trace") or {}).get("spans")
+        ann = out.annotations or {}
+        spans = (ann.get("trace") or {}).get("spans")
         if spans:
             TRACES.merge(self.rid, spans)
+        if ann.get("timing"):
+            self.worker_timing = ann["timing"]
 
     @property
     def ttft(self) -> Optional[float]:
@@ -185,8 +202,21 @@ class _RequestTiming:
         self._finished = True
         if not self.t_first:
             return
-        self.svc._h_ttft.observe(self.ttft)
-        self.svc._h_e2e.observe(time.monotonic() - self.t_start)
+        ttft = self.ttft
+        e2e = time.monotonic() - self.t_start
+        self.svc._h_ttft.observe(ttft, exemplar_id=self.rid)
+        self.svc._h_e2e.observe(e2e, exemplar_id=self.rid)
+        # tail-latency forensics: the no-breach path is a couple of float
+        # compares — this runs BEFORE run()'s finally calls TRACES.finish,
+        # so a breach promotion still adopts the shell's buffered spans
+        self.svc.forensics.on_finish(
+            self.rid,
+            ttft_s=ttft,
+            itl_p95_s=self.itl_percentile(0.95),
+            e2e_s=e2e,
+            queue_s=self.worker_timing.get("queue_s"),
+            timing=dict(self.worker_timing),
+        )
 
 
 class HttpService:
@@ -199,6 +229,7 @@ class HttpService:
         host: str = "0.0.0.0",
         port: int = 8080,
         trace_sample_rate: float = 1.0,
+        forensics_sample_rate: float = 0.0,
     ):
         # fraction of requests minting a FULL trace (--trace-sample-rate):
         # high-QPS deployments trace a sample instead of every request;
@@ -208,6 +239,13 @@ class HttpService:
         import random as _random
 
         self._trace_rng = _random.Random()
+        # SLO-breach dossiers: every finishing request runs the cheap
+        # breach check; breaches (and a --forensics-sample-rate coin
+        # flip) land in the OUTLIERS ring at /debug/outliers
+        self.forensics = ForensicsCapture(
+            sample_rate=forensics_sample_rate,
+            engines_fn=self._local_engines,
+        )
         # `is not None`, NOT truthiness: an EMPTY manager (len 0 -> falsy)
         # must be kept — discovery registers models into it later; replacing
         # it would silently split the watcher and the HTTP handlers onto
@@ -243,6 +281,9 @@ class HttpService:
                 web.get("/debug/trace/{request_id}", self.handle_trace),
                 web.get("/debug/flight", self.handle_flight),
                 web.get("/debug/kv_fleet", self.handle_kv_fleet),
+                web.get("/debug/outliers", self.handle_outliers),
+                web.get("/debug/outliers/{request_id}",
+                        self.handle_outlier),
             ]
         )
         self._runner: Optional[web.AppRunner] = None
@@ -296,7 +337,9 @@ class HttpService:
             PROF.fold_burn_rates(
                 self._h_ttft.snapshot(), self._h_itl.snapshot()
             )
-        body = (self.metrics.render() + self.telemetry.render().encode()
+        om = wants_openmetrics(request)
+        body = (self.metrics.render()
+                + self.telemetry.render(openmetrics=om).encode()
                 + RESILIENCE.render().encode()
                 + KV_TRANSFER.render().encode()
                 + KV_QUANT.render().encode()
@@ -305,7 +348,14 @@ class HttpService:
                 + PROF.render().encode()
                 + STORE.render().encode()
                 + PLANNER.render().encode()
-                + KV_FLEET.render().encode())
+                + KV_FLEET.render().encode()
+                + FLEET_FEED.render(openmetrics=om).encode()
+                + FORENSICS.render().encode())
+        if om:
+            return web.Response(
+                body=body + b"# EOF\n",
+                content_type="application/openmetrics-text",
+            )
         return web.Response(
             body=body, content_type=CONTENT_TYPE_LATEST.split(";")[0]
         )
@@ -344,10 +394,52 @@ class HttpService:
         rid = request.match_info["request_id"]
         tr = TRACES.get(rid)
         if tr is None:
-            return web.json_response(
-                {"error": f"no trace for request {rid!r}"}, status=404
-            )
+            # the body says WHY: evicted vs unsampled vs never seen
+            return web.json_response(TRACES.describe_missing(rid),
+                                     status=404)
         return web.json_response(tr.to_dict())
+
+    def _local_engines(self) -> list:
+        """In-process engines whose prof/flight rings feed dossiers
+        (remote workers assemble their own via the system server)."""
+        engines = []
+        for name in self.manager.list_models():
+            try:
+                engines.append(self.manager.get(name).engine)
+            except Exception as e:  # noqa: BLE001 — forensics never throws
+                log.debug("forensics: skipping engine %s: %s", name, e)
+                continue
+        return engines
+
+    async def handle_outliers(self, request: web.Request) -> web.Response:
+        """GET /debug/outliers — the SLO-breach dossier ring: capture
+        stats + newest-first summaries (full dossiers one level down)."""
+        return web.json_response(OUTLIERS.index())
+
+    async def handle_outlier(self, request: web.Request) -> web.Response:
+        """GET /debug/outliers/{request_id}[?format=perfetto] — one full
+        dossier, either as JSON or as a single-request Perfetto/Chrome
+        timeline merging its spans, host rounds, flight and stream
+        events."""
+        rid = request.match_info["request_id"]
+        d = OUTLIERS.get(rid)
+        if d is None:
+            return web.json_response({
+                "error": f"no dossier for request {rid!r}",
+                "capacity": OUTLIERS.capacity,
+                "captured_total": OUTLIERS.captured_total,
+                "evicted_total": OUTLIERS.evicted_total,
+                "oldest_retained_id": OUTLIERS.oldest_id(),
+            }, status=404)
+        if request.query.get("format") == "perfetto":
+            return web.json_response(to_chrome_trace(
+                spans=list(d.trace.get("spans") or []),
+                round_records=d.rounds,
+                flight_events=d.flight,
+                stream_events=d.stream,
+                label=rid,
+            ))
+        return web.json_response(d.to_dict())
 
     async def handle_flight(self, request: web.Request) -> web.Response:
         """Flight rings of every local engine (keyed by model). Remote
@@ -668,6 +760,12 @@ class HttpService:
                 "tokenize", t_tok,
                 model=req.model, prompt_tokens=len(pre.token_ids),
             ))
+            # ship the detail bit to the worker: an SLO breach is only
+            # detectable at finish, so the engine must retain the FULL
+            # round-span history until then for a late promotion to
+            # yield a complete dossier (the PR 4 shell-trace gap)
+            if "trace_detail" not in pre.annotations:
+                pre.annotations.append("trace_detail")
             # overload plane: header hints land on top of the nvext
             # fields the preprocessor already applied (headers win;
             # nvext is NOT re-applied — re-minting its deadline here
@@ -684,7 +782,11 @@ class HttpService:
                     req, chain, pre, chat, t_received=env["t0"])
             finally:
                 self.metrics.inflight.labels(req.model).dec()
-                TRACES.finish(pre.request_id)
+                tr = TRACES.finish(pre.request_id)
+                # a breach/sample decision made in timing.finish() (which
+                # ran inside the stream/unary paths) assembles its
+                # dossier here, from the fully merged trace
+                self.forensics.on_trace_finished(pre.request_id, tr)
 
         return await self._run_endpoint(request, endpoint, run)
 

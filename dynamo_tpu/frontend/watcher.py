@@ -360,9 +360,13 @@ class ModelWatcher:
     async def _follow_metrics(self) -> None:
         """Heartbeat tap on the load-metrics plane: every worker metrics
         publication refreshes that worker's soft lease in the shared
-        health tracker (resilience/health.py)."""
+        health tracker (resilience/health.py) and folds its latency
+        histograms into the fleet-merged feed (telemetry/fleet_feed.py —
+        the frontend's dynamo_fleet_request_* families and the planner's
+        latency view)."""
         from dynamo_tpu.kv_router.protocols import ForwardPassMetrics
         from dynamo_tpu.runtime.publisher import METRICS_TOPIC
+        from dynamo_tpu.telemetry.fleet_feed import FLEET_FEED
 
         sub = await self.rt.kv.subscribe(f"{METRICS_TOPIC}.>")
         async for ev in sub:
@@ -372,6 +376,7 @@ class ModelWatcher:
                 continue
             self.health.observe_metrics(m)
             self.load.observe(m)
+            FLEET_FEED.observe(m)
 
     def _route_kv_event(self, event: KvCacheEvent, *,
                         buffer_unclaimed: bool = True) -> bool:

@@ -314,6 +314,15 @@ class KvPushRouter:
                     worker_id, getattr(e, "retry_after_s", 1.0)
                 )
                 OVERLOAD.inc("dynamo_overload_router_spills_total")
+                # the bounce is part of the request's KV path — a breach
+                # dossier shows WHERE the queueing came from
+                TRACES.add_span(rid, span_now(
+                    "overload_bounce", t_route,
+                    worker=str(worker_id),
+                    retry_after_s=round(
+                        float(getattr(e, "retry_after_s", 1.0)), 3),
+                    attempt=attempt - 1,
+                ))
                 log.info(
                     "worker %s overloaded; spilling %s to a peer "
                     "(retry_after %.2fs)",

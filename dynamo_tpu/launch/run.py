@@ -159,6 +159,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="SLO objective (fraction of observations that "
                         "must meet the target, e.g. 0.99); burn rate = "
                         "frac-over-target / (1 - objective)")
+    p.add_argument("--forensics-sample-rate", type=float,
+                   default=cfg.forensics_sample_rate,
+                   help="fraction of NON-breaching requests that still "
+                        "get an SLO-breach-style dossier captured into "
+                        "/debug/outliers (breaches are always captured; "
+                        "0 disables the healthy-baseline sample)")
     # speculative decoding (dynamo_tpu/spec/)
     p.add_argument("--speculative", default=cfg.speculative,
                    choices=["off", "ngram", "draft"],
@@ -573,6 +579,7 @@ def build_chain(args) -> "Any":
             slo_ttft_target_s=args.slo_ttft_target,
             slo_itl_target_s=args.slo_itl_target,
             slo_objective=args.slo_objective,
+            forensics_sample_rate=args.forensics_sample_rate,
             kv_dedup_admission=not getattr(
                 args, "no_kv_dedup_admission", False
             ),
@@ -622,7 +629,8 @@ async def _serve_http(args, chain) -> None:
     manager = ModelManager()
     manager.register(chain)
     svc = HttpService(manager, host=args.http_host, port=args.http_port,
-                      trace_sample_rate=args.trace_sample_rate)
+                      trace_sample_rate=args.trace_sample_rate,
+                      forensics_sample_rate=args.forensics_sample_rate)
     await svc.start()
     print(f"serving {chain.name!r} on http://{args.http_host}:{args.http_port}")
     try:
@@ -1024,7 +1032,8 @@ async def _serve_http_dynamic(args) -> None:
         router_config=router_config, prefetch_config=prefetch_config,
     ).start()
     svc = HttpService(manager, host=args.http_host, port=args.http_port,
-                      trace_sample_rate=args.trace_sample_rate)
+                      trace_sample_rate=args.trace_sample_rate,
+                      forensics_sample_rate=args.forensics_sample_rate)
     # /debug/kv_fleet serves the watcher's live per-model fleet views
     svc.fleet_views = watcher.fleet_views
     await svc.start()

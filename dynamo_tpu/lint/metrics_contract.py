@@ -25,7 +25,7 @@ from dynamo_tpu.lint.core import Finding, Module, ProjectIndex, dotted
 
 _METRIC_NAME = re.compile(r"dynamo_[a-z0-9_]+")
 _VALID_TYPES = {"counter", "gauge", "histogram", "summary"}
-_REGISTRY_CTORS = {"CounterRegistry", "ProfRegistry"}
+_REGISTRY_CTORS = {"CounterRegistry", "ProfRegistry", "FleetLatencyFeed"}
 _SURFACES = (
     "frontend/service.py",
     "runtime/system_server.py",
@@ -131,7 +131,10 @@ class MetricsContractRule:
                             and tgt.id.isupper()):
                         continue  # instance/local registries opt out
                     for sname, smod in zip(_SURFACES, surfaces):
-                        if f"{tgt.id}.render()" not in smod.source:
+                        # open paren, not `render()`: surfaces may pass
+                        # render(openmetrics=...) for exemplar-capable
+                        # registries
+                        if f"{tgt.id}.render(" not in smod.source:
                             findings.append(Finding(
                                 self.ID, mod.path, node.lineno,
                                 node.col_offset,

@@ -14,6 +14,8 @@ Exposed (all labelled by worker):
   dynamo_spec_effective_k (mean adaptive K over speculating slots)
   dynamo_request_{ttft,itl,e2e,queue}_seconds / dynamo_engine_round_seconds
       (latency histograms shipped inside ForwardPassMetrics.histograms)
+  dynamo_fleet_request_* (the same histograms MERGED across workers —
+      telemetry/fleet_feed.py; exemplars preserved under OpenMetrics)
 Run: ``dynamo-tpu metrics --control-plane HOST:PORT --port 9090``.
 """
 from __future__ import annotations
@@ -29,6 +31,8 @@ from dynamo_tpu.kv_router.metrics_aggregator import MetricsAggregator
 from dynamo_tpu.kv_router.protocols import ForwardPassMetrics
 from dynamo_tpu.runtime.client import KvClient
 from dynamo_tpu.runtime.publisher import METRICS_TOPIC
+from dynamo_tpu.telemetry.fleet_feed import FLEET_FEED
+from dynamo_tpu.telemetry.forensics import FORENSICS
 from dynamo_tpu.telemetry.metrics import render_histogram
 
 log = logging.getLogger(__name__)
@@ -79,8 +83,11 @@ class MetricsExporter:
             except (KeyError, ValueError, TypeError):
                 continue
             self.aggregator.update(m)
+            # fleet-merged latency feed: per-worker histogram snapshots
+            # sum into the dynamo_fleet_request_* families
+            FLEET_FEED.observe(m)
 
-    def render(self) -> str:
+    def render(self, openmetrics: bool = False) -> str:
         snap = self.aggregator.snapshot()
         lines: list[str] = []
 
@@ -167,6 +174,7 @@ class MetricsExporter:
                 # must appear once per family, not once per worker
                 lines.extend(render_histogram(
                     name, help_, per_worker[w], label=f'worker="{w}"',
+                    openmetrics=openmetrics,
                 )[2:])
         gauge("dynamo_metrics_workers",
               "workers in the last load-plane snapshot", len(snap.metrics))
@@ -186,9 +194,18 @@ class MetricsExporter:
                 + KV_TRANSFER.render() + KV_QUANT.render()
                 + KV_INTEGRITY.render() + OVERLOAD.render()
                 + PROF.render() + STORE.render() + PLANNER.render()
-                + KV_FLEET.render())
+                + KV_FLEET.render()
+                + FLEET_FEED.render(openmetrics=openmetrics)
+                + FORENSICS.render())
 
     async def handle_metrics(self, request: web.Request) -> web.Response:
+        if "application/openmetrics-text" in request.headers.get(
+                "Accept", ""):
+            return web.Response(
+                text=self.render(openmetrics=True) + "# EOF\n",
+                content_type="application/openmetrics-text",
+                charset="utf-8",
+            )
         return web.Response(
             text=self.render(), content_type="text/plain", charset="utf-8"
         )

@@ -38,6 +38,11 @@ from dynamo_tpu.protocols.common import (
     LLMEngineOutput,
     PreprocessedRequest,
 )
+from dynamo_tpu.telemetry import metrics as tmetrics
+from dynamo_tpu.telemetry.metrics import (
+    TelemetryRegistry,
+    request_histograms,
+)
 from dynamo_tpu.tokens import TokenBlockSequence
 
 
@@ -77,6 +82,9 @@ class _MockRequest:
     cancelled: bool = False
     prefilling: bool = False
     enqueue_time: float = field(default_factory=time.monotonic)
+    # forensics/timeline anchors (mocker-clock monotonic seconds)
+    admit_time: Optional[float] = None
+    first_token_time: Optional[float] = None
 
     # current (possibly restart-extended) prompt — kept separate from
     # req.token_ids so preemption never mutates the caller's request object
@@ -124,6 +132,15 @@ class MockerEngine:
         from dynamo_tpu.overload import AdmissionController
 
         self._queue_waits: deque = deque(maxlen=32)
+        # latency histograms on the SAME canonical ladders as the real
+        # engine (fleet merge sums only identical ladders), shipped in
+        # ForwardPassMetrics.histograms so fleet-feed / planner / bench
+        # paths exercise on CPU; exemplars carry request ids
+        self.telemetry = request_histograms(TelemetryRegistry(),
+                                            engine=True)
+        self._h_ttft = self.telemetry.get(tmetrics.TTFT[0])
+        self._h_e2e = self.telemetry.get(tmetrics.E2E[0])
+        self._h_queue = self.telemetry.get(tmetrics.QUEUE[0])
         self.admission = AdmissionController(
             self.args.max_waiting_requests,
             self.args.max_waiting_prefill_tokens,
@@ -253,6 +270,11 @@ class MockerEngine:
                 gpu_cache_usage_perc=a.usage(),
                 gpu_prefix_cache_hit_rate=a.hit_rate(),
             ),
+            histograms={
+                name: self.telemetry.get(name).snapshot()
+                for name, _ in (tmetrics.TTFT, tmetrics.ITL,
+                                tmetrics.E2E, tmetrics.QUEUE)
+            },
         )
 
     # ------------------------------------------------------------------
@@ -361,7 +383,11 @@ class MockerEngine:
                 return  # head-of-line blocks until space frees
             r.pages = matched + fresh
             r.prefilling = True
-            self._queue_waits.append(self.clock.monotonic() - r.enqueue_time)
+            r.admit_time = self.clock.monotonic()
+            wait = r.admit_time - r.enqueue_time
+            self._queue_waits.append(wait)
+            self._h_queue.observe(
+                wait, exemplar_id=r.req.request_id or None)
             self._waiting.pop(0)
             self._active.append(r)
             # simulated prefill cost for the non-cached suffix
@@ -431,8 +457,51 @@ class MockerEngine:
         pairs = [[tok + i, -0.1 - 1.0 * i] for i in range(max(int(n), 1))]
         return {"log_probs": [-0.1], "top_logprobs": [pairs[: int(n)]]}
 
+    def _finish_annotations(self, r: _MockRequest) -> dict:
+        """Timing + worker trace spans for the finishing output — the
+        same annotation shapes TpuEngine._final_annotations ships, so
+        the frontend's forensics/request-stats paths join mocker
+        requests identically (span starts anchored off the shared
+        clock's monotonic->wall offset)."""
+        now_m = self.clock.monotonic()
+        now_w = self.clock.time()
+
+        def wall(t_mono: float) -> float:
+            return round(now_w - (now_m - t_mono), 6)
+
+        e2e = now_m - r.enqueue_time
+        self._h_e2e.observe(e2e, exemplar_id=r.req.request_id or None)
+        timing: dict = {"e2e_s": round(e2e, 6),
+                        "output_tokens": r.produced}
+        spans: list[dict] = []
+        if r.admit_time is not None:
+            q = r.admit_time - r.enqueue_time
+            timing["queue_s"] = round(q, 6)
+            spans.append({"name": "queue", "start_s": wall(r.enqueue_time),
+                          "duration_s": round(q, 6), "attrs": {}})
+        if r.first_token_time is not None:
+            timing["ttft_s"] = round(r.first_token_time - r.enqueue_time, 6)
+            if r.admit_time is not None:
+                spans.append({
+                    "name": "prefill", "start_s": wall(r.admit_time),
+                    "duration_s": round(
+                        r.first_token_time - r.admit_time, 6),
+                    "attrs": {"tokens": len(r.orig_prompt)},
+                })
+            spans.append({
+                "name": "decode", "start_s": wall(r.first_token_time),
+                "duration_s": round(now_m - r.first_token_time, 6),
+                "attrs": {"tokens": r.produced},
+            })
+        return {"timing": timing, "trace": {"spans": spans}}
+
     def _emit_token(self, r: _MockRequest, tok: int) -> None:
         sc = r.req.stop_conditions
+        if r.produced == 0:
+            r.first_token_time = self.clock.monotonic()
+            self._h_ttft.observe(
+                r.first_token_time - r.enqueue_time,
+                exemplar_id=r.req.request_id or None)
         r.produced += 1
         self.tokens_generated += 1
         hit_eos = (
@@ -442,7 +511,8 @@ class MockerEngine:
         )
         if hit_eos:
             r.out.put_nowait(
-                LLMEngineOutput(token_ids=[], finish_reason=FinishReason.EOS)
+                LLMEngineOutput(token_ids=[], finish_reason=FinishReason.EOS,
+                                annotations=self._finish_annotations(r))
             )
             self._release(r)
             return
@@ -451,6 +521,7 @@ class MockerEngine:
             r.out.put_nowait(
                 LLMEngineOutput(
                     token_ids=[tok], finish_reason=FinishReason.LENGTH,
+                    annotations=self._finish_annotations(r),
                     **self._lp_fields(r, tok),
                 )
             )

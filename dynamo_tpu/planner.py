@@ -61,6 +61,14 @@ class PlannerConfig:
     # and any worker's estimated admission wait exceeds this, scale up
     # even if KV usage and queue depth look fine (0 = disabled)
     queue_wait_scale_up_s: float = 0.0
+    # fleet-merged latency triggers (telemetry/fleet_feed.py): the
+    # planner keeps its own FleetLatencyFeed over the same metrics
+    # subscription and reads interval-delta p99s each decide. Stream
+    # counts miss a latency wave that arrives without queue growth
+    # (slow rounds, deep prefixes falling off cache); the merged TTFT /
+    # queue-wait distribution sees it directly. 0 = disabled.
+    fleet_ttft_scale_up_s: float = 0.0
+    fleet_queue_scale_up_s: float = 0.0
 
 
 class Connector(Protocol):
@@ -251,6 +259,16 @@ class Planner:
             stale_after_s=self.config.metrics_stale_after_s,
             clock=self.clock.monotonic,
         )
+        # fleet-merged latency feed (telemetry/fleet_feed.py): a private
+        # instance (not the process-global FLEET_FEED) so the planner's
+        # advance() interval-delta baseline is its own, and fleetsim's
+        # VirtualClock governs staleness
+        from dynamo_tpu.telemetry.fleet_feed import FleetLatencyFeed
+
+        self.fleet_feed = FleetLatencyFeed(
+            stale_after_s=self.config.metrics_stale_after_s,
+            clock=self.clock.monotonic,
+        )
         self.decisions: list[tuple[float, int]] = []  # (ts, target) history
         self._low_streak = 0
         self._task: Optional[asyncio.Task] = None
@@ -287,6 +305,7 @@ class Planner:
             except (KeyError, ValueError, TypeError):
                 continue
             self.aggregator.update(m)
+            self.fleet_feed.observe(m)
 
     async def _loop(self) -> None:
         while True:
@@ -322,6 +341,32 @@ class Planner:
             c.max_replicas,
             math.ceil(forecast / c.streams_per_replica),
         ))
+
+    def _fleet_latency_high(self) -> bool:
+        """Fleet-merged latency trigger: p99 TTFT / queue wait over the
+        LAST DECIDE INTERVAL (advance() deltas, not the cumulative
+        distribution) beyond the configured bounds. Runs every decide —
+        even with both bounds disabled the gauges still publish, so
+        dashboards see what the planner sees."""
+        from dynamo_tpu.planner_metrics import PLANNER
+
+        deltas = self.fleet_feed.advance()
+        from dynamo_tpu.telemetry.metrics import percentile_from_snapshot
+
+        ttft_p99 = percentile_from_snapshot(
+            deltas.get("dynamo_fleet_request_ttft_seconds") or {}, 0.99)
+        queue_p99 = percentile_from_snapshot(
+            deltas.get("dynamo_fleet_request_queue_seconds") or {}, 0.99)
+        PLANNER.set("dynamo_planner_fleet_ttft_p99_seconds",
+                    round(ttft_p99 or 0.0, 6))
+        PLANNER.set("dynamo_planner_fleet_queue_p99_seconds",
+                    round(queue_p99 or 0.0, 6))
+        c = self.config
+        if (c.fleet_ttft_scale_up_s > 0 and ttft_p99 is not None
+                and ttft_p99 > c.fleet_ttft_scale_up_s):
+            return True
+        return (c.fleet_queue_scale_up_s > 0 and queue_p99 is not None
+                and queue_p99 > c.fleet_queue_scale_up_s)
 
     def _queue_wait_high(self, snap) -> bool:
         """Live overload-plane trigger: any worker's estimated admission
@@ -368,9 +413,13 @@ class Planner:
         ))
         usage = self._pred_usage.predict_next()
         waiting = self._pred_waiting.predict_next()
+        # evaluated unconditionally (not short-circuited inside the
+        # ``or``): advance() must step its interval baseline and publish
+        # the fleet p99 gauges exactly once per decide
+        fleet_high = self._fleet_latency_high()
         target = current
         if (usage > c.kv_usage_scale_up or waiting > c.waiting_scale_up
-                or self._queue_wait_high(snap)):
+                or self._queue_wait_high(snap) or fleet_high):
             target = current + 1
             self._low_streak = 0
         elif usage < c.kv_usage_scale_down and waiting < 0.5:
@@ -440,6 +489,9 @@ async def run_planner(args) -> None:
         predictor=getattr(args, "predictor", "constant"),
         predictive=getattr(args, "predictive", False),
         streams_per_replica=getattr(args, "streams_per_replica", 0.0),
+        fleet_ttft_scale_up_s=getattr(args, "fleet_ttft_scale_up", 0.0),
+        fleet_queue_scale_up_s=getattr(
+            args, "fleet_queue_scale_up", 0.0),
     )
     if connector.current_replicas() < cfg.min_replicas:
         await connector.set_replicas(cfg.min_replicas)

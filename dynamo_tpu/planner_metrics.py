@@ -24,6 +24,12 @@ FAMILIES: tuple[tuple[str, str, str], ...] = (
     ("dynamo_planner_predicted_load", "gauge",
      "predictor forecast for the next interval (concurrent streams in "
      "predictive/SLA mode, mean KV usage in load mode)"),
+    ("dynamo_planner_fleet_ttft_p99_seconds", "gauge",
+     "p99 TTFT over the last decide interval from the fleet-merged "
+     "latency feed (0 until the feed has data)"),
+    ("dynamo_planner_fleet_queue_p99_seconds", "gauge",
+     "p99 admission queue wait over the last decide interval from the "
+     "fleet-merged latency feed (0 until the feed has data)"),
 )
 
 # process-wide registry shared by every planner in the process (parity

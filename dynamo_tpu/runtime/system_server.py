@@ -31,7 +31,10 @@ from aiohttp import web
 from dynamo_tpu.resilience.chaos import CHAOS
 from dynamo_tpu.resilience.metrics import RESILIENCE
 from dynamo_tpu.telemetry import TRACES
+from dynamo_tpu.telemetry.fleet_feed import FLEET_FEED
+from dynamo_tpu.telemetry.forensics import FORENSICS, OUTLIERS
 from dynamo_tpu.telemetry.metrics import render_histogram
+from dynamo_tpu.telemetry.timeline import to_chrome_trace
 
 log = logging.getLogger(__name__)
 
@@ -69,6 +72,8 @@ class SystemServer:
             web.get("/debug/prof", self.handle_prof),
             web.get("/debug/trace", self.handle_trace_index),
             web.get("/debug/trace/{request_id}", self.handle_trace),
+            web.get("/debug/outliers", self.handle_outliers),
+            web.get("/debug/outliers/{request_id}", self.handle_outlier),
             web.get("/drain", self.handle_drain_status),
             web.post("/drain", self.handle_drain),
             web.get("/chaos", self.handle_chaos_list),
@@ -90,7 +95,7 @@ class SystemServer:
             await self._runner.cleanup()
             self._runner = None
 
-    def render(self) -> str:
+    def render(self, openmetrics: bool = False) -> str:
         lines = [
             "# HELP dynamo_system_uptime_seconds process uptime",
             "# TYPE dynamo_system_uptime_seconds gauge",
@@ -105,6 +110,9 @@ class SystemServer:
                 log.exception("engine metrics failed")
                 m = None
             if m is not None:
+                # this worker's histograms feed the (fleet-of-one) merge
+                # so dynamo_fleet_* families render here too
+                FLEET_FEED.observe(m)
                 w = self.worker_id or m.worker_id
 
                 def g(name: str, help_: str, v) -> None:
@@ -154,6 +162,7 @@ class SystemServer:
                     lines.extend(render_histogram(
                         name, snap.get("help", name), snap,
                         label=f'worker="{w}"',
+                        openmetrics=openmetrics,
                     ))
         # resilience + KV-transfer + overload planes: counters of THIS
         # process
@@ -170,9 +179,17 @@ class SystemServer:
                 + KV_TRANSFER.render() + KV_QUANT.render()
                 + KV_INTEGRITY.render() + OVERLOAD.render()
                 + PROF.render() + STORE.render() + PLANNER.render()
-                + KV_FLEET.render())
+                + KV_FLEET.render()
+                + FLEET_FEED.render(openmetrics=openmetrics)
+                + FORENSICS.render())
 
     async def handle_metrics(self, request: web.Request) -> web.Response:
+        if "application/openmetrics-text" in request.headers.get(
+                "Accept", ""):
+            return web.Response(
+                text=self.render(openmetrics=True) + "# EOF\n",
+                content_type="application/openmetrics-text",
+            )
         return web.Response(text=self.render(), content_type="text/plain")
 
     async def handle_health(self, request: web.Request) -> web.Response:
@@ -298,7 +315,39 @@ class SystemServer:
         rid = request.match_info["request_id"]
         tr = TRACES.get(rid)
         if tr is None:
-            return web.json_response(
-                {"error": f"no trace for request {rid!r}"}, status=404
-            )
+            # the body says WHY: evicted vs unsampled vs never seen
+            return web.json_response(TRACES.describe_missing(rid),
+                                     status=404)
         return web.json_response(tr.to_dict())
+
+    async def handle_outliers(self, request: web.Request) -> web.Response:
+        """GET /debug/outliers — this worker's SLO-breach dossier ring
+        (worker-side captures for requests whose frontend runs in
+        another process)."""
+        body = OUTLIERS.index()
+        body["worker_id"] = self.worker_id
+        return web.json_response(body)
+
+    async def handle_outlier(self, request: web.Request) -> web.Response:
+        """GET /debug/outliers/{request_id}[?format=perfetto] — one full
+        dossier from this worker's ring."""
+        rid = request.match_info["request_id"]
+        d = OUTLIERS.get(rid)
+        if d is None:
+            return web.json_response({
+                "error": f"no dossier for request {rid!r}",
+                "worker_id": self.worker_id,
+                "capacity": OUTLIERS.capacity,
+                "captured_total": OUTLIERS.captured_total,
+                "evicted_total": OUTLIERS.evicted_total,
+                "oldest_retained_id": OUTLIERS.oldest_id(),
+            }, status=404)
+        if request.query.get("format") == "perfetto":
+            return web.json_response(to_chrome_trace(
+                spans=list(d.trace.get("spans") or []),
+                round_records=d.rounds,
+                flight_events=d.flight,
+                stream_events=d.stream,
+                label=rid,
+            ))
+        return web.json_response(d.to_dict())

@@ -22,7 +22,16 @@ resulting distributions). Five pieces:
               segments, flight events, and kv-transfer stream events
               (tools/trace_export.py is the CLI)
 """
+from dynamo_tpu.telemetry.fleet_feed import FLEET_FEED, FleetLatencyFeed
 from dynamo_tpu.telemetry.flight import FlightRecorder
+from dynamo_tpu.telemetry.forensics import (
+    FORENSICS,
+    OUTLIERS,
+    Dossier,
+    DossierRing,
+    ForensicsCapture,
+    kv_path_from_spans,
+)
 from dynamo_tpu.telemetry.metrics import (
     DEFAULT_TIME_BUCKETS,
     Histogram,
@@ -47,7 +56,15 @@ from dynamo_tpu.telemetry.trace import TRACES, Span, Trace, TraceStore
 
 __all__ = [
     "DEFAULT_TIME_BUCKETS",
+    "Dossier",
+    "DossierRing",
+    "FLEET_FEED",
+    "FleetLatencyFeed",
     "FlightRecorder",
+    "FORENSICS",
+    "ForensicsCapture",
+    "kv_path_from_spans",
+    "OUTLIERS",
     "Histogram",
     "HOST_BUCKETS",
     "PROF",

@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import math
 import threading
+import time
 from typing import Any, Optional
 
 import numpy as np
@@ -49,11 +50,18 @@ class Histogram:
         self._counts = [0] * (len(self.buckets) + 1)  # last = +Inf
         self._sum = 0.0
         self._count = 0
+        # bucket index -> (exemplar_id, value, unix_ts): the LAST observed
+        # id per bucket, rendered as an OpenMetrics exemplar so a heatmap
+        # cell links to a concrete request's dossier
+        self._exemplars: dict[int, tuple[str, float, float]] = {}
         self._lock = threading.Lock()
 
-    def observe(self, value: float, n: int = 1) -> None:
+    def observe(
+        self, value: float, n: int = 1, exemplar_id: Optional[str] = None
+    ) -> None:
         """Record ``value`` ``n`` times (n>1: a batch of identical
-        observations, e.g. per-token gaps derived from one round)."""
+        observations, e.g. per-token gaps derived from one round).
+        ``exemplar_id`` tags the target bucket with a trace id."""
         if n <= 0 or not math.isfinite(value):
             return
         i = len(self.buckets)
@@ -65,6 +73,8 @@ class Histogram:
             self._counts[i] += n
             self._sum += value * n
             self._count += n
+            if exemplar_id:
+                self._exemplars[i] = (exemplar_id, value, time.time())
 
     def observe_many(self, values) -> None:
         """Vectorized observe for a 1-D numpy batch: one searchsorted +
@@ -97,19 +107,27 @@ class Histogram:
             return self._sum
 
     def snapshot(self) -> dict[str, Any]:
-        """Wire form: cumulative counts aligned with ``buckets`` + +Inf."""
+        """Wire form: cumulative counts aligned with ``buckets`` + +Inf.
+        When exemplars were observed, an ``exemplars`` key maps bucket
+        index (stringified for JSON round-trips) to [id, value, ts]."""
         with self._lock:
             cum = []
             total = 0
             for c in self._counts:
                 total += c
                 cum.append(total)
-            return {
+            snap: dict[str, Any] = {
                 "buckets": list(self.buckets),
                 "counts": cum,        # cumulative, last entry == count
                 "sum": self._sum,
                 "count": self._count,
             }
+            if self._exemplars:
+                snap["exemplars"] = {
+                    str(i): [eid, v, ts]
+                    for i, (eid, v, ts) in self._exemplars.items()
+                }
+            return snap
 
     def percentile(self, q: float) -> Optional[float]:
         return percentile_from_snapshot(self.snapshot(), q)
@@ -119,9 +137,13 @@ class Histogram:
             self._counts = [0] * (len(self.buckets) + 1)
             self._sum = 0.0
             self._count = 0
+            self._exemplars.clear()
 
-    def render(self, label: str = "") -> list[str]:
-        return render_histogram(self.name, self.help, self.snapshot(), label)
+    def render(self, label: str = "", openmetrics: bool = False) -> list[str]:
+        return render_histogram(
+            self.name, self.help, self.snapshot(), label,
+            openmetrics=openmetrics,
+        )
 
 
 class CounterRegistry:
@@ -165,8 +187,11 @@ class CounterRegistry:
         with self._lock:
             return self._values[name]
 
-    def observe(self, name: str, value: float, n: int = 1) -> None:
-        self._hists[name].observe(value, n)
+    def observe(
+        self, name: str, value: float, n: int = 1,
+        exemplar_id: Optional[str] = None,
+    ) -> None:
+        self._hists[name].observe(value, n, exemplar_id=exemplar_id)
 
     def histogram(self, name: str) -> Histogram:
         return self._hists[name]
@@ -182,7 +207,7 @@ class CounterRegistry:
         with self._lock:
             return dict(self._values)
 
-    def render(self) -> str:
+    def render(self, openmetrics: bool = False) -> str:
         """Prometheus text for every family (trailing newline included)."""
         snap = self.snapshot()
         lines: list[str] = []
@@ -192,7 +217,7 @@ class CounterRegistry:
             v = snap[name]
             lines.append(f"{name} {int(v) if v == int(v) else v}")
         for h in self._hists.values():
-            lines.extend(h.render())
+            lines.extend(h.render(openmetrics=openmetrics))
         return "\n".join(lines) + "\n"
 
 
@@ -243,19 +268,36 @@ def weighted_percentile(
 
 
 def render_histogram(
-    name: str, help_: str, snap: dict[str, Any], label: str = ""
+    name: str, help_: str, snap: dict[str, Any], label: str = "",
+    *, openmetrics: bool = False,
 ) -> list[str]:
     """Prometheus text-format lines for one snapshot. ``label`` is a
-    pre-rendered extra label pair (e.g. ``worker="w0"``) or empty."""
+    pre-rendered extra label pair (e.g. ``worker="w0"``) or empty.
+
+    ``openmetrics=True`` appends ``# {trace_id="..."} value ts`` exemplar
+    suffixes to bucket lines that carry one (the OpenMetrics exposition
+    format); the default plain Prometheus text output is byte-identical
+    to what it always was — exemplars only ship to scrapers that
+    negotiated ``application/openmetrics-text``."""
 
     def fmt(le: str) -> str:
         pairs = f'le="{le}"' if not label else f'{label},le="{le}"'
         return f"{name}_bucket{{{pairs}}}"
 
+    exemplars = snap.get("exemplars") or {} if openmetrics else {}
+
+    def ex(i: int) -> str:
+        e = exemplars.get(str(i)) or exemplars.get(i)
+        if not e:
+            return ""
+        eid, value, ts = e
+        return f' # {{trace_id="{eid}"}} {value} {ts:.3f}'
+
     lines = [f"# HELP {name} {help_}", f"# TYPE {name} histogram"]
-    for edge, cum in zip(snap["buckets"], snap["counts"][:-1]):
-        lines.append(f"{fmt(repr(float(edge)))} {cum}")
-    lines.append(f"{fmt('+Inf')} {snap['counts'][-1]}")
+    for i, (edge, cum) in enumerate(zip(snap["buckets"], snap["counts"][:-1])):
+        lines.append(f"{fmt(repr(float(edge)))} {cum}{ex(i)}")
+    n_b = len(snap["buckets"])
+    lines.append(f"{fmt('+Inf')} {snap['counts'][-1]}{ex(n_b)}")
     suffix = f"{{{label}}}" if label else ""
     lines.append(f"{name}_sum{suffix} {snap['sum']}")
     lines.append(f"{name}_count{suffix} {snap['count']}")
@@ -290,10 +332,10 @@ class TelemetryRegistry:
             for name, h in self._hists.items()
         }
 
-    def render(self, label: str = "") -> str:
+    def render(self, label: str = "", openmetrics: bool = False) -> str:
         lines: list[str] = []
         for h in self._hists.values():
-            lines.extend(h.render(label))
+            lines.extend(h.render(label, openmetrics=openmetrics))
         return "\n".join(lines) + ("\n" if lines else "")
 
     def reset(self) -> None:

@@ -65,26 +65,43 @@ def span_now(name: str, t0_monotonic: float, **attrs: Any) -> Span:
                 attrs=attrs)
 
 
+# spans a shell trace buffers while unsampled, so a LATE promotion (an
+# SLO breach only detectable at finish) still recovers the request's
+# whole path; bounded so a pathological span source can't grow a shell
+_SHELL_BUFFER_CAP = 160
+
+
 @dataclass
 class Trace:
     """One request's span tree (flat span list; stage order by start).
 
-    ``sampled=False`` traces are shells: span recording no-ops and the
-    trace is dropped at finish instead of parking in the completed ring —
-    the high-QPS sampling mode (--trace-sample-rate) pays one dict entry
-    per request, not span assembly. A shell can be PROMOTED mid-request
-    (migration/failure paths always trace) and collects spans from then
-    on."""
+    ``sampled=False`` traces are shells: spans park in a small bounded
+    side buffer and the trace is dropped at finish instead of parking in
+    the completed ring — the high-QPS sampling mode (--trace-sample-rate)
+    never pays completed-ring assembly for unsampled requests. A shell
+    can be PROMOTED at any point before finish (migration/failure paths
+    always trace; SLO-breach forensics promotes at finish time) and the
+    buffered spans are adopted, so even a promotion on the request's
+    last instruction yields a complete tree."""
 
     trace_id: str
     created_s: float = field(default_factory=time.time)
     spans: list[Span] = field(default_factory=list)
     finished: bool = False
     sampled: bool = True
+    buffered: list[Span] = field(default_factory=list)
 
     def add(self, span: Span) -> None:
         if self.sampled:
             self.spans.append(span)
+        elif len(self.buffered) < _SHELL_BUFFER_CAP:
+            self.buffered.append(span)
+
+    def adopt_buffer(self) -> None:
+        """Promote: fold the shell's buffered spans into the real tree."""
+        if self.buffered:
+            self.spans.extend(self.buffered)
+            self.buffered = []
 
     def merge_dicts(self, span_dicts: list[dict[str, Any]]) -> None:
         """Fold worker-side spans (annotation payload) into the tree."""
@@ -121,7 +138,19 @@ class TraceStore:
         # on the parent request's tree
         self._aliases: dict[str, str] = {}
         self._completed: OrderedDict[str, Trace] = OrderedDict()
+        # ids we saw but no longer hold, mapped to WHY ("evicted" ring
+        # overflow vs "unsampled" shell drop) — lets /debug/trace 404s
+        # distinguish "gone" from "never existed"; bounded like the ring
+        self._gone: OrderedDict[str, str] = OrderedDict()
+        self.evicted_total = 0
         self._lock = threading.Lock()
+
+    def _note_gone(self, trace_id: str, reason: str) -> None:
+        # caller holds self._lock
+        self._gone[trace_id] = reason
+        self._gone.move_to_end(trace_id)
+        while len(self._gone) > 8 * self.max_completed:
+            self._gone.popitem(last=False)
 
     def start(self, trace_id: str, sampled: bool = True) -> Trace:
         tr = Trace(trace_id=trace_id, sampled=sampled)
@@ -155,10 +184,10 @@ class TraceStore:
         through to the annotation path."""
         with self._lock:
             tr = self._resolve(trace_id)
-            if tr is None or not tr.sampled:
+            if tr is None:
                 return False
-            tr.add(span)
-            return True
+            tr.add(span)  # shells buffer (bounded) for late promotion
+            return tr.sampled
 
     def promote(self, trace_id: str) -> bool:
         """Turn an unsampled shell into a full trace mid-request —
@@ -169,13 +198,24 @@ class TraceStore:
             if tr is None:
                 return False
             tr.sampled = True
+            tr.adopt_buffer()
             return True
 
     def merge(self, trace_id: str, span_dicts: list[dict[str, Any]]) -> None:
         with self._lock:
             tr = self._resolve(trace_id)
-        if tr is not None and tr.sampled:
+        if tr is None:
+            return
+        if tr.sampled:
             tr.merge_dicts(span_dicts)
+        else:
+            # shell: park worker spans in the bounded buffer so a
+            # finish-time promotion recovers them
+            room = _SHELL_BUFFER_CAP - len(tr.buffered)
+            if room > 0:
+                shadow = Trace(trace_id=trace_id)
+                shadow.merge_dicts(span_dicts[:room])
+                tr.buffered.extend(shadow.spans)
 
     def finish(self, trace_id: str) -> Optional[Trace]:
         with self._lock:
@@ -187,10 +227,13 @@ class TraceStore:
             }
             tr.finished = True
             if not tr.sampled:
+                self._note_gone(trace_id, "unsampled")
                 return tr  # shell: dropped, never parked in the ring
             self._completed[trace_id] = tr
             while len(self._completed) > self.max_completed:
-                self._completed.popitem(last=False)
+                gone_id, _ = self._completed.popitem(last=False)
+                self.evicted_total += 1
+                self._note_gone(gone_id, "evicted")
             return tr
 
     def record_remote(
@@ -205,11 +248,31 @@ class TraceStore:
             self._completed[trace_id] = tr
             self._completed.move_to_end(trace_id)
             while len(self._completed) > self.max_completed:
-                self._completed.popitem(last=False)
+                gone_id, _ = self._completed.popitem(last=False)
+                self.evicted_total += 1
+                self._note_gone(gone_id, "evicted")
 
     def get(self, trace_id: str) -> Optional[Trace]:
         with self._lock:
             return self._completed.get(trace_id) or self._active.get(trace_id)
+
+    def describe_missing(self, trace_id: str) -> dict[str, Any]:
+        """404 body for /debug/trace/{id}: says WHY the trace is absent —
+        ``evicted`` (ring overflow), ``unsampled`` (shell dropped at
+        finish), or ``never_seen`` — plus enough ring state to judge
+        whether raising --trace-sample-rate or the ring size would have
+        kept it."""
+        with self._lock:
+            reason = self._gone.get(trace_id, "never_seen")
+            oldest = next(iter(self._completed), None)
+            return {
+                "error": f"no trace for request {trace_id!r}",
+                "reason": reason,
+                "ring_capacity": self.max_completed,
+                "retained": len(self._completed),
+                "oldest_retained_id": oldest,
+                "evicted_total": self.evicted_total,
+            }
 
     def recent_ids(self, n: int = 50) -> list[str]:
         with self._lock:
@@ -220,6 +283,8 @@ class TraceStore:
             self._active.clear()
             self._aliases.clear()
             self._completed.clear()
+            self._gone.clear()
+            self.evicted_total = 0
 
 
 # process-wide store: the frontend, router, engine, and debug endpoints in

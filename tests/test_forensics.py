@@ -1,0 +1,501 @@
+"""Tail-latency forensics: SLO-breach dossiers, OpenMetrics exemplars,
+and the fleet-merged latency feed.
+
+Covers the full capture chain — breach detection at request finish,
+trace promotion, dossier assembly into the bounded /debug/outliers ring,
+exemplar-tagged histogram buckets that resolve back to servable
+dossiers — plus the FleetLatencyFeed merge/delta math the planner's
+latency trigger consumes, and the ≤5% always-on overhead bound.
+"""
+import re
+import time
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from dynamo_tpu.backend import Backend
+from dynamo_tpu.engines import EchoEngine
+from dynamo_tpu.frontend import HttpService, ModelChain, ModelManager
+from dynamo_tpu.kv_router.protocols import (
+    ForwardPassMetrics,
+    KvStats,
+    WorkerStats,
+)
+from dynamo_tpu.preprocessor import OpenAIPreprocessor, PromptFormatter
+from dynamo_tpu.telemetry import TelemetryRegistry, request_histograms
+from dynamo_tpu.telemetry import metrics as tmetrics
+from dynamo_tpu.telemetry.fleet_feed import FleetLatencyFeed
+from dynamo_tpu.telemetry.forensics import (
+    OUTLIERS,
+    DossierRing,
+    ForensicsCapture,
+)
+from dynamo_tpu.telemetry.trace import TRACES, Span, TraceStore
+from dynamo_tpu.tokenizer import make_test_tokenizer
+
+WORDS = [f"w{i}" for i in range(50)] + ["hello", "world"]
+
+TTFT = "dynamo_request_ttft_seconds"
+QUEUE = "dynamo_request_queue_seconds"
+FLEET_TTFT = "dynamo_fleet_request_ttft_seconds"
+FLEET_QUEUE = "dynamo_fleet_request_queue_seconds"
+
+
+def make_forensic_service(**svc_kwargs) -> HttpService:
+    tok = make_test_tokenizer(WORDS)
+    fmt = PromptFormatter(
+        template="{% for m in messages %}{{ m.content }} {% endfor %}"
+    )
+    chain = ModelChain(
+        name="echo",
+        preprocessor=OpenAIPreprocessor(
+            tokenizer=tok, formatter=fmt, model_name="echo"),
+        engine=EchoEngine(delay_s=0.0),
+        backend=Backend(tok),
+    )
+    manager = ModelManager()
+    manager.register(chain)
+    return HttpService(manager, **svc_kwargs)
+
+
+async def with_client(svc):
+    client = TestClient(TestServer(svc.app))
+    await client.start_server()
+    return client
+
+
+def engine_metrics(worker: str, ttft_s: float, n: int = 8,
+                   usage: float = 0.2) -> ForwardPassMetrics:
+    """A worker metrics payload whose histograms show ``n`` requests at
+    ``ttft_s`` TTFT/queue-wait — canonical ladder via request_histograms
+    so fleet merge sums bucket-for-bucket."""
+    t = request_histograms(TelemetryRegistry(), engine=True)
+    for _ in range(n):
+        t.get(TTFT).observe(ttft_s)
+        t.get(QUEUE).observe(ttft_s)
+    return ForwardPassMetrics(
+        worker_id=worker,
+        worker_stats=WorkerStats(request_active_slots=1,
+                                 request_total_slots=8),
+        kv_stats=KvStats(gpu_cache_usage_perc=usage),
+        histograms=t.snapshot(),
+    )
+
+
+# ---------------------------------------------------------------------------
+# exemplars
+
+
+def test_exemplar_rendered_openmetrics_only():
+    reg = request_histograms(TelemetryRegistry())
+    reg.get(TTFT).observe(0.07, exemplar_id="req-abc")
+    om = "\n".join(reg.get(TTFT).render(openmetrics=True))
+    m = re.search(
+        r'_bucket\{le="[^"]+"\} \d+ # \{trace_id="req-abc"\} '
+        r'([0-9.e+-]+) ([0-9.]+)', om)
+    assert m, f"no OpenMetrics exemplar in:\n{om}"
+    assert float(m.group(1)) == pytest.approx(0.07)
+    # plain Prometheus scrape stays exemplar-free
+    plain = "\n".join(reg.get(TTFT).render())
+    assert "# {" not in plain
+
+
+def test_plain_render_byte_identical_with_exemplars():
+    """Attaching exemplars must not perturb the plain text format."""
+    a = request_histograms(TelemetryRegistry())
+    b = request_histograms(TelemetryRegistry())
+    for v in (0.01, 0.3, 2.0):
+        a.get(TTFT).observe(v, exemplar_id=f"r-{v}")
+        b.get(TTFT).observe(v)
+    assert a.get(TTFT).render() == b.get(TTFT).render()
+
+
+def test_exemplar_survives_snapshot_round_trip():
+    reg = request_histograms(TelemetryRegistry())
+    reg.get(TTFT).observe(0.2, exemplar_id="rid-9")
+    snap = reg.get(TTFT).snapshot()
+    ex = snap.get("exemplars") or {}
+    assert any(e[0] == "rid-9" for e in ex.values())
+    # snapshot is JSON-shaped: string keys, [id, value, ts] triples
+    for k, e in ex.items():
+        assert isinstance(k, str)
+        assert len(e) == 3
+
+
+# ---------------------------------------------------------------------------
+# fleet-merged latency feed
+
+
+def test_fleet_merge_equals_sum():
+    feed = FleetLatencyFeed()
+    m0 = engine_metrics("w0", 0.05, n=5)
+    m1 = engine_metrics("w1", 1.5, n=3)
+    feed.observe(m0)
+    feed.observe(m1)
+    assert sorted(feed.workers()) == ["w0", "w1"]
+    merged = feed.merged()
+    fleet = merged[FLEET_TTFT]
+    s0 = m0.histograms[TTFT]
+    s1 = m1.histograms[TTFT]
+    assert fleet["count"] == s0["count"] + s1["count"] == 8
+    assert fleet["sum"] == pytest.approx(s0["sum"] + s1["sum"])
+    assert fleet["buckets"] == s0["buckets"]
+    for i, c in enumerate(fleet["counts"]):
+        assert c == s0["counts"][i] + s1["counts"][i]
+    # and the percentile helper reads the merged distribution
+    p99 = feed.percentile(FLEET_TTFT, 0.99)
+    assert p99 is not None and p99 > 0.5
+
+
+def test_fleet_feed_interval_deltas():
+    """advance() returns per-interval deltas, not lifetime cumulatives —
+    an hour of healthy history must not dilute a fresh latency wave."""
+    feed = FleetLatencyFeed()
+    feed.observe(engine_metrics("w0", 0.01, n=100))
+    first = feed.advance()
+    assert first[FLEET_TTFT]["count"] == 100
+    # next interval: 10 slow requests on top of the same worker
+    t = request_histograms(TelemetryRegistry(), engine=True)
+    for _ in range(100):
+        t.get(TTFT).observe(0.01)
+        t.get(QUEUE).observe(0.01)
+    for _ in range(10):
+        t.get(TTFT).observe(2.0)
+        t.get(QUEUE).observe(2.0)
+    feed.observe(ForwardPassMetrics(worker_id="w0",
+                                    histograms=t.snapshot()))
+    delta = feed.advance()
+    assert delta[FLEET_TTFT]["count"] == 10
+    p99 = tmetrics.percentile_from_snapshot(delta[FLEET_TTFT], 0.99)
+    assert p99 is not None and p99 > 1.0
+
+
+def test_fleet_feed_staleness_eviction():
+    now = [0.0]
+    feed = FleetLatencyFeed(stale_after_s=5.0, clock=lambda: now[0])
+    feed.observe(engine_metrics("w0", 0.1))
+    assert feed.workers() == ["w0"]
+    now[0] = 10.0
+    assert feed.workers() == []
+    assert FLEET_TTFT not in feed.merged()
+
+
+def test_fleet_feed_render_has_help_type():
+    feed = FleetLatencyFeed()
+    feed.observe(engine_metrics("w0", 0.1))
+    text = feed.render()
+    assert f"# TYPE {FLEET_TTFT} histogram" in text
+    assert f"# HELP {FLEET_TTFT}" in text
+    assert "dynamo_fleet_feed_workers 1" in text
+    om = feed.render(openmetrics=True)
+    assert f"# TYPE {FLEET_TTFT} histogram" in om
+
+
+# ---------------------------------------------------------------------------
+# dossier ring + trace 404 taxonomy
+
+
+def _capture(fc: ForensicsCapture, rid: str) -> None:
+    fc.capture_direct(
+        rid, "ttft_breach", {"ttft_s": 1.0, "e2e_s": 2.0}, "w0",
+        {"trace_id": rid, "finished": True,
+         "spans": [{"name": "prefill", "start_s": 1.0,
+                    "duration_s": 0.5}]},
+    )
+
+
+def test_dossier_ring_bounded_eviction():
+    ring = DossierRing(capacity=2)
+    fc = ForensicsCapture(ring, ttft_target_s=0.5, itl_target_s=10.0,
+                          traces=TraceStore())
+    for rid in ("r0", "r1", "r2"):
+        _capture(fc, rid)
+    assert ring.get("r0") is None          # oldest evicted
+    assert ring.get("r2") is not None
+    assert ring.evicted_total == 1
+    assert ring.captured_total == 3
+    idx = ring.index()
+    assert idx["capacity"] == 2
+    assert [o["request_id"] for o in idx["outliers"]] == ["r2", "r1"]
+    assert ring.oldest_id() == "r1"
+
+
+def test_trace_404_distinguishes_evicted_vs_unsampled_vs_never_seen():
+    store = TraceStore(max_completed=1)
+    # unsampled shell, finished without promotion
+    store.start("shell", sampled=False)
+    store.finish("shell")
+    assert store.describe_missing("shell")["reason"] == "unsampled"
+    # two sampled finishes through a 1-slot ring: first one evicted
+    store.start("old", sampled=True)
+    store.finish("old")
+    store.start("new", sampled=True)
+    store.finish("new")
+    gone = store.describe_missing("old")
+    assert gone["reason"] == "evicted"
+    assert gone["ring_capacity"] == 1
+    assert gone["oldest_retained_id"] == "new"
+    assert gone["evicted_total"] == 1
+    assert store.describe_missing("ghost")["reason"] == "never_seen"
+
+
+def test_worker_finish_one_shot_capture():
+    ring = DossierRing(capacity=8)
+    fc = ForensicsCapture(ring, ttft_target_s=0.1, itl_target_s=10.0,
+                          traces=TraceStore())
+    d = fc.worker_finish(
+        "wr-1",
+        timing={"ttft_s": 0.5, "e2e_s": 1.0, "queue_s": 0.2},
+        worker_id="w3",
+        trace_spans=[
+            {"name": "queue", "start_s": 0.0, "duration_s": 0.2},
+            {"name": "prefill", "start_s": 0.2, "duration_s": 0.3},
+        ],
+    )
+    assert d is not None and d.reason == "ttft_breach"
+    got = ring.get("wr-1")
+    assert got is not None
+    assert got.worker_id == "w3"
+    assert len(got.trace["spans"]) == 2
+    assert got.kv_path["queue_wait_s"] == pytest.approx(0.2)
+    # healthy request: no dossier
+    assert fc.worker_finish(
+        "wr-2", timing={"ttft_s": 0.01, "e2e_s": 0.02},
+        worker_id="w3", trace_spans=[]) is None
+    assert ring.get("wr-2") is None
+
+
+def test_shell_trace_promoted_on_breach():
+    """The sampled=False shell path: buffered spans survive a
+    finish-time promotion triggered by on_finish."""
+    store = TraceStore()
+    ring = DossierRing(capacity=8)
+    fc = ForensicsCapture(ring, ttft_target_s=0.1, itl_target_s=10.0,
+                          traces=store)
+    store.start("breach-1", sampled=False)
+    store.add_span("breach-1", Span(
+        name="http", start_s=time.time(), duration_s=0.4))
+    assert fc.on_finish("breach-1", ttft_s=0.9) == "ttft_breach"
+    assert fc.pending("breach-1")
+    tr = store.finish("breach-1")
+    d = fc.on_trace_finished("breach-1", tr)
+    assert d is not None
+    assert d.trace["trace_id"] == "breach-1"
+    assert [s["name"] for s in d.trace["spans"]] == ["http"]
+
+
+# ---------------------------------------------------------------------------
+# overhead: the always-on finish path must stay cheap
+
+
+def test_on_finish_no_capture_overhead_under_budget():
+    """Per-finish cost of the breach check on a healthy request must be
+    ≤5% of a 1 ms request budget (it is a couple of float compares)."""
+    fc = ForensicsCapture(DossierRing(capacity=4), ttft_target_s=10.0,
+                          itl_target_s=10.0, traces=TraceStore())
+    n = 2000
+    t0 = time.perf_counter()
+    for i in range(n):
+        fc.on_finish(f"r{i}", ttft_s=0.01, itl_p95_s=0.001, e2e_s=0.1,
+                     queue_s=0.0)
+    per_call = (time.perf_counter() - t0) / n
+    assert per_call < 50e-6, f"on_finish {per_call*1e6:.1f}us/call"
+
+
+# ---------------------------------------------------------------------------
+# planner: fleet-merged latency trigger
+
+
+class FakeConnector:
+    def __init__(self, n=1):
+        self.n = n
+
+    def current_replicas(self):
+        return self.n
+
+    async def set_replicas(self, n):
+        self.n = n
+
+
+def test_planner_fleet_latency_wave_triggers_scale_up():
+    """A latency wave with calm stream counts: the merged-feed arm
+    scales up; the stream-count-only arm misses it."""
+    from dynamo_tpu.planner import Planner, PlannerConfig
+
+    def build(fleet_ttft_s: float) -> Planner:
+        return Planner(None, FakeConnector(1), PlannerConfig(
+            kv_usage_scale_up=0.8, kv_usage_scale_down=0.01,
+            waiting_scale_up=100, min_replicas=1, max_replicas=4,
+            fleet_ttft_scale_up_s=fleet_ttft_s,
+        ))
+
+    # wave: 20 requests at 1s TTFT, but no queue depth / KV pressure
+    wave = engine_metrics("w0", 1.0, n=20, usage=0.2)
+
+    feed_arm = build(0.3)
+    feed_arm.aggregator.update(wave)
+    feed_arm.fleet_feed.observe(wave)
+    assert feed_arm.decide() == 2          # merged feed sees the wave
+
+    stream_arm = build(0.0)
+    stream_arm.aggregator.update(wave)
+    stream_arm.fleet_feed.observe(wave)
+    assert stream_arm.decide() == 1        # stream counts look calm
+
+    # and the trigger publishes its gauge for scrape-side visibility
+    from dynamo_tpu.planner_metrics import PLANNER
+    assert "dynamo_planner_fleet_ttft_p99_seconds" in PLANNER.render()
+
+
+def test_planner_fleet_queue_trigger():
+    from dynamo_tpu.planner import Planner, PlannerConfig
+
+    planner = Planner(None, FakeConnector(1), PlannerConfig(
+        kv_usage_scale_up=0.8, kv_usage_scale_down=0.01,
+        waiting_scale_up=100, min_replicas=1, max_replicas=4,
+        fleet_queue_scale_up_s=0.5,
+    ))
+    wave = engine_metrics("w0", 2.0, n=20)
+    planner.aggregator.update(wave)
+    planner.fleet_feed.observe(wave)
+    assert planner.decide() == 2
+
+
+# ---------------------------------------------------------------------------
+# e2e: breach -> dossier over a live frontend
+
+
+async def test_breach_to_dossier_e2e():
+    """Every SLO-breaching request yields a servable dossier joining its
+    span tree and timing under one trace_id, discoverable through the
+    exemplar on the TTFT histogram bucket."""
+    TRACES.clear()
+    OUTLIERS.clear()
+    svc = make_forensic_service()
+    svc.forensics._ttft_target_s = 0.0     # any TTFT breaches
+    client = await with_client(svc)
+    try:
+        r = await client.post("/v1/chat/completions", json={
+            "model": "echo",
+            "messages": [{"role": "user", "content": "hello world"}],
+            "max_tokens": 2,
+        })
+        assert r.status == 200
+
+        # the dossier ring lists the breach, newest first
+        r = await client.get("/debug/outliers")
+        idx = await r.json()
+        assert idx["captured_total"] >= 1
+        assert idx["outliers"], idx
+        entry = idx["outliers"][0]
+        rid = entry["request_id"]
+        assert entry["reason"] == "ttft_breach"
+
+        # the full dossier joins trace + timing under that trace_id
+        r = await client.get(f"/debug/outliers/{rid}")
+        assert r.status == 200
+        d = await r.json()
+        assert d["request_id"] == rid
+        assert d["trace"]["trace_id"] == rid
+        assert d["timing"]["ttft_s"] >= 0.0
+        assert "e2e_s" in d["timing"]
+        assert d["trace"]["spans"], "dossier lost the span tree"
+
+        # perfetto export of the same dossier
+        r = await client.get(f"/debug/outliers/{rid}?format=perfetto")
+        perfetto = await r.json()
+        assert perfetto["traceEvents"]
+
+        # OpenMetrics scrape: the TTFT bucket exemplar carries the rid
+        # and resolves to the servable dossier above
+        r = await client.get("/metrics", headers={
+            "Accept": "application/openmetrics-text"})
+        text = await r.text()
+        assert text.rstrip().endswith("# EOF")
+        assert f'# {{trace_id="{rid}"}}' in text
+        assert "dynamo_request_ttft_seconds_bucket" in text
+
+        # plain Prometheus scrape stays exemplar-free
+        r = await client.get("/metrics")
+        plain = await r.text()
+        assert "# {" not in plain
+        assert "# EOF" not in plain
+        # fleet + forensics families render on the frontend surface
+        assert "dynamo_forensics_dossiers_total" in plain
+        assert "dynamo_fleet_feed_workers" in plain
+    finally:
+        await client.close()
+        OUTLIERS.clear()
+        TRACES.clear()
+
+
+async def test_outlier_404_and_trace_404_bodies():
+    TRACES.clear()
+    OUTLIERS.clear()
+    svc = make_forensic_service()
+    client = await with_client(svc)
+    try:
+        r = await client.get("/debug/outliers/ghost")
+        assert r.status == 404
+        body = await r.json()
+        assert body["capacity"] == OUTLIERS.capacity
+        assert "oldest_retained_id" in body
+
+        r = await client.get("/debug/trace/ghost")
+        assert r.status == 404
+        body = await r.json()
+        assert body["reason"] == "never_seen"
+        assert body["ring_capacity"] == TRACES.max_completed
+    finally:
+        await client.close()
+
+
+async def test_healthy_requests_not_captured():
+    """With sane targets and no sampling, a fast request leaves no
+    dossier — the capture path stays dormant."""
+    TRACES.clear()
+    OUTLIERS.clear()
+    svc = make_forensic_service()
+    svc.forensics._ttft_target_s = 60.0
+    svc.forensics._itl_target_s = 60.0
+    client = await with_client(svc)
+    try:
+        r = await client.post("/v1/chat/completions", json={
+            "model": "echo",
+            "messages": [{"role": "user", "content": "hello"}],
+            "max_tokens": 2,
+        })
+        assert r.status == 200
+        r = await client.get("/debug/outliers")
+        idx = await r.json()
+        assert idx["outliers"] == []
+    finally:
+        await client.close()
+        OUTLIERS.clear()
+        TRACES.clear()
+
+
+async def test_sample_rate_captures_healthy_request():
+    """--forensics-sample-rate 1.0: healthy requests get dossiers tagged
+    'sampled' (the comparison baseline)."""
+    TRACES.clear()
+    OUTLIERS.clear()
+    svc = make_forensic_service(forensics_sample_rate=1.0)
+    svc.forensics._ttft_target_s = 60.0
+    svc.forensics._itl_target_s = 60.0
+    client = await with_client(svc)
+    try:
+        r = await client.post("/v1/chat/completions", json={
+            "model": "echo",
+            "messages": [{"role": "user", "content": "hello"}],
+            "max_tokens": 2,
+        })
+        assert r.status == 200
+        r = await client.get("/debug/outliers")
+        idx = await r.json()
+        assert idx["outliers"]
+        assert idx["outliers"][0]["reason"] == "sampled"
+    finally:
+        await client.close()
+        OUTLIERS.clear()
+        TRACES.clear()

@@ -190,10 +190,51 @@ def test_readme_documents_canonical_series():
         "dynamo_host_round_coverage_ratio",
         "dynamo_slo_ttft_burn_rate",
         "dynamo_slo_itl_burn_rate",
+        # tail-latency forensics (dynamo_tpu/telemetry/forensics.py)
+        "dynamo_forensics_dossiers_total",
+        "dynamo_forensics_breaches_total",
+        "dynamo_forensics_sampled_total",
+        "dynamo_forensics_dossiers_evicted_total",
+        "dynamo_forensics_ring_size",
+        # fleet-merged latency feed (dynamo_tpu/telemetry/fleet_feed.py)
+        "dynamo_fleet_request_ttft_seconds",
+        "dynamo_fleet_request_itl_seconds",
+        "dynamo_fleet_request_e2e_seconds",
+        "dynamo_fleet_request_queue_seconds",
+        "dynamo_fleet_engine_round_seconds",
+        "dynamo_fleet_feed_workers",
+        "dynamo_planner_fleet_ttft_p99_seconds",
+        "dynamo_planner_fleet_queue_p99_seconds",
     ):
         assert name in readme, f"{name} missing from README"
-    for endpoint in ("/debug/trace", "/debug/flight", "/debug/prof"):
+    for endpoint in ("/debug/trace", "/debug/flight", "/debug/prof",
+                     "/debug/outliers"):
         assert endpoint in readme
+
+
+def test_forensics_and_fleet_families_on_all_three_surfaces():
+    """The new forensics counters and the fleet-merged histograms render
+    with HELP/TYPE on every scrape surface."""
+    from dynamo_tpu.frontend.service import HttpService
+    from dynamo_tpu.metrics_exporter import MetricsExporter
+    from dynamo_tpu.runtime.system_server import SystemServer
+
+    from dynamo_tpu.telemetry.fleet_feed import FLEET_FEED
+    from dynamo_tpu.telemetry.forensics import FORENSICS
+
+    exp = MetricsExporter(kv=None)
+    exp.aggregator.update(_StubEngine().metrics())
+    svc = HttpService()
+    frontend = (svc.metrics.render().decode() + svc.telemetry.render()
+                + FLEET_FEED.render() + FORENSICS.render())
+    for text in (
+        SystemServer(_StubEngine(), worker_id="w0").render(),
+        exp.render(),
+        frontend,
+    ):
+        assert "# TYPE dynamo_forensics_dossiers_total counter" in text
+        assert "# TYPE dynamo_forensics_ring_size gauge" in text
+        assert "# TYPE dynamo_fleet_feed_workers gauge" in text
 
 
 def test_prof_families_on_all_three_surfaces():

@@ -16,10 +16,18 @@ Usage:
         [--flight http://HOST:PORT/debug/flight] [-o trace.json]
     python tools/trace_export.py trace_debug.json -o trace.json
     curl -s .../debug/trace/ID | python tools/trace_export.py - -o out.json
+    python tools/trace_export.py --base http://HOST:PORT --request REQ_ID
+    python tools/trace_export.py --base http://HOST:PORT --outlier 0
 
 A file/stdin source may be either a raw trace dict ({"trace_id", "spans"})
 or a pre-merged bundle {"trace": ..., "flight": [...], "stream": [...],
 "rounds": [[end_s, wall_s, [seg_s, ...]], ...]}.
+
+Forensics modes (--base): ``--request <id>`` fetches the SLO-breach
+dossier at /debug/outliers/<id> — already a pre-merged bundle with the
+request's clipped host rounds and flight/stream events — falling back to
+/debug/trace/<id> when no dossier was captured; ``--outlier <n>`` picks
+the n-th most recent entry from the /debug/outliers index (0 = newest).
 """
 from __future__ import annotations
 
@@ -77,13 +85,43 @@ def build(
     )
 
 
+def resolve_forensics(
+    base: str, request: Optional[str], outlier: Optional[int]
+) -> dict[str, Any]:
+    """Fetch a dossier bundle from a frontend/system-server ``base``
+    URL: by request id (dossier first, raw trace fallback) or by index
+    into the outlier ring (0 = newest)."""
+    base = base.rstrip("/")
+    if outlier is not None:
+        index = load(f"{base}/debug/outliers")
+        entries = index.get("outliers") or []
+        if outlier >= len(entries):
+            return {"error": f"outlier index {outlier} out of range "
+                             f"({len(entries)} retained)"}
+        request = entries[outlier]["request_id"]
+    try:
+        return load(f"{base}/debug/outliers/{request}")
+    except Exception:  # noqa: BLE001 — 404s fall through to the raw trace
+        return load(f"{base}/debug/trace/{request}")
+
+
 def main(argv: list[str]) -> int:
     ap = argparse.ArgumentParser(
         description=__doc__,
         formatter_class=argparse.RawDescriptionHelpFormatter,
     )
-    ap.add_argument("source",
+    ap.add_argument("source", nargs="?", default=None,
                     help="/debug/trace URL, JSON file, or - for stdin")
+    ap.add_argument("--base", default=None,
+                    help="frontend/system-server base URL for the "
+                         "forensics modes (--request / --outlier)")
+    ap.add_argument("--request", default=None,
+                    help="with --base: export this request id's dossier "
+                         "(/debug/outliers/<id>), falling back to its "
+                         "raw /debug/trace")
+    ap.add_argument("--outlier", type=int, default=None,
+                    help="with --base: export the n-th most recent "
+                         "outlier dossier (0 = newest)")
     ap.add_argument("--flight", default=None,
                     help="optional /debug/flight URL or JSON file to "
                          "merge as instant events")
@@ -91,7 +129,16 @@ def main(argv: list[str]) -> int:
                     help="output path (default trace.json); - for stdout")
     args = ap.parse_args(argv)
 
-    doc = load(args.source)
+    if args.base is not None:
+        if args.request is None and args.outlier is None:
+            ap.error("--base needs --request or --outlier")
+        doc = resolve_forensics(args.base, args.request, args.outlier)
+    elif args.source is None:
+        ap.error("a source (or --base with --request/--outlier) is "
+                 "required")
+        return 2  # unreachable; ap.error raises
+    else:
+        doc = load(args.source)
     if "error" in doc:
         print(f"error: {doc['error']}", file=sys.stderr)
         return 1
