@@ -780,6 +780,15 @@ def main():
               "overload_gave_up", "overload_admitted_on",
               "overload_admitted_off", "overload_token_equal",
               "overload_error",
+              # multi_tenant phase (bench_modes.
+              # multi_tenant_experiment): tenant-A storm vs tenant-B
+              # interactive TTFT isolation (< 20% move enforced in the
+              # phase itself), per-tenant quota bounces with
+              # tenant-derived Retry-After, token-identity
+              "tenant_b_ttft_p99_alone_ms", "tenant_b_ttft_p99_storm_ms",
+              "tenant_b_ttft_move_pct", "tenant_a_bounces",
+              "tenant_a_storm_done", "tenant_retry_after_mean_s",
+              "tenant_token_equal", "multi_tenant_error",
               # forensics phase (bench_modes.forensics_experiment):
               # SLO-breach dossier capture under the storm — every
               # breaching request joins spans+KV path under its id,

@@ -27,6 +27,15 @@ retriable-end-to-end story), and whether every admitted stream's
 tokens match an unloaded run of the same prompt (exactly-once: no
 duplicate or lost tokens through bounce/retry).
 
+``multi_tenant`` — the tenant-isolation experiment (dynamo_tpu/
+tenancy/): tenant A storms a small fleet in three waves while tenant
+B's interactive traffic keeps arriving. Per-tenant quotas bounce A's
+overflow with A's OWN queue-derived Retry-After (the bounce carries the
+tenant key end to end) and weighted fair share keeps B near the queue
+head; the phase asserts B's TTFT p99 moves < 20% vs a B-alone baseline
+(RuntimeError on violation) and that every admitted stream is
+token-identical to an unloaded run.
+
 ``forensics`` — the tail-latency-forensics experiment (telemetry/
 forensics.py): the overload-style storm with SLO-breach dossier capture
 on — every breaching request must land a dossier joining its merged
@@ -399,6 +408,200 @@ async def overload_experiment(
         "overload_admitted_on": on["admitted"],
         "overload_admitted_off": off["admitted"],
         "overload_token_equal": on["token_equal"] and off["token_equal"],
+    }
+
+
+async def multi_tenant_experiment(
+    n_workers: int = 2,
+    n_storm: int = 30,
+    n_interactive: int = 6,
+    storm_prompt_tokens: int = 16,
+    interactive_prompt_tokens: int = 512,
+    tenant_max_waiting: int = 2,
+    block_size: int = 16,
+    max_client_retries: int = 6,
+    max_move_pct: float = 20.0,
+) -> dict:
+    """Tenant-isolation experiment (the tenancy plane): tenant A storms
+    the fleet in three waves while tenant B's interactive traffic keeps
+    arriving. Per-tenant quotas bounce A's overflow with a Retry-After
+    derived from A's OWN queue waits (the bounce carries A's tenant
+    key), and weighted fair share keeps B near the queue head — so B's
+    TTFT p99 must move < ``max_move_pct``% vs a B-alone baseline.
+    RuntimeError on violation; admitted streams must stay
+    token-identical to unloaded runs."""
+    from dynamo_tpu.kv_router.router import KvPushRouter, KvRouter
+    from dynamo_tpu.kv_router.scheduler import KvRouterConfig
+    from dynamo_tpu.mocker import MockerArgs, MockerEngine
+    from dynamo_tpu.overload import EngineOverloadedError
+    from dynamo_tpu.protocols.common import (
+        PreprocessedRequest,
+        StopConditions,
+    )
+
+    rng = np.random.RandomState(23)
+    storm_prompts = [
+        rng.randint(1, 10_000, size=storm_prompt_tokens).tolist()
+        for _ in range(n_storm)
+    ]
+    live_prompts = [
+        rng.randint(1, 10_000, size=interactive_prompt_tokens).tolist()
+        for _ in range(n_interactive)
+    ]
+
+    def req_for(prompt, tenant, out_tokens):
+        req = PreprocessedRequest(
+            token_ids=list(prompt),
+            stop_conditions=StopConditions(max_tokens=out_tokens,
+                                           ignore_eos=True),
+        )
+        req.tenant = tenant
+        return req
+
+    def make_args(wid: str) -> "MockerArgs":
+        # A's short requests are cheap next to B's long prefill, so the
+        # residual slot wait B can't avoid stays far inside the bound
+        return MockerArgs(
+            num_pages=1024, page_size=block_size, max_decode_slots=2,
+            max_pages_per_seq=64, worker_id=wid,
+            prefill_time_per_token_s=0.0004,
+            decode_time_per_step_s=0.001,
+            tenant_max_waiting_requests=tenant_max_waiting,
+            tenant_weights={"tenant-b": 4.0},
+        )
+
+    # unloaded reference streams: the token-identity oracle
+    ref_eng = MockerEngine(make_args("ref"))
+    storm_refs, live_refs = [], []
+    for p in storm_prompts:
+        toks = []
+        async for out in ref_eng.generate(req_for(p, "tenant-a", 8)):
+            toks.extend(out.token_ids)
+        storm_refs.append(toks)
+    for p in live_prompts:
+        toks = []
+        async for out in ref_eng.generate(req_for(p, "tenant-b", 4)):
+            toks.extend(out.token_ids)
+        live_refs.append(toks)
+    await ref_eng.stop()
+
+    async def run(with_storm: bool) -> dict:
+        router = KvRouter(block_size,
+                          KvRouterConfig(router_temperature=0.0))
+        push = KvPushRouter(router)
+        engines = []
+        for i in range(n_workers):
+            eng = MockerEngine(make_args(f"w{i}"),
+                               on_kv_event=router.indexer.apply_event)
+            engines.append(eng)
+            push.add_worker(f"w{i}", eng)
+        b_ttfts: list[float] = []
+        token_ok = True
+        bounces = 0
+        bounce_tenants: set = set()
+        retry_afters: list[float] = []
+        storm_done = 0
+
+        async def storm_one(idx: int) -> None:
+            nonlocal bounces, token_ok, storm_done
+            for _attempt in range(max_client_retries + 1):
+                toks: list[int] = []
+                try:
+                    async for out in push.generate(
+                        req_for(storm_prompts[idx], "tenant-a", 8)
+                    ):
+                        toks.extend(out.token_ids)
+                except EngineOverloadedError as e:
+                    # the per-tenant bounce: must carry A's tenant key
+                    # and A's own queue-derived Retry-After
+                    bounces += 1
+                    bounce_tenants.add(getattr(e, "tenant", ""))
+                    retry_afters.append(float(e.retry_after_s))
+                    await asyncio.sleep(min(e.retry_after_s, 0.25))
+                    continue
+                token_ok = token_ok and toks == storm_refs[idx]
+                storm_done += 1
+                return
+
+        async def storm() -> None:
+            wave = max(1, n_storm // 3)
+            tasks = []
+            for w in range(0, n_storm, wave):
+                tasks += [asyncio.ensure_future(storm_one(i))
+                          for i in range(w, min(w + wave, n_storm))]
+                await asyncio.sleep(0.03)
+            await asyncio.gather(*tasks)
+
+        async def interactive() -> None:
+            nonlocal token_ok
+            for i in range(n_interactive):
+                t0 = time.monotonic()
+                first = None
+                toks: list[int] = []
+                async for out in push.generate(
+                    req_for(live_prompts[i], "tenant-b", 4)
+                ):
+                    if first is None and out.token_ids:
+                        first = time.monotonic() - t0
+                    toks.extend(out.token_ids)
+                if first is not None:
+                    b_ttfts.append(first)
+                token_ok = token_ok and toks == live_refs[i]
+
+        if with_storm:
+            await asyncio.gather(storm(), interactive())
+        else:
+            await interactive()
+        for eng in engines:
+            await eng.stop()
+        b_ttfts.sort()
+        return {
+            "b_ttft_p99_s": (
+                b_ttfts[min(len(b_ttfts) - 1, int(0.99 * len(b_ttfts)))]
+                if b_ttfts else None
+            ),
+            "bounces": bounces,
+            "bounce_tenants": bounce_tenants,
+            "retry_afters": retry_afters,
+            "storm_done": storm_done,
+            "token_ok": token_ok,
+        }
+
+    base = await run(with_storm=False)
+    loaded = await run(with_storm=True)
+
+    if not (base["token_ok"] and loaded["token_ok"]):
+        raise RuntimeError(
+            "multi_tenant: admitted streams diverged from unloaded runs")
+    if loaded["bounces"] == 0:
+        raise RuntimeError(
+            "multi_tenant: the storm never hit the tenant quota — the "
+            "experiment measured nothing")
+    if loaded["bounce_tenants"] != {"tenant-a"}:
+        raise RuntimeError(
+            "multi_tenant: quota bounces leaked outside the storming "
+            f"tenant: {sorted(loaded['bounce_tenants'])}")
+    if any(r <= 0 for r in loaded["retry_afters"]):
+        raise RuntimeError(
+            "multi_tenant: a per-tenant bounce shipped no Retry-After")
+    move_pct = (
+        (loaded["b_ttft_p99_s"] - base["b_ttft_p99_s"])
+        / base["b_ttft_p99_s"] * 100.0
+    )
+    if move_pct >= max_move_pct:
+        raise RuntimeError(
+            f"multi_tenant: tenant-B TTFT p99 moved {move_pct:.1f}% "
+            f"under tenant-A's storm (bound {max_move_pct:.0f}%)")
+    return {
+        "tenant_b_ttft_p99_alone_ms": round(base["b_ttft_p99_s"] * 1e3, 2),
+        "tenant_b_ttft_p99_storm_ms": round(
+            loaded["b_ttft_p99_s"] * 1e3, 2),
+        "tenant_b_ttft_move_pct": round(move_pct, 2),
+        "tenant_a_bounces": loaded["bounces"],
+        "tenant_a_storm_done": loaded["storm_done"],
+        "tenant_retry_after_mean_s": round(
+            sum(loaded["retry_afters"]) / len(loaded["retry_afters"]), 3),
+        "tenant_token_equal": True,
     }
 
 
@@ -1876,6 +2079,18 @@ def main():
         out.update(asyncio.run(overload_experiment()))
     except Exception as e:  # noqa: BLE001 — best-effort phase
         out["overload_error"] = str(e)[:200]
+    try:
+        # wall-clock isolation bound on shared CPU: same retry rationale
+        # as disagg/prefix_economy — a real regression loses 3/3
+        for attempt in range(3):
+            try:
+                out.update(asyncio.run(multi_tenant_experiment()))
+                break
+            except RuntimeError:
+                if attempt == 2:
+                    raise
+    except Exception as e:  # noqa: BLE001 — best-effort phase
+        out["multi_tenant_error"] = str(e)[:200]
     try:
         out.update(asyncio.run(forensics_experiment()))
     except Exception as e:  # noqa: BLE001 — best-effort phase

@@ -225,6 +225,25 @@ class EngineConfig:
     # the differential harness (tests/test_kv_quant).
     kv_quant: str = "none"
 
+    # multi-tenant serving plane (dynamo_tpu/tenancy/).
+    # Resident LoRA adapter bank: >0 allocates a bank of this many
+    # adapter slots (row 0 is the all-zeros identity = the base model)
+    # at rank lora_rank, riding inside the params pytree so every jitted
+    # program (fused round, prefill, batched prefill) serves mixed
+    # adapter ids with zero extra dispatches. 0 = no bank: the engine
+    # traces the identical pre-tenancy programs.
+    lora_adapters: int = 0
+    lora_rank: int = 8
+    # per-tenant slices of the overload-plane backlog budgets (0 =
+    # unbounded). One tenant's storm exhausts ITS slice — and bounces
+    # with a Retry-After from that tenant's own observed queue waits —
+    # before it can crowd the global queue.
+    tenant_max_waiting_requests: int = 0
+    tenant_max_waiting_prefill_tokens: int = 0
+    # fair-share weights for the SFQ dequeue order (tenant -> weight,
+    # default 1.0); weights bias ordering, not the budgets above
+    tenant_weights: Optional[dict] = None
+
     # fleet prefix economy (kv_router/fleet.py): when the frontend's
     # hint digest is applied, dedup-by-hash admission consults it before
     # a G4 probe round — fleet-known holders are probed first, and a

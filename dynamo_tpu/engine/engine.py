@@ -95,6 +95,8 @@ from dynamo_tpu.telemetry import metrics as tmetrics
 from dynamo_tpu.telemetry import prof as tprof
 from dynamo_tpu.telemetry.prof import PROF, RoundProf
 from dynamo_tpu.telemetry.trace import Span, span_now
+from dynamo_tpu.tenancy.metrics import TENANT
+from dynamo_tpu.tenancy.quotas import TenantQuotas
 from dynamo_tpu.tokens import TokenBlockSequence
 
 log = logging.getLogger(__name__)
@@ -201,6 +203,19 @@ class _Request:
     # annotation — lifts the round-span cap so late (finish-time) trace
     # promotion still sees the full decode path
     trace_detail: bool = False
+    # tenancy plane: SFQ virtual finish-time stamp minted at enqueue
+    # (tenant virtual clock + prompt cost / weight) — orders
+    # same-priority waiting entries so a storming tenant self-paces
+    # behind its own stamps (see _enqueue_waiting)
+    vft: float = 0.0
+
+    @property
+    def tenant(self) -> str:
+        return getattr(self.req, "tenant", "") or "default"
+
+    @property
+    def adapter_id(self) -> int:
+        return int(getattr(self.req, "adapter_id", 0) or 0)
 
     @property
     def prompt_len(self) -> int:
@@ -347,6 +362,26 @@ class TpuEngine:
         if params is None:
             params = llama.init_params(c, rng_seed)
         self.params = jax.tree.map(lambda x, s: jax.device_put(x, s), params, p_sh)
+        # resident LoRA adapter bank (tenancy plane): rides INSIDE the
+        # params pytree so every jitted program carries it with zero
+        # signature churn — the model fns look it up via
+        # params.get("adapters") (a trace-time presence check; engines
+        # without a bank trace the identical pre-tenancy programs). Row
+        # 0 is the all-zeros identity = the base model, exactly.
+        self.n_adapters = max(0, e.lora_adapters)
+        if self.n_adapters > 0:
+            from dynamo_tpu.tenancy.adapters import (
+                init_adapter_bank,
+                replicate_bank,
+            )
+
+            self.params = dict(
+                self.params,
+                adapters=replicate_bank(
+                    init_adapter_bank(c, self.n_adapters, e.lora_rank),
+                    self.mesh,
+                ),
+            )
         # paged pool: prefix-cache STORAGE (sealed blocks copied in,
         # admission prefixes copied out — models/llama.py module doc)
         self.cache = jax.tree.map(
@@ -514,6 +549,10 @@ class TpuEngine:
             "freq": jnp.zeros(B, jnp.float32),
             "pres": jnp.zeros(B, jnp.float32),
             "rep": jnp.ones(B, jnp.float32),
+            # per-slot resident LoRA bank row (0 = identity base model);
+            # gathered inside the fused round program — mixed adapters
+            # in one decode batch cost zero extra dispatches
+            "adapter": jnp.zeros(B, jnp.int32),
         }
 
         self._build_jits()
@@ -551,6 +590,24 @@ class TpuEngine:
         )
         self._waiting_tokens = 0
         self._wt_lock = threading.Lock()
+        # tenancy plane (dynamo_tpu/tenancy/): per-tenant slices of the
+        # backlog budgets + SFQ fair-share state. The per-tenant
+        # counters ride the same `counted` flag / _wt_lock as
+        # _waiting_tokens (inc at intake, dec exactly once at lane
+        # acquisition or queue exit).
+        self.tenant_quotas = TenantQuotas(
+            e.tenant_max_waiting_requests,
+            e.tenant_max_waiting_prefill_tokens,
+            weights=e.tenant_weights,
+        )
+        self._tenant_waiting: dict[str, int] = {}
+        self._tenant_tokens: dict[str, int] = {}
+        # SFQ virtual clocks: per-tenant virtual finish time of the last
+        # enqueued request, and the global clock advanced as requests
+        # start service — a light tenant's fresh arrival stamps near the
+        # global clock, i.e. near the queue head (engine thread only)
+        self._tenant_vnow: dict[str, float] = {}
+        self._vclock = 0.0
         self.sheds = 0                # deadline-expired waiting requests
         self.waiting_preemptions = 0  # waiting entries evicted by priority
         self.preempt_migrations = 0   # running streams force-migrated
@@ -672,7 +729,7 @@ class TpuEngine:
                 ring, dev, toks_out, lp_out = carry
                 ring, logits = llama.decode_step_impl(
                     c, params, ctx_kv, ring, dev["tokens"], dev["ctx"],
-                    ring_base, s, live,
+                    ring_base, s, live, dev["adapter"],
                 )
                 if want_sample:
                     toks, st = sampling.sample_step_impl(
@@ -744,10 +801,11 @@ class TpuEngine:
         def patch(dev, clear_mask, admit_meta, admit_tok, admit_keys,
                   admit_counts):
             """State patch (releases + one admission). ``admit_meta`` is
-            ONE packed f32[8] row — [slot, ctx, temp, top_k, top_p, freq,
-            pres, rep] — instead of ten scalar device_puts per admission
-            (every int here is exact in f32; ctx < 2^24). slot == B is
-            the no-admission sentinel: every .at[] update is dropped."""
+            ONE packed f32[9] row — [slot, ctx, temp, top_k, top_p, freq,
+            pres, rep, adapter] — instead of eleven scalar device_puts
+            per admission (every int here is exact in f32; ctx and
+            adapter ids < 2^24). slot == B is the no-admission sentinel:
+            every .at[] update is dropped."""
             B = dev["tokens"].shape[0]
             dev = dict(dev)
             dev["ctx"] = jnp.where(clear_mask, 1, dev["ctx"])
@@ -759,6 +817,7 @@ class TpuEngine:
             dev["dest"] = jnp.where(
                 clear_mask, B, dev["dest"]
             ).astype(jnp.int32)
+            dev["adapter"] = jnp.where(clear_mask, 0, dev["adapter"])
             s = admit_meta[0].astype(jnp.int32)
             dev["tokens"] = dev["tokens"].at[s].set(admit_tok[0])
             dev["ctx"] = dev["ctx"].at[s].set(admit_meta[1].astype(jnp.int32))
@@ -775,6 +834,9 @@ class TpuEngine:
             dev["freq"] = dev["freq"].at[s].set(admit_meta[5])
             dev["pres"] = dev["pres"].at[s].set(admit_meta[6])
             dev["rep"] = dev["rep"].at[s].set(admit_meta[7])
+            dev["adapter"] = dev["adapter"].at[s].set(
+                admit_meta[8].astype(jnp.int32)
+            )
             return dev
 
         @functools.partial(jax.jit, static_argnums=(5, 6))
@@ -871,6 +933,28 @@ class TpuEngine:
                 log.exception("commit listener failed")
 
     # ------------------------------------------------------------------
+    # tenancy plane: resident adapters
+
+    def install_adapter(self, adapter_id: int, weights: dict) -> None:
+        """Install one fine-tune variant's LoRA factors into the
+        resident bank (site -> {"a": [L, d_in, r], "b": [L, r, d_out]}).
+        Swapping the bank is a pure buffer replacement — shapes/dtypes
+        are unchanged, so no jitted program retraces."""
+        from dynamo_tpu.tenancy.adapters import replicate_bank, set_adapter
+
+        bank = (self.params or {}).get("adapters")
+        if bank is None:
+            raise ValueError(
+                "engine has no adapter bank (EngineConfig.lora_adapters=0)"
+            )
+        self.params = dict(
+            self.params,
+            adapters=replicate_bank(
+                set_adapter(bank, adapter_id, weights), self.mesh
+            ),
+        )
+
+    # ------------------------------------------------------------------
     # AsyncEngine surface
 
     async def generate(
@@ -891,6 +975,13 @@ class TpuEngine:
             raise ValueError(
                 f"prompt length {len(request.token_ids)} exceeds max context "
                 f"{self.ecfg.max_context}"
+            )
+        tenant = getattr(request, "tenant", "") or "default"
+        adapter_id = int(getattr(request, "adapter_id", 0) or 0)
+        if adapter_id and not (0 < adapter_id < max(1, self.n_adapters)):
+            raise ValueError(
+                f"adapter_id {adapter_id} out of range: engine bank has "
+                f"{self.n_adapters} adapter slots"
             )
         # overload plane: a deadline that expired before intake is shed
         # immediately — zero tokens, the DEADLINE finish reason, never an
@@ -920,7 +1011,25 @@ class TpuEngine:
             except EngineOverloadedError:
                 if request.priority < PRIORITY_HIGH:
                     OVERLOAD.inc("dynamo_overload_rejected_total")
+                    TENANT.inc("dynamo_tenant_rejected_total", tenant)
                     raise
+        # per-tenant admission slice: one tenant's storm exhausts its
+        # OWN budget (429 + Retry-After derived from that tenant's own
+        # queue waits) before it can crowd the global queue. HIGH
+        # priority is force-admitted like the global check —
+        # _enforce_bounds restores the budget from the same tenant.
+        if self.tenant_quotas.bounded:
+            with self._wt_lock:
+                t_waiting = self._tenant_waiting.get(tenant, 0)
+                t_tokens = self._tenant_tokens.get(tenant, 0)
+            try:
+                self.tenant_quotas.check(tenant, t_waiting, t_tokens)
+            except EngineOverloadedError:
+                if request.priority < PRIORITY_HIGH:
+                    OVERLOAD.inc("dynamo_overload_rejected_total")
+                    TENANT.inc("dynamo_tenant_rejected_total", tenant)
+                    raise
+        TENANT.inc("dynamo_tenant_admitted_total", tenant)
         # multimodal requests salt their block hashes with the image digest:
         # placeholder tokens are identical across different images, and a
         # prefix-cache hit keyed on tokens alone would serve the wrong
@@ -943,6 +1052,12 @@ class TpuEngine:
         r.counted = True
         with self._wt_lock:
             self._waiting_tokens += len(r.tokens)
+            self._tenant_waiting[tenant] = (
+                self._tenant_waiting.get(tenant, 0) + 1
+            )
+            self._tenant_tokens[tenant] = (
+                self._tenant_tokens.get(tenant, 0) + len(r.tokens)
+            )
         self._intake.put(r)
         self._wake_evt.set()
         try:
@@ -1400,6 +1515,15 @@ class TpuEngine:
         # process-level overload gauges (all three scrape surfaces)
         OVERLOAD.set("dynamo_overload_queue_depth", num_waiting)
         OVERLOAD.set("dynamo_overload_queue_tokens", waiting_tokens)
+        # tenant-sliced backlog gauges
+        with self._wt_lock:
+            t_waiting = dict(self._tenant_waiting)
+            t_tokens = dict(self._tenant_tokens)
+        for t in set(t_waiting) | set(t_tokens):
+            TENANT.set("dynamo_tenant_queue_depth", t,
+                       t_waiting.get(t, 0))
+            TENANT.set("dynamo_tenant_queue_tokens", t,
+                       t_tokens.get(t, 0))
         # pool capacity in blocks: the kv_quant=int8 headline — the same
         # HBM budget holds ~2x the blocks of a bf16 pool
         KV_QUANT.set("dynamo_kv_pool_capacity_blocks", a.total_pages)
@@ -1460,6 +1584,36 @@ class TpuEngine:
                 ),
             ),
         )
+
+    def tenant_debug(self) -> dict:
+        """Per-tenant quota/backlog/metric view — the engine half of the
+        /debug/tenants surface (runtime/system_server.py; the frontend
+        merges its own HTTP-side slice)."""
+        with self._wt_lock:
+            t_waiting = dict(self._tenant_waiting)
+            t_tokens = dict(self._tenant_tokens)
+        quotas = self.tenant_quotas.snapshot()
+        metrics_snap = TENANT.snapshot()
+        tenants: dict[str, dict[str, Any]] = {}
+        for t in (set(t_waiting) | set(t_tokens) | set(quotas)
+                  | set(metrics_snap)):
+            tenants[t] = {
+                "waiting_requests": t_waiting.get(t, 0),
+                "waiting_prefill_tokens": t_tokens.get(t, 0),
+                **(quotas.get(t) or {}),
+                "metrics": metrics_snap.get(t, {}),
+            }
+        return {
+            "bounded": self.tenant_quotas.bounded,
+            "max_waiting_requests": (
+                self.ecfg.tenant_max_waiting_requests
+            ),
+            "max_waiting_prefill_tokens": (
+                self.ecfg.tenant_max_waiting_prefill_tokens
+            ),
+            "n_adapters": self.n_adapters,
+            "tenants": tenants,
+        }
 
     # ------------------------------------------------------------------
     # engine loop
@@ -1753,14 +1907,35 @@ class TpuEngine:
                 return
 
     def _enqueue_waiting(self, r: _Request) -> None:
-        """FIFO within a priority class; a high-priority arrival queues
-        ahead of every lower-priority entry that has NOT started prefill
-        (entries holding a lane are active work, never jumped)."""
+        """Weighted fair share (SFQ) within a priority class; a
+        high-priority arrival still queues ahead of every lower-priority
+        entry that has NOT started prefill (entries holding a lane are
+        active work, never jumped).
+
+        Each request is stamped with a virtual finish time — the
+        tenant's virtual clock advanced by prompt-cost / weight — and
+        inserts before the first not-started same-priority entry with a
+        LARGER stamp. A storming tenant's backlog carries ever-growing
+        stamps while a light tenant's fresh arrival starts at the global
+        virtual clock (advanced at service start, _note_queue_wait), so
+        it lands near the head. Single-tenant traffic degrades to exact
+        FIFO: one tenant's stamps are monotonic by construction."""
+        t = r.tenant
+        vstart = max(self._tenant_vnow.get(t, 0.0), self._vclock)
+        r.vft = vstart + max(1, len(r.tokens)) / self.tenant_quotas.weight(t)
+        self._tenant_vnow[t] = r.vft
         if r.req.priority > 0:
             for i, w in enumerate(self._waiting):
                 if w.prefill_pos < 0 and w.req.priority < r.req.priority:
                     self._waiting.insert(i, r)
                     return
+            self._waiting.append(r)
+            return
+        for i, w in enumerate(self._waiting):
+            if (w.prefill_pos < 0 and w.req.priority == r.req.priority
+                    and w.vft > r.vft):
+                self._waiting.insert(i, r)
+                return
         self._waiting.append(r)
 
     # ---- overload plane: budgets, deadline shedding, preemption ----
@@ -1771,8 +1946,15 @@ class TpuEngine:
         if not r.counted:
             return
         r.counted = False
+        t = r.tenant
         with self._wt_lock:
             self._waiting_tokens -= len(r.tokens)
+            self._tenant_waiting[t] = max(
+                0, self._tenant_waiting.get(t, 0) - 1
+            )
+            self._tenant_tokens[t] = max(
+                0, self._tenant_tokens.get(t, 0) - len(r.tokens)
+            )
 
     def _shed_waiting(self, r: _Request, reason: str) -> None:
         """Drop a still-WAITING request from the queue. ``deadline``
@@ -1793,11 +1975,22 @@ class TpuEngine:
                 }},
             ))
         else:
+            t = r.tenant
+            TENANT.inc("dynamo_tenant_shed_total", t)
+            if self.tenant_quotas.bounded:
+                # pressure is tenant-confined, so the hint is too: this
+                # tenant's own queue-wait p50 x its own backlog depth
+                with self._wt_lock:
+                    t_waiting = self._tenant_waiting.get(t, 0)
+                retry = self.tenant_quotas.retry_after_s(t, t_waiting)
+            else:
+                retry = self.admission.retry_after_s(
+                    sum(1 for w in self._waiting if w.slot < 0)
+                )
             r.emit(EngineOverloadedError(
                 f"request shed while waiting ({reason})",
-                retry_after_s=self.admission.retry_after_s(
-                    sum(1 for w in self._waiting if w.slot < 0)
-                ),
+                retry_after_s=retry,
+                tenant=t,
             ))
 
     def _enforce_bounds(self) -> None:
@@ -1806,6 +1999,7 @@ class TpuEngine:
         waiting entry until the backlog fits. When every candidate has
         the same priority there is no one to preempt FOR — the newest
         arrival bounces instead (the budget stays honest either way)."""
+        self._enforce_tenant_bounds()
         adm = self.admission
         if not adm.bounded:
             return
@@ -1837,6 +2031,48 @@ class TpuEngine:
                 self._shed_waiting(victim, "queue budget exceeded")
             self._waiting.remove(victim)
 
+    def _enforce_tenant_bounds(self) -> None:
+        """Per-tenant half of _enforce_bounds: a HIGH-priority arrival
+        force-admitted past its tenant's budget is paid for WITHIN that
+        tenant — the victim is always the offending tenant's own
+        lowest-priority, newest waiting entry, never another tenant's
+        work."""
+        tq = self.tenant_quotas
+        if not tq.bounded:
+            return
+        while True:
+            by_tenant: dict[str, list[_Request]] = {}
+            for r in self._waiting:
+                if r.prefill_pos < 0 and not r.cancelled and not r.finished:
+                    by_tenant.setdefault(r.tenant, []).append(r)
+            victim = None
+            for t, rs in by_tenant.items():
+                toks = sum(len(r.tokens) for r in rs)
+                over = ((tq.max_waiting_requests
+                         and len(rs) > tq.max_waiting_requests)
+                        or (tq.max_waiting_prefill_tokens
+                            and toks > tq.max_waiting_prefill_tokens))
+                if not over:
+                    continue
+                lo = min(r.req.priority for r in rs)
+                hi = max(r.req.priority for r in rs)
+                victim = max(
+                    (r for r in rs if r.req.priority == lo),
+                    key=lambda r: r.enqueue_time,
+                )
+                if lo < hi:
+                    self.waiting_preemptions += 1
+                    OVERLOAD.inc("dynamo_overload_preempted_total")
+                    self._shed_waiting(victim, "preempted by priority "
+                                               "(tenant budget)")
+                else:
+                    OVERLOAD.inc("dynamo_overload_rejected_total")
+                    self._shed_waiting(victim, "tenant budget exceeded")
+                self._waiting.remove(victim)
+                break
+            if victim is None:
+                return
+
     def _maybe_preempt_running(self) -> None:
         """Running half of priority preemption (behind
         ``preempt_running``): a HIGH-priority request blocked on a lane
@@ -1865,6 +2101,21 @@ class TpuEngine:
         ]
         if not victims:
             return
+        # tenant-confined preference: when tenant budgets are set, a
+        # victim is drawn from a tenant that is OVER its own budget
+        # whenever one is running — an innocent tenant's stream is only
+        # preempted when no over-budget tenant holds a lane
+        if self.tenant_quotas.bounded:
+            with self._wt_lock:
+                tw = dict(self._tenant_waiting)
+                tt = dict(self._tenant_tokens)
+            over = [
+                v for v in victims
+                if self.tenant_quotas.over_budget(
+                    tw.get(v.tenant, 0), tt.get(v.tenant, 0))
+            ]
+            if over:
+                victims = over
         lo = min(v.req.priority for v in victims)
         victim = max(
             (v for v in victims if v.req.priority == lo),
@@ -1974,6 +2225,17 @@ class TpuEngine:
         self._ctx_disp[active] = np.minimum(
             self._ctx_disp[active] + n, e.max_context
         )
+        if self.n_adapters:
+            # adapter-switch-overhead observability: which tenants'
+            # rounds gathered a non-base bank row (the row gather is
+            # fused into this same program — zero extra dispatches)
+            seen: set[str] = set()
+            for i in active:
+                r = self._slots[i]
+                if r is not None and r.adapter_id and r.tenant not in seen:
+                    seen.add(r.tenant)
+                    TENANT.inc("dynamo_tenant_adapter_rounds_total",
+                               r.tenant)
         self.step_count += n
         stacked.copy_to_host_async()
         self.dispatch_counts["fetch"] += 1
@@ -2027,6 +2289,7 @@ class TpuEngine:
             a.get("slot", B), a.get("ctx", 1),
             a.get("temp", 0.0), a.get("top_k", 0), a.get("top_p", 1.0),
             a.get("freq", 0.0), a.get("pres", 0.0), a.get("rep", 1.0),
+            a.get("adapter", 0),
         ], np.float32)
         self.dispatch_counts["patch"] += 1
         self._dev = self._patch(
@@ -2824,6 +3087,7 @@ class TpuEngine:
         e = self.ecfg
         if (r.prefill_pos < 0
                 and e.sp_prefill_threshold is not None
+                and r.adapter_id == 0
                 and self.mesh.shape.get("sp", 1) > 1):
             ps = e.page_size
             hashes = r.seq.block_hashes()
@@ -2897,6 +3161,7 @@ class TpuEngine:
         q_starts = np.zeros(K, np.int32)
         seq_lens = np.zeros(K, np.int32)        # dummy seq_len 0: all
         chunk_lens = []                         # tokens masked out
+        adapter_ids = np.zeros(K, np.int32)     # dummies -> identity row
         for i, r in enumerate(group):
             start = r.prefill_pos
             chunk = r.tokens[start : start + width]
@@ -2905,6 +3170,7 @@ class TpuEngine:
             q_starts[i] = start
             seq_lens[i] = start + len(chunk)
             chunk_lens.append(len(chunk))
+            adapter_ids[i] = r.adapter_id
         # ctx_span is binary — 0 (fresh) or the FULL region: each distinct
         # value is its own ~30 s XLA compile on the dev chip, and the
         # masked flash scan over dead context is a rounding error next to
@@ -2916,13 +3182,14 @@ class TpuEngine:
                 "tokens": toks.tolist(), "slots": slots.tolist(),
                 "q_starts": q_starts.tolist(),
                 "seq_lens": seq_lens.tolist(), "ctx_span": ctx_span,
+                "adapter_ids": adapter_ids.tolist(),
             })
         t_disp = time.monotonic()
         self.dispatch_counts["prefill_batch"] += 1
         self.ctx, logits = llama.batch_prefill(
             self.config, self.params, self.ctx, jnp.asarray(toks),
             jnp.asarray(slots), jnp.asarray(q_starts),
-            jnp.asarray(seq_lens), ctx_span,
+            jnp.asarray(seq_lens), ctx_span, jnp.asarray(adapter_ids),
         )
         self.flight.record(
             "prefill_batch", slots=[r.slot for r in group], width=width,
@@ -2958,11 +3225,19 @@ class TpuEngine:
         The request also leaves the waiting-token backlog here — it is
         active prefill work now, not queued work."""
         self._uncount_waiting(r)
+        # SFQ: service starting advances the global virtual clock to
+        # this request's stamp, so later light-tenant arrivals start
+        # from here rather than from zero
+        self._vclock = max(self._vclock, r.vft)
         if r.t_prefill_start is not None:
             return
         now = time.monotonic()
-        self._h_queue.observe(now - r.enqueue_time,
-                              exemplar_id=r.req.request_id or None)
+        wait = now - r.enqueue_time
+        self._h_queue.observe(wait, exemplar_id=r.req.request_id or None)
+        t = r.tenant
+        self.tenant_quotas.note_queue_wait(t, wait)
+        TENANT.observe("dynamo_tenant_request_queue_seconds", t, wait,
+                       exemplar_id=r.req.request_id or None)
         r.trace_spans.append(_span_dict("queue", r.enqueue_time))
         r.t_prefill_start = now
 
@@ -3043,6 +3318,7 @@ class TpuEngine:
         if (r.prefill_pos < 0
                 and e.sp_prefill_threshold is not None
                 and not (r.req.multimodal or {}).get("embeddings")
+                and r.adapter_id == 0  # sp ring path serves the base model
                 and self.mesh.shape.get("sp", 1) > 1):
             # threshold applies to the UNCACHED suffix: a mostly-cached
             # long prompt is cheaper on the chunked local path (which
@@ -3093,6 +3369,7 @@ class TpuEngine:
             self.on_dispatch("prefill", {
                 "tokens": toks.tolist(), "slot": r.slot,
                 "start": start, "end": start + len(chunk),
+                "adapter": r.adapter_id,
             })
         t_disp = time.monotonic()
         self.dispatch_counts["prefill"] += 1
@@ -3100,7 +3377,7 @@ class TpuEngine:
             self.config, self.params, self.ctx,
             jnp.asarray(toks), jnp.int32(r.slot),
             jnp.int32(start), jnp.int32(start + len(chunk)),
-            embeds, embeds_mask,
+            embeds, embeds_mask, jnp.int32(r.adapter_id),
         )
         self.flight.record(
             "prefill", slots=[r.slot], tokens=len(chunk), start=start,
@@ -3213,7 +3490,12 @@ class TpuEngine:
         del self._prefilling[slot]
         self._slots[slot] = r
         self._ctx_disp[slot] = len(prompt) + 1
-        if self.spec is not None and self.spec.eligible(r.req):
+        # speculation is confined to the base model (adapter 0): the
+        # draft/verify programs have no adapter plumbing, and a draft
+        # proposing from base-model logits against a variant's target
+        # distribution would crater acceptance anyway
+        if (self.spec is not None and r.adapter_id == 0
+                and self.spec.eligible(r.req)):
             # speculative admission: the device lane stays PARKED on the
             # scratch lane (exactly like a freed slot) — the slot's real
             # state lives host-side and it advances through verify
@@ -3243,6 +3525,7 @@ class TpuEngine:
                     freq=so.frequency_penalty or 0.0,
                     pres=so.presence_penalty or 0.0,
                     rep=so.repetition_penalty or 1.0,
+                    adapter=r.adapter_id,
                 ),
             )
         # first token reaches the client via the async fetch pipeline
@@ -3330,8 +3613,12 @@ class TpuEngine:
         if r.first_token_time is None:
             r.first_token_time = time.monotonic()
             r.t_last_emit = r.first_token_time
-            self._h_ttft.observe(r.first_token_time - r.enqueue_time,
+            ttft = r.first_token_time - r.enqueue_time
+            self._h_ttft.observe(ttft,
                                  exemplar_id=r.req.request_id or None)
+            TENANT.observe("dynamo_tenant_request_ttft_seconds",
+                           r.tenant, ttft,
+                           exemplar_id=r.req.request_id or None)
         sc = r.req.stop_conditions
         if not sc.ignore_eos and tok in (sc.stop_token_ids or []) and (
             sc.min_tokens is None or r.produced >= sc.min_tokens

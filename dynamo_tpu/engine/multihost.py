@@ -283,10 +283,12 @@ class Follower:
                 jnp.asarray(np.asarray(cmd["tokens"], np.int32)),
                 jnp.int32(cmd["slot"]),
                 jnp.int32(cmd["start"]), jnp.int32(cmd["end"]),
+                None, None, jnp.int32(cmd.get("adapter", 0)),
             )
         elif op == "prefill_batch":
             from dynamo_tpu.models import llama
 
+            k = len(cmd["slots"])
             eng.ctx, eng._mh_last_logits = llama.batch_prefill(
                 eng.config, eng.params, eng.ctx,
                 jnp.asarray(np.asarray(cmd["tokens"], np.int32)),
@@ -294,6 +296,8 @@ class Follower:
                 jnp.asarray(np.asarray(cmd["q_starts"], np.int32)),
                 jnp.asarray(np.asarray(cmd["seq_lens"], np.int32)),
                 int(cmd["ctx_span"]),
+                jnp.asarray(np.asarray(
+                    cmd.get("adapter_ids", [0] * k), np.int32)),
             )
         elif op == "sample_first":
             logits = eng._mh_last_logits

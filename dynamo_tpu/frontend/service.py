@@ -50,6 +50,7 @@ from dynamo_tpu.protocols.openai import (
     model_list_response,
 )
 from dynamo_tpu.protocols.sse import encode_done, encode_event
+from dynamo_tpu.tenancy import DEFAULT_TENANT, TENANT
 from dynamo_tpu.telemetry import (
     TRACES,
     TelemetryRegistry,
@@ -117,6 +118,10 @@ def _overloaded_response(e: EngineOverloadedError) -> web.Response:
     """HTTP 429 with the load-derived Retry-After (whole seconds,
     rounded up — RFC 7231 delta-seconds)."""
     OVERLOAD.inc("dynamo_overload_http_429_total")
+    # tenant-sliced 429 accounting: a quota rejection carries the
+    # offending tenant on the error; global-backlog rejections ("") land
+    # on the default slice so the series totals stay reconcilable
+    TENANT.inc("dynamo_tenant_http_429_total", e.tenant or DEFAULT_TENANT)
     retry_after = max(1, int(-(-e.retry_after_s // 1)))
     return _error(
         429, str(e) or "engine overloaded", "overloaded_error",
@@ -142,10 +147,12 @@ class _RequestTiming:
     service histograms, and worker-side trace spans merged into the
     trace store."""
 
-    def __init__(self, svc: "HttpService", request_id: str, t_start: float):
+    def __init__(self, svc: "HttpService", request_id: str, t_start: float,
+                 tenant: str = ""):
         self.svc = svc
         self.rid = request_id
         self.t_start = t_start
+        self.tenant = tenant
         self.t_first: dict[int, float] = {}
         self.t_last: dict[int, float] = {}
         self.tok_counts: dict[int, int] = {}
@@ -209,13 +216,18 @@ class _RequestTiming:
         # tail-latency forensics: the no-breach path is a couple of float
         # compares — this runs BEFORE run()'s finally calls TRACES.finish,
         # so a breach promotion still adopts the shell's buffered spans
+        timing = dict(self.worker_timing)
+        if self.tenant:
+            # tenant tag rides the timing payload into any dossier this
+            # finish promotes — breach triage can slice by tenant
+            timing.setdefault("tenant", self.tenant)
         self.svc.forensics.on_finish(
             self.rid,
             ttft_s=ttft,
             itl_p95_s=self.itl_percentile(0.95),
             e2e_s=e2e,
             queue_s=self.worker_timing.get("queue_s"),
-            timing=dict(self.worker_timing),
+            timing=timing,
         )
 
 
@@ -281,6 +293,7 @@ class HttpService:
                 web.get("/debug/trace/{request_id}", self.handle_trace),
                 web.get("/debug/flight", self.handle_flight),
                 web.get("/debug/kv_fleet", self.handle_kv_fleet),
+                web.get("/debug/tenants", self.handle_tenants),
                 web.get("/debug/outliers", self.handle_outliers),
                 web.get("/debug/outliers/{request_id}",
                         self.handle_outlier),
@@ -350,6 +363,7 @@ class HttpService:
                 + PLANNER.render().encode()
                 + KV_FLEET.render().encode()
                 + FLEET_FEED.render(openmetrics=om).encode()
+                + TENANT.render(openmetrics=om).encode()
                 + FORENSICS.render().encode())
         if om:
             return web.Response(
@@ -410,6 +424,30 @@ class HttpService:
                 log.debug("forensics: skipping engine %s: %s", name, e)
                 continue
         return engines
+
+    async def handle_tenants(self, request: web.Request) -> web.Response:
+        """GET /debug/tenants — the tenancy plane in one JSON page: the
+        frontend's own tenant-sliced metric snapshot plus every local
+        engine's quota/queue view (keyed by model; remote workers serve
+        the same shape from their system server)."""
+        engines: dict[str, Any] = {}
+        for name in self.manager.list_models():
+            try:
+                eng = self.manager.get(name).engine
+            except Exception as e:  # noqa: BLE001 — debug page never throws
+                log.debug("tenant debug: model %s unavailable: %s", name, e)
+                continue
+            dbg = getattr(eng, "tenant_debug", None)
+            if dbg is None:
+                continue
+            try:
+                engines[name] = dbg()
+            except Exception as e:  # noqa: BLE001
+                log.debug("tenant debug for %s failed: %s", name, e)
+        return web.json_response({
+            "tenants": TENANT.snapshot(),
+            "engines": engines,
+        })
 
     async def handle_outliers(self, request: web.Request) -> web.Response:
         """GET /debug/outliers — the SLO-breach dossier ring: capture
@@ -814,7 +852,8 @@ class HttpService:
         finishes: list[FinishReason] = [FinishReason.EOS] * len(streams)
         lp_entries: list[list[dict]] = [[] for _ in streams]
         t_start = t_received if t_received is not None else time.monotonic()
-        timing = _RequestTiming(self, pre.request_id, t_start)
+        timing = _RequestTiming(self, pre.request_id, t_start,
+                                 tenant=getattr(pre, "tenant", ""))
 
         async def drain(i: int) -> None:
             try:
@@ -927,7 +966,8 @@ class HttpService:
         # (envelope entry — includes preprocess/route time, matching the
         # reference's measurement point)
         t_start = t_received if t_received is not None else time.monotonic()
-        timing = _RequestTiming(self, pre.request_id, t_start)
+        timing = _RequestTiming(self, pre.request_id, t_start,
+                                 tenant=getattr(pre, "tenant", ""))
         # tool-call detection: hold back tool-shaped text until it parses
         tool_accs: dict[int, Any] = {}
         if chat and getattr(req, "tools", None):

@@ -414,6 +414,9 @@ class KvPushRouter:
             raise EngineOverloadedError(
                 f"all workers overloaded for request {rid}",
                 retry_after_s=last_err.retry_after_s,
+                # a per-tenant quota bounce keeps its tenant key through
+                # the fleet-wide re-raise (frontend slices 429s by it)
+                tenant=getattr(last_err, "tenant", ""),
             ) from last_err
         raise ConnectionError(
             f"no reachable worker for request {rid}"

@@ -25,7 +25,8 @@ from dynamo_tpu.lint.core import Finding, Module, ProjectIndex, dotted
 
 _METRIC_NAME = re.compile(r"dynamo_[a-z0-9_]+")
 _VALID_TYPES = {"counter", "gauge", "histogram", "summary"}
-_REGISTRY_CTORS = {"CounterRegistry", "ProfRegistry", "FleetLatencyFeed"}
+_REGISTRY_CTORS = {"CounterRegistry", "ProfRegistry", "FleetLatencyFeed",
+                   "TenantRegistry"}
 _SURFACES = (
     "frontend/service.py",
     "runtime/system_server.py",

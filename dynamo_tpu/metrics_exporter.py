@@ -16,6 +16,8 @@ Exposed (all labelled by worker):
       (latency histograms shipped inside ForwardPassMetrics.histograms)
   dynamo_fleet_request_* (the same histograms MERGED across workers —
       telemetry/fleet_feed.py; exemplars preserved under OpenMetrics)
+  dynamo_tenant_* (process-local tenant-sliced admission/latency
+      families — dynamo_tpu/tenancy/metrics.py)
 Run: ``dynamo-tpu metrics --control-plane HOST:PORT --port 9090``.
 """
 from __future__ import annotations
@@ -34,6 +36,7 @@ from dynamo_tpu.runtime.publisher import METRICS_TOPIC
 from dynamo_tpu.telemetry.fleet_feed import FLEET_FEED
 from dynamo_tpu.telemetry.forensics import FORENSICS
 from dynamo_tpu.telemetry.metrics import render_histogram
+from dynamo_tpu.tenancy import TENANT
 
 log = logging.getLogger(__name__)
 
@@ -196,6 +199,7 @@ class MetricsExporter:
                 + PROF.render() + STORE.render() + PLANNER.render()
                 + KV_FLEET.render()
                 + FLEET_FEED.render(openmetrics=openmetrics)
+                + TENANT.render(openmetrics=openmetrics)
                 + FORENSICS.render())
 
     async def handle_metrics(self, request: web.Request) -> web.Response:

@@ -68,6 +68,12 @@ class MockerArgs:
     # every priority class (no preemption machinery here).
     max_waiting_requests: int = 0
     max_waiting_prefill_tokens: int = 0
+    # tenancy plane (dynamo_tpu/tenancy/): per-tenant admission budgets
+    # over the waiting queue (0 = unbounded) and fair-share weights —
+    # the same knobs as TpuEngine, so quota/fairness paths test on CPU
+    tenant_max_waiting_requests: int = 0
+    tenant_max_waiting_prefill_tokens: int = 0
+    tenant_weights: Optional[dict] = None
 
 
 @dataclass
@@ -89,6 +95,9 @@ class _MockRequest:
     # current (possibly restart-extended) prompt — kept separate from
     # req.token_ids so preemption never mutates the caller's request object
     prompt: list[int] = field(default_factory=list)
+    # SFQ virtual finish stamp (tenancy fair share — same scheme as
+    # TpuEngine._enqueue_waiting)
+    vft: float = 0.0
 
 
 class MockerEngine:
@@ -149,6 +158,20 @@ class MockerEngine:
                 if self._queue_waits else None
             ),
         )
+        # tenancy plane: per-tenant budgets + tenant-sliced metrics,
+        # mirroring TpuEngine so CPU tests exercise the same contract
+        from dynamo_tpu.tenancy import TenantQuotas
+
+        self.tenant_quotas = TenantQuotas(
+            self.args.tenant_max_waiting_requests,
+            self.args.tenant_max_waiting_prefill_tokens,
+            weights=self.args.tenant_weights,
+        )
+        # SFQ virtual clocks (same scheme as TpuEngine): per-tenant
+        # finish stamps self-pace a storming tenant's backlog behind its
+        # own stamps; single-tenant traffic degenerates to exact FIFO
+        self._tenant_vnow: dict[str, float] = {}
+        self._vclock = 0.0
         self.sheds = 0
 
     # ------------------------------------------------------------------
@@ -198,12 +221,15 @@ class MockerEngine:
             self.start()
         if not request.token_ids:
             raise ValueError("empty prompt")
+        tenant = getattr(request, "tenant", "") or "default"
         if (request.deadline is not None
                 and self.clock.time() > request.deadline):
             from dynamo_tpu.overload import OVERLOAD
+            from dynamo_tpu.tenancy import TENANT
 
             self.sheds += 1
             OVERLOAD.inc("dynamo_overload_shed_total")
+            TENANT.inc("dynamo_tenant_shed_total", tenant)
             yield LLMEngineOutput(
                 token_ids=[], finish_reason=FinishReason.DEADLINE,
                 annotations={"shed": {"reason": "deadline",
@@ -216,6 +242,7 @@ class MockerEngine:
         # is a TpuEngine feature — see engine.py _enforce_bounds)
         if self.admission.bounded:
             from dynamo_tpu.overload import OVERLOAD
+            from dynamo_tpu.tenancy import TENANT
 
             waiting = len(self._waiting)
             tokens = sum(len(w.prompt) for w in self._waiting)
@@ -223,7 +250,25 @@ class MockerEngine:
                 self.admission.check(waiting, tokens)
             except Exception:
                 OVERLOAD.inc("dynamo_overload_rejected_total")
+                TENANT.inc("dynamo_tenant_rejected_total", tenant)
                 raise
+        if self.tenant_quotas.bounded:
+            from dynamo_tpu.overload import OVERLOAD
+            from dynamo_tpu.tenancy import TENANT
+
+            t_waiting = sum(1 for w in self._waiting
+                            if self._tenant_of(w) == tenant)
+            t_tokens = sum(len(w.prompt) for w in self._waiting
+                           if self._tenant_of(w) == tenant)
+            try:
+                self.tenant_quotas.check(tenant, t_waiting, t_tokens)
+            except Exception:
+                OVERLOAD.inc("dynamo_overload_rejected_total")
+                TENANT.inc("dynamo_tenant_rejected_total", tenant)
+                raise
+        from dynamo_tpu.tenancy import TENANT as _TENANT
+
+        _TENANT.inc("dynamo_tenant_admitted_total", tenant)
         r = _MockRequest(
             req=request,
             seq=TokenBlockSequence.from_tokens(
@@ -234,7 +279,20 @@ class MockerEngine:
             prompt=list(request.token_ids),
             enqueue_time=self.clock.monotonic(),
         )
-        self._waiting.append(r)
+        # weighted fair-share enqueue: stamp a virtual finish time and
+        # insert before the first waiting entry with a larger stamp
+        cost = max(1, len(request.token_ids))
+        vft = (max(self._tenant_vnow.get(tenant, 0.0), self._vclock)
+               + cost / self.tenant_quotas.weight(tenant))
+        r.vft = vft
+        self._tenant_vnow[tenant] = vft
+        for i, wr in enumerate(self._waiting):
+            # never jump a preempted restart (it holds produced tokens)
+            if wr.produced == 0 and wr.vft > vft:
+                self._waiting.insert(i, r)
+                break
+        else:
+            self._waiting.append(r)
         self._wake.set()
         try:
             while True:
@@ -248,7 +306,50 @@ class MockerEngine:
             r.cancelled = True
             self._wake.set()
 
+    @staticmethod
+    def _tenant_of(r: _MockRequest) -> str:
+        return getattr(r.req, "tenant", "") or "default"
+
+    def tenant_debug(self) -> dict:
+        """Same shape as TpuEngine.tenant_debug — tools/tenant_stats.py
+        and the system server's /debug/tenants read either engine."""
+        from dynamo_tpu.tenancy import TENANT
+
+        q = self.tenant_quotas
+        tenants: dict[str, dict] = {}
+        snap = TENANT.snapshot()
+        qsnap = q.snapshot()
+        names = ({self._tenant_of(w) for w in self._waiting}
+                 | {self._tenant_of(w) for w in self._active}
+                 | set(qsnap) | set(snap))
+        for t in sorted(names):
+            tenants[t] = {
+                "waiting_requests": sum(
+                    1 for w in self._waiting if self._tenant_of(w) == t),
+                "waiting_prefill_tokens": sum(
+                    len(w.prompt) for w in self._waiting
+                    if self._tenant_of(w) == t),
+                **qsnap.get(t, {}),
+                "metrics": snap.get(t, {}),
+            }
+        return {
+            "bounded": q.bounded,
+            "max_waiting_requests": q.max_waiting_requests,
+            "max_waiting_prefill_tokens": q.max_waiting_prefill_tokens,
+            "n_adapters": 0,
+            "tenants": tenants,
+        }
+
     def metrics(self) -> ForwardPassMetrics:
+        from dynamo_tpu.tenancy import TENANT
+
+        by_tenant: dict[str, list] = {}
+        for w in self._waiting:
+            by_tenant.setdefault(self._tenant_of(w), []).append(w)
+        for t, ws in by_tenant.items():
+            TENANT.set("dynamo_tenant_queue_depth", t, len(ws))
+            TENANT.set("dynamo_tenant_queue_tokens", t,
+                       sum(len(w.prompt) for w in ws))
         a = self.allocator
         return ForwardPassMetrics(
             worker_id=self.args.worker_id,
@@ -384,10 +485,19 @@ class MockerEngine:
             r.pages = matched + fresh
             r.prefilling = True
             r.admit_time = self.clock.monotonic()
+            # the admitted stamp advances the global virtual clock, so
+            # later arrivals can't be stamped into the served past
+            self._vclock = max(self._vclock, r.vft)
             wait = r.admit_time - r.enqueue_time
             self._queue_waits.append(wait)
             self._h_queue.observe(
                 wait, exemplar_id=r.req.request_id or None)
+            from dynamo_tpu.tenancy import TENANT
+
+            t = self._tenant_of(r)
+            self.tenant_quotas.note_queue_wait(t, wait)
+            TENANT.observe("dynamo_tenant_request_queue_seconds", t, wait,
+                           exemplar_id=r.req.request_id or None)
             self._waiting.pop(0)
             self._active.append(r)
             # simulated prefill cost for the non-cached suffix
@@ -500,6 +610,12 @@ class MockerEngine:
         if r.produced == 0:
             r.first_token_time = self.clock.monotonic()
             self._h_ttft.observe(
+                r.first_token_time - r.enqueue_time,
+                exemplar_id=r.req.request_id or None)
+            from dynamo_tpu.tenancy import TENANT
+
+            TENANT.observe(
+                "dynamo_tenant_request_ttft_seconds", self._tenant_of(r),
                 r.first_token_time - r.enqueue_time,
                 exemplar_id=r.req.request_id or None)
         r.produced += 1

@@ -26,6 +26,10 @@ CARD_FILES = (
     "special_tokens_map.json",
     "generation_config.json",
     "chat_template.jinja",
+    # tenancy plane: the fine-tune variant manifest — which servable
+    # names map to which resident adapter rows (frontends registering
+    # variants need it; workers without one serve only the base model)
+    "adapters.json",
 )
 
 # object-plane payloads are base64-encoded (4/3 inflation) into frames

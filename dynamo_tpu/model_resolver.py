@@ -24,6 +24,27 @@ class ResolvedModel:
     kind: str  # "dir" | "gguf"
 
 
+# Aliasing fix: two specs naming the SAME on-disk model (a symlinked
+# variant directory, a trailing-slash or relative spelling, a hub id
+# whose snapshot another spec already resolved) used to come back as
+# DIFFERENT ResolvedModel paths — and everything downstream that keys on
+# the path (weight loads, model cards, engine registries) duplicated the
+# work, loading the same checkpoint once per alias. Resolutions are now
+# canonicalised by realpath: the first resolution of an on-disk target
+# wins, and every alias returns that same shared object.
+_CANONICAL: dict[tuple[str, str], ResolvedModel] = {}
+
+
+def resolver_cache_clear() -> None:
+    """Drop canonical resolutions (tests re-point HF cache env vars)."""
+    _CANONICAL.clear()
+
+
+def _canonical(rm: ResolvedModel) -> ResolvedModel:
+    key = (os.path.realpath(rm.path), rm.kind)
+    return _CANONICAL.setdefault(key, rm)
+
+
 def _hub_cache_dirs() -> list[str]:
     roots = []
     if os.environ.get("HF_HUB_CACHE"):
@@ -55,16 +76,16 @@ def _cached_snapshot(repo_id: str) -> Optional[str]:
 def resolve_model(spec: str) -> ResolvedModel:
     """Resolve a model spec to a local path (never downloads)."""
     if os.path.isdir(spec):
-        return ResolvedModel(path=spec, kind="dir")
+        return _canonical(ResolvedModel(path=spec, kind="dir"))
     if os.path.isfile(spec) and spec.endswith(".gguf"):
-        return ResolvedModel(path=spec, kind="gguf")
+        return _canonical(ResolvedModel(path=spec, kind="gguf"))
     looks_like_hub_id = (
         spec.count("/") == 1 and not spec.startswith(("/", ".", "~"))
     )
     if looks_like_hub_id and not os.path.exists(spec):
         snap = _cached_snapshot(spec)
         if snap is not None:
-            return ResolvedModel(path=snap, kind="dir")
+            return _canonical(ResolvedModel(path=snap, kind="dir"))
         raise FileNotFoundError(
             f"model {spec!r} is not a local path and is not in the HF "
             f"cache ({', '.join(_hub_cache_dirs())}). Serving hosts have "

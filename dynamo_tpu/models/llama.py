@@ -413,6 +413,55 @@ def _mm(x: jnp.ndarray, w) -> jnp.ndarray:
     return x @ w
 
 
+# ---------------------------------------------------------------------------
+# Resident LoRA adapters (dynamo_tpu/tenancy/adapters.py builds the bank)
+#
+# The bank rides inside `params` as params["adapters"] = {site: {"a":
+# [N, L, d_in, r], "b": [N, L, r, d_out]}}; presence is a TRACE-TIME
+# check, so engines without a bank trace the identical pre-tenancy
+# programs. Adapter 0 is all-zeros — the delta is exactly 0.0 and the
+# base model's outputs are bit-identical.
+
+def _lora_delta(x: jnp.ndarray, a, b) -> jnp.ndarray:
+    """Rank-r LoRA delta for x [T, d_in] (or [B, d_in] in decode).
+    Shared-id factors are 2-D ([d_in, r] / [r, d_out]); per-row decode
+    factors are 3-D ([B, d_in, r] / [B, r, d_out]) — one gathered row
+    per batch lane, contracted with that lane's activation only."""
+    a = a.astype(x.dtype)
+    b = b.astype(x.dtype)
+    if a.ndim == 2:
+        return (x @ a) @ b
+    t = jnp.einsum("nd,ndr->nr", x, a)
+    return jnp.einsum("nr,nro->no", t, b)
+
+
+def _mm_ad(x: jnp.ndarray, w, ab) -> jnp.ndarray:
+    """x @ w plus the site's adapter delta (``ab`` = (a, b) or None)."""
+    y = _mm(x, w)
+    if ab is not None:
+        y = y + _lora_delta(x, ab[0], ab[1])
+    return y
+
+
+def _gather_adapters(bank, ids):
+    """Gather each site's factor rows by adapter id: a scalar id yields
+    per-site [L, d, r]; a [B] id row yields [B, L, d, r] (the per-slot
+    decode gather — ids are constant within a round, so XLA hoists the
+    gather out of the fused step loop)."""
+    if bank is None or ids is None:
+        return None
+    return jax.tree.map(lambda x: x[ids], bank)
+
+
+def _adapter_layer(gathered, l: int, per_row: bool):
+    """Layer-l (a, b) slices of a gathered bank, keyed by site — the
+    ``ad`` argument of _layer_body. None stays None (no-LoRA trace)."""
+    if gathered is None:
+        return None
+    sl = (lambda x: x[:, l]) if per_row else (lambda x: x[l])
+    return {s: (sl(ab["a"]), sl(ab["b"])) for s, ab in gathered.items()}
+
+
 def _embed_rows(params: Params, tokens: jnp.ndarray, dtype) -> jnp.ndarray:
     """Embedding gather for dense or quantized embed tables."""
     e = params["embed"]
@@ -459,8 +508,11 @@ def rms_norm(x: jnp.ndarray, w: jnp.ndarray, eps: float) -> jnp.ndarray:
     return (xf * jax.lax.rsqrt(var + eps)).astype(x.dtype) * w
 
 
-def _mlp(h, wg, wu, wd):
-    return _mm(jax.nn.silu(_mm(h, wg)) * _mm(h, wu), wd)
+def _mlp(h, wg, wu, wd, ad=None):
+    ad = ad or {}
+    gate = _mm_ad(h, wg, ad.get("wg"))
+    up = _mm_ad(h, wu, ad.get("wu"))
+    return _mm_ad(jax.nn.silu(gate) * up, wd, ad.get("wd"))
 
 
 def _moe_ffn(c: ModelConfig, lp, x: jnp.ndarray,
@@ -523,32 +575,37 @@ def _moe_ffn(c: ModelConfig, lp, x: jnp.ndarray,
     return out.sum(axis=1).astype(x.dtype)
 
 
-def _ffn(c: ModelConfig, lp, x: jnp.ndarray, valid=None) -> jnp.ndarray:
+def _ffn(c: ModelConfig, lp, x: jnp.ndarray, valid=None,
+         ad=None) -> jnp.ndarray:
     if c.moe is not None:
+        # MoE expert stacks are not adapted (tenancy/adapters.py)
         return _moe_ffn(c, lp, x, valid)
-    return _mlp(x, lp["wg"], lp["wu"], lp["wd"])
+    return _mlp(x, lp["wg"], lp["wu"], lp["wd"], ad)
 
 
 def _layer_body(c: ModelConfig, lp, h, cos, sin, write_kv, attend,
-                ffn_valid=None):
+                ffn_valid=None, ad=None):
     """Shared decoder-layer body for prefill and decode.
 
     `write_kv(k, v)` scatters new KV into the carried cache and returns it;
     `attend(q, cache)` runs attention over the updated cache. `h` is [N, H]
-    (N = padded tokens for prefill, batch slots for decode).
+    (N = padded tokens for prefill, batch slots for decode). `ad` is the
+    layer's adapter-factor slices (``_adapter_layer``) or None — the
+    rank-r LoRA deltas fuse into the existing site matmuls.
     """
     N = h.shape[0]
+    ad = ad or {}
     x = rms_norm(h, lp["ln1"], c.rms_norm_eps)
-    q = _mm(x, lp["wq"]).reshape(N, c.num_heads, c.head_dim)
-    k = _mm(x, lp["wk"]).reshape(N, c.num_kv_heads, c.head_dim)
-    v = _mm(x, lp["wv"]).reshape(N, c.num_kv_heads, c.head_dim)
+    q = _mm_ad(x, lp["wq"], ad.get("wq")).reshape(N, c.num_heads, c.head_dim)
+    k = _mm_ad(x, lp["wk"], ad.get("wk")).reshape(N, c.num_kv_heads, c.head_dim)
+    v = _mm_ad(x, lp["wv"], ad.get("wv")).reshape(N, c.num_kv_heads, c.head_dim)
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
     new_cache = write_kv(k, v)
     attn = attend(q, new_cache)
-    h = h + _mm(attn.reshape(N, c.q_dim), lp["wo"])
+    h = h + _mm_ad(attn.reshape(N, c.q_dim), lp["wo"], ad.get("wo"))
     x2 = rms_norm(h, lp["ln2"], c.rms_norm_eps)
-    h = h + _ffn(c, lp, x2, ffn_valid)
+    h = h + _ffn(c, lp, x2, ffn_valid, ad)
     return h, new_cache
 
 
@@ -582,6 +639,9 @@ def prefill_impl(
     embeds_mask: Optional[jnp.ndarray] = None,  # [T] bool — True: use
                               # `embeds` instead of the token embedding
                               # (multimodal image tokens; vision.py)
+    adapter_id: Optional[jnp.ndarray] = None,   # scalar i32 — resident
+                              # LoRA bank row (0 = identity base model);
+                              # ignored when params carry no bank
 ) -> tuple[Cache, jnp.ndarray]:
     """Run T new tokens through the model, writing their KV into the
     slot's contiguous context region at [q_start, q_start+T).
@@ -615,6 +675,7 @@ def prefill_impl(
     # writes land in one tail pass after the last read, so the donated
     # update chain aliases in place (interleaved write/read of the GB-
     # scale buffer would force XLA to materialize copies of it).
+    ag = _gather_adapters(params.get("adapters"), adapter_id)
     new_ks: list[jnp.ndarray] = []
     new_vs: list[jnp.ndarray] = []
     for l in range(c.num_layers):
@@ -635,7 +696,8 @@ def prefill_impl(
 
         # padding tokens must not claim MoE expert capacity
         h, _ = _layer_body(c, lp, h, cos, sin, write_kv, attend,
-                           ffn_valid=positions < seq_len)
+                           ffn_valid=positions < seq_len,
+                           ad=_adapter_layer(ag, l, per_row=False))
 
     # tail: one contiguous span write per buffer (all reads are done)
     upd_k = jnp.stack(new_ks).transpose(0, 2, 1, 3)  # [L, kvh, T, hd]
@@ -676,6 +738,7 @@ def _batch_forward(
     q_starts: jnp.ndarray,  # [K] i32
     seq_lens: jnp.ndarray,  # [K] i32
     ctx_span: int,
+    adapter_ids: Optional[jnp.ndarray] = None,  # [K] i32 bank rows
 ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Read-only vmapped layer stack shared by batch_prefill and
     batch_score: K chunks through the model in one program. Returns
@@ -690,8 +753,11 @@ def _batch_forward(
     )
 
     cdt = _ctx_compute_dtype(c, ctx_kv)
+    # gather bank rows OUTSIDE the vmap ([K, L, d, r] per site), then vmap
+    # over the gathered rows so each lane sees its own [L, d, r] factors
+    ag = _gather_adapters(params.get("adapters"), adapter_ids)
 
-    def compute(toks, slot, q_start, seq_len):
+    def compute(toks, slot, q_start, seq_len, ag_row):
         positions = q_start + jnp.arange(T, dtype=jnp.int32)
         cos, sin = rope_cos_sin(positions, inv_freq)
         h = _embed_rows(params, toks, cdt)
@@ -719,14 +785,19 @@ def _batch_forward(
                 )
 
             h, _ = _layer_body(c, lp, h, cos, sin, write_kv, attend,
-                               ffn_valid=positions < seq_len)
+                               ffn_valid=positions < seq_len,
+                               ad=_adapter_layer(ag_row, l, per_row=False))
         return (
             jnp.stack(new_ks).astype(cdt),
             jnp.stack(new_vs).astype(cdt),
             h,
         )
 
-    return jax.vmap(compute)(tokens, slots, q_starts, seq_lens)
+    if ag is None:
+        return jax.vmap(
+            lambda t, s, q, sl: compute(t, s, q, sl, None)
+        )(tokens, slots, q_starts, seq_lens)
+    return jax.vmap(compute)(tokens, slots, q_starts, seq_lens, ag)
 
 
 def _write_chunks(
@@ -777,6 +848,8 @@ def batch_prefill_impl(
     ctx_span: int = 0,      # STATIC: prior-context window to attend
                             # (pow2 >= max(q_starts); 0 = fresh prefill,
                             # no context read compiled at all)
+    adapter_ids: Optional[jnp.ndarray] = None,  # [K] i32 — resident LoRA
+                            # bank rows (0 = identity; padding lanes 0)
 ) -> tuple[Cache, jnp.ndarray]:
     """Batched multi-request prefill: K chunks through the model in ONE
     program — the TTFT lever for concurrent arrivals (reference analogue:
@@ -797,7 +870,8 @@ def batch_prefill_impl(
     tokens out of MoE routing and their region writes hit scratch.
     """
     ks, vs, h = _batch_forward(
-        config, params, ctx_kv, tokens, slots, q_starts, seq_lens, ctx_span
+        config, params, ctx_kv, tokens, slots, q_starts, seq_lens, ctx_span,
+        adapter_ids,
     )
     ctx_kv = _write_chunks(ctx_kv, ks, vs, slots, q_starts, seq_lens)
     last = jnp.maximum(seq_lens - q_starts - 1, 0)
@@ -920,6 +994,10 @@ def decode_step_impl(
     ring_pos: jnp.ndarray,     # scalar int32 — ring slot receiving this token
     live: Optional[jnp.ndarray] = None,  # [B] bool — garbage lanes masked
                                # out of MoE expert routing
+    adapter_ids: Optional[jnp.ndarray] = None,  # [B] i32 — per-slot
+                               # resident LoRA bank rows (0 = identity);
+                               # mixed ids batch into ONE program via a
+                               # row gather + rank-r einsum per site
 ) -> tuple[Cache, jnp.ndarray]:
     """One decode step for all slots. Returns (ring, logits [B, vocab]).
 
@@ -939,6 +1017,9 @@ def decode_step_impl(
 
     h = _embed_rows(params, tokens, _ctx_compute_dtype(c, ctx_kv))  # [B, H]
     quant = ctx_is_quantized(ctx_kv)
+    # [B, L, d, r] per site — ids are round-constant, so XLA hoists the
+    # gather out of the fori_loop wrapping this step in the fused round
+    ag = _gather_adapters(params.get("adapters"), adapter_ids)
 
     # unrolled layers — see prefill_impl for why not lax.scan
     for l in range(c.num_layers):
@@ -964,7 +1045,8 @@ def decode_step_impl(
             )
 
         h, ring = _layer_body(c, lp, h, cos, sin, write_kv, attend,
-                              ffn_valid=live)
+                              ffn_valid=live,
+                              ad=_adapter_layer(ag, l, per_row=True))
 
     logits = _logits(c, params, h)
     return ring, logits

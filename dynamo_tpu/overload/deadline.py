@@ -78,14 +78,20 @@ def remaining_s(deadline: Optional[float],
 
 def apply_request_hints(pre: Any, headers: Any = None,
                         nvext: Optional[dict] = None) -> None:
-    """Fold priority/deadline hints onto a PreprocessedRequest. Body
-    (nvext) first, headers override — a proxy injecting headers wins
-    over a stale client body."""
+    """Fold priority/deadline/tenant hints onto a PreprocessedRequest.
+    Body (nvext) first, headers override — a proxy injecting headers
+    wins over a stale client body."""
+    # local import: tenancy.quotas must stay importable without the
+    # overload plane and vice versa
+    from dynamo_tpu.tenancy.quotas import TENANT_HEADER, parse_tenant
+
     nvext = nvext or {}
     if nvext.get("priority") is not None:
         pre.priority = parse_priority(nvext.get("priority"))
     if nvext.get("timeout_ms") is not None:
         pre.deadline = mint_deadline(nvext.get("timeout_ms"))
+    if nvext.get("tenant") is not None:
+        pre.tenant = parse_tenant(nvext.get("tenant"))
     if headers is not None:
         hp = headers.get(PRIORITY_HEADER)
         if hp is not None:
@@ -95,3 +101,6 @@ def apply_request_hints(pre: Any, headers: Any = None,
             d = mint_deadline(ht)
             if d is not None:
                 pre.deadline = d
+        hten = headers.get(TENANT_HEADER)
+        if hten is not None:
+            pre.tenant = parse_tenant(hten)

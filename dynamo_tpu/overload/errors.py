@@ -22,9 +22,14 @@ class EngineOverloadedError(ConnectionError):
     duplicate work or tokens.
     """
 
-    def __init__(self, message: str, retry_after_s: float = 1.0):
+    def __init__(self, message: str, retry_after_s: float = 1.0,
+                 tenant: str = ""):
         super().__init__(message)
         self.retry_after_s = max(0.0, float(retry_after_s))
+        # which tenant's budget refused the request ("" = the global
+        # backlog budget, pre-tenancy behavior). Rides the wire error
+        # frame so the frontend can label its 429 counters per tenant.
+        self.tenant = str(tenant)
 
 
 class PreemptedError(ConnectionError):

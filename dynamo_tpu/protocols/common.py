@@ -93,6 +93,15 @@ class PreprocessedRequest:
     # Multimodal: media inputs resolved by the preprocessor/encode worker.
     multimodal: Optional[dict[str, Any]] = None
     annotations: list[str] = field(default_factory=list)
+    # Tenancy plane (dynamo_tpu/tenancy/): tenant identity minted at the
+    # frontend (X-Tenant-Id header / nvext.tenant; legacy traffic lands
+    # in "default") — keys per-tenant quotas, fair-share ordering, and
+    # the dynamo_tenant_* metric slices end to end.
+    tenant: str = "default"
+    # Resident LoRA bank row serving this request (0 = identity base
+    # model). Stamped by the frontend when `model` names a registered
+    # fine-tune variant of the worker's base model.
+    adapter_id: int = 0
 
     def to_dict(self) -> dict[str, Any]:
         return asdict(self)

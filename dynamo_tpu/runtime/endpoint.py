@@ -83,6 +83,7 @@ class EndpointServer:
                     # the load-derived Retry-After hint intact
                     frame["overloaded"] = True
                     frame["retry_after_s"] = e.retry_after_s
+                    frame["tenant"] = e.tenant
                 writer.write(encode_frame(frame))
                 await writer.drain()
             except (ConnectionResetError, BrokenPipeError, OSError):
@@ -127,6 +128,7 @@ async def call_endpoint(
                         msg["error"],
                         retry_after_s=float(
                             msg.get("retry_after_s", 1.0)),
+                        tenant=str(msg.get("tenant", "")),
                     )
                 if msg.get("retriable"):
                     raise EndpointConnectionError(msg["error"])

@@ -35,6 +35,7 @@ from dynamo_tpu.telemetry.fleet_feed import FLEET_FEED
 from dynamo_tpu.telemetry.forensics import FORENSICS, OUTLIERS
 from dynamo_tpu.telemetry.metrics import render_histogram
 from dynamo_tpu.telemetry.timeline import to_chrome_trace
+from dynamo_tpu.tenancy import TENANT
 
 log = logging.getLogger(__name__)
 
@@ -69,6 +70,7 @@ class SystemServer:
             web.get("/live", self.handle_health),
             web.get("/debug/flight", self.handle_flight),
             web.get("/debug/kv_fleet", self.handle_kv_fleet),
+            web.get("/debug/tenants", self.handle_tenants),
             web.get("/debug/prof", self.handle_prof),
             web.get("/debug/trace", self.handle_trace_index),
             web.get("/debug/trace/{request_id}", self.handle_trace),
@@ -181,6 +183,7 @@ class SystemServer:
                 + PROF.render() + STORE.render() + PLANNER.render()
                 + KV_FLEET.render()
                 + FLEET_FEED.render(openmetrics=openmetrics)
+                + TENANT.render(openmetrics=openmetrics)
                 + FORENSICS.render())
 
     async def handle_metrics(self, request: web.Request) -> web.Response:
@@ -223,6 +226,22 @@ class SystemServer:
         return web.json_response(
             {"worker_id": self.worker_id, "hints": hints.to_dict()}
         )
+
+    async def handle_tenants(self, request: web.Request) -> web.Response:
+        """GET /debug/tenants — this WORKER's tenancy plane: the
+        engine's quota/queue view per tenant plus the process-local
+        tenant metric snapshot (the frontend aggregates its own)."""
+        body: dict = {
+            "worker_id": self.worker_id,
+            "tenants": TENANT.snapshot(),
+        }
+        dbg = getattr(self.engine, "tenant_debug", None)
+        if dbg is not None:
+            try:
+                body["engine"] = dbg()
+            except Exception:  # noqa: BLE001 — debug surface never throws
+                log.exception("tenant debug failed")
+        return web.json_response(body)
 
     async def handle_prof(self, request: web.Request) -> web.Response:
         """GET /debug/prof[?top=N] — host-round attribution: per-segment

@@ -1,0 +1,26 @@
+"""Multi-tenant serving plane: resident LoRA adapter multiplexing,
+per-tenant quota/fairness, and tenant-sliced observability.
+
+Three planes (see each module's doc):
+  adapters.py  resident LoRA banks + the variant-name registry
+               (imported lazily — it needs jax; quota/metrics don't)
+  quotas.py    per-tenant admission budgets, SFQ fair-share stamps,
+               tenant-derived Retry-After
+  metrics.py   the ``dynamo_tenant_*`` labelled metric families
+"""
+from dynamo_tpu.tenancy.metrics import TENANT, TenantRegistry
+from dynamo_tpu.tenancy.quotas import (
+    DEFAULT_TENANT,
+    TENANT_HEADER,
+    TenantQuotas,
+    parse_tenant,
+)
+
+__all__ = [
+    "TENANT",
+    "TenantRegistry",
+    "TENANT_HEADER",
+    "DEFAULT_TENANT",
+    "TenantQuotas",
+    "parse_tenant",
+]

@@ -40,8 +40,10 @@ def _engine(**kw) -> TpuEngine:
                      mesh_config=MeshConfig(tp=1))
 
 
-async def _steady_window_budget(**kw):
+async def _steady_window_budget(adapter_ids=None, setup=None, **kw):
     eng = _engine(**kw)
+    if setup is not None:
+        setup(eng)
     eng.start()
     rng = np.random.RandomState(0)
     n_req, osl = 4, 64
@@ -53,6 +55,12 @@ async def _steady_window_budget(**kw):
             token_ids=list(prompts[i]),
             stop_conditions=StopConditions(max_tokens=osl,
                                            ignore_eos=True),
+            adapter_id=(adapter_ids[i % len(adapter_ids)]
+                        if adapter_ids else 0),
+            # variant requests carry their own model salt (the frontend
+            # contract) so adapter streams never share cached prefixes
+            model=(f"m:a{adapter_ids[i % len(adapter_ids)]}"
+                   if adapter_ids else ""),
         )):
             progress[i] += len(out.token_ids)
 
@@ -104,6 +112,24 @@ async def test_steady_decode_round_budget_int8():
     requantization and the raw int8 fused seals all ride the round
     program — the in-kernel quant path costs ZERO extra dispatches."""
     await _steady_window_budget(kv_quant="int8")
+
+
+async def test_steady_decode_round_budget_mixed_adapters():
+    """Resident LoRA multiplexing keeps the identical budget: per-slot
+    adapter rows are gathered INSIDE the fused round program, so a
+    steady decode batch mixing the base model with two live fine-tune
+    variants still costs 1 program + 1 fetch per round — adapter
+    switching has no dispatch price."""
+    from dynamo_tpu.models.config import ModelConfig
+    from dynamo_tpu.tenancy.adapters import random_adapter
+
+    def setup(eng):
+        mc = ModelConfig.tiny(dtype="float32")
+        eng.install_adapter(1, random_adapter(mc, 4, seed=5))
+        eng.install_adapter(2, random_adapter(mc, 4, seed=6))
+
+    await _steady_window_budget(adapter_ids=(0, 1, 2, 1), setup=setup,
+                                lora_adapters=4, lora_rank=4)
 
 
 async def test_whole_run_dispatch_budget():

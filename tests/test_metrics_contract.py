@@ -205,10 +205,20 @@ def test_readme_documents_canonical_series():
         "dynamo_fleet_feed_workers",
         "dynamo_planner_fleet_ttft_p99_seconds",
         "dynamo_planner_fleet_queue_p99_seconds",
+        # tenant-sliced serving plane (dynamo_tpu/tenancy/)
+        "dynamo_tenant_admitted_total",
+        "dynamo_tenant_rejected_total",
+        "dynamo_tenant_shed_total",
+        "dynamo_tenant_http_429_total",
+        "dynamo_tenant_queue_depth",
+        "dynamo_tenant_queue_tokens",
+        "dynamo_tenant_adapter_rounds_total",
+        "dynamo_tenant_request_ttft_seconds",
+        "dynamo_tenant_request_queue_seconds",
     ):
         assert name in readme, f"{name} missing from README"
     for endpoint in ("/debug/trace", "/debug/flight", "/debug/prof",
-                     "/debug/outliers"):
+                     "/debug/outliers", "/debug/tenants"):
         assert endpoint in readme
 
 
@@ -235,6 +245,41 @@ def test_forensics_and_fleet_families_on_all_three_surfaces():
         assert "# TYPE dynamo_forensics_dossiers_total counter" in text
         assert "# TYPE dynamo_forensics_ring_size gauge" in text
         assert "# TYPE dynamo_fleet_feed_workers gauge" in text
+
+
+def test_tenant_families_on_all_three_surfaces():
+    """The tenant-sliced families render — with HELP/TYPE and the
+    ``tenant`` label — on every scrape surface."""
+    from dynamo_tpu.frontend.service import HttpService
+    from dynamo_tpu.metrics_exporter import MetricsExporter
+    from dynamo_tpu.runtime.system_server import SystemServer
+    from dynamo_tpu.tenancy import TENANT
+
+    TENANT.inc("dynamo_tenant_admitted_total", "t0")
+    TENANT.observe("dynamo_tenant_request_ttft_seconds", "t0", 0.05)
+    try:
+        exp = MetricsExporter(kv=None)
+        exp.aggregator.update(_StubEngine().metrics())
+        svc = HttpService()
+        frontend = (svc.metrics.render().decode() + svc.telemetry.render()
+                    + TENANT.render())
+        for text in (
+            SystemServer(_StubEngine(), worker_id="w0").render(),
+            exp.render(),
+            frontend,
+        ):
+            assert "# TYPE dynamo_tenant_admitted_total counter" in text
+            assert "# TYPE dynamo_tenant_queue_depth gauge" in text
+            assert ("# TYPE dynamo_tenant_request_ttft_seconds histogram"
+                    in text)
+            assert text.count(
+                "# TYPE dynamo_tenant_admitted_total counter") == 1
+            assert 'dynamo_tenant_admitted_total{tenant="t0"} 1' in text
+            assert ('dynamo_tenant_request_ttft_seconds_bucket{tenant="t0"'
+                    in text)
+            _assert_contract(text, _readme_text())
+    finally:
+        TENANT.reset()
 
 
 def test_prof_families_on_all_three_surfaces():

@@ -1,0 +1,476 @@
+"""Multi-tenant serving plane (dynamo_tpu/tenancy/).
+
+Keystones: (1) tenant identity is minted at the frontend and survives
+every hop — request hints, the engine's quota check, the endpoint wire
+error frame; (2) per-tenant budgets bounce the offending tenant with a
+Retry-After derived from that tenant's OWN queue waits, while other
+tenants keep flowing; (3) SFQ fair share lets a light tenant's fresh
+arrival overtake a storming tenant's backlog; (4) adapter 0 is the
+EXACT identity base model — a banked engine is greedy token-identical
+to a bankless one, and mixed-adapter batches keep per-stream identity;
+(5) aliasing variant names (symlinks, trailing slashes) resolve to ONE
+shared weight load; (6) tools/tenant_stats.py's 0/1/2 exit contract.
+"""
+import asyncio
+import json
+import os
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from dynamo_tpu.mocker import MockerArgs, MockerEngine
+from dynamo_tpu.overload.deadline import apply_request_hints
+from dynamo_tpu.overload.errors import EngineOverloadedError
+from dynamo_tpu.protocols.common import PreprocessedRequest, StopConditions
+from dynamo_tpu.tenancy import (
+    DEFAULT_TENANT,
+    TENANT,
+    TenantQuotas,
+    parse_tenant,
+)
+
+REPO_ROOT = Path(__file__).resolve().parents[1]
+
+
+@pytest.fixture(autouse=True)
+def _clean_tenant_registry():
+    TENANT.reset()
+    yield
+    TENANT.reset()
+
+
+def req(prompt, max_tokens=8, tenant=None, **kw):
+    r = PreprocessedRequest(
+        token_ids=list(prompt),
+        stop_conditions=StopConditions(max_tokens=max_tokens,
+                                       ignore_eos=True),
+        **kw,
+    )
+    if tenant is not None:
+        r.tenant = tenant
+    return r
+
+
+async def collect(eng, r):
+    toks = []
+    async for out in eng.generate(r):
+        toks.extend(out.token_ids)
+    return toks
+
+
+# ---------------------------------------------------------------------------
+# tenant minting: parse_tenant + apply_request_hints
+
+
+def test_parse_tenant_sanitizes_and_defaults():
+    assert parse_tenant(None) == DEFAULT_TENANT
+    assert parse_tenant("") == DEFAULT_TENANT
+    assert parse_tenant("   ") == DEFAULT_TENANT
+    assert parse_tenant("acme") == "acme"
+    assert parse_tenant("  acme  ") == "acme"
+    # label-breaking characters are stripped, not escaped
+    assert parse_tenant('ac"me\\x\n\r') == "acmex"
+    assert parse_tenant('"\\') == DEFAULT_TENANT
+    assert parse_tenant(123) == "123"
+    assert len(parse_tenant("x" * 200)) == 64
+
+
+def test_apply_request_hints_mints_tenant_header_over_body():
+    pre = PreprocessedRequest(token_ids=[1])
+    assert pre.tenant == DEFAULT_TENANT  # legacy traffic
+    apply_request_hints(pre, nvext={"tenant": "body-co"})
+    assert pre.tenant == "body-co"
+    # a proxy-injected header wins over a stale client body
+    apply_request_hints(pre, headers={"X-Tenant-Id": "edge-co"},
+                        nvext={"tenant": "body-co"})
+    assert pre.tenant == "edge-co"
+    # malformed hints fall into the default tenant, never fail
+    apply_request_hints(pre, nvext={"tenant": '"\\'})
+    assert pre.tenant == DEFAULT_TENANT
+
+
+# ---------------------------------------------------------------------------
+# TenantQuotas arithmetic
+
+
+def test_quotas_over_budget_at_cap_not_only_past_it():
+    q = TenantQuotas(max_waiting_requests=2)
+    assert not q.bounded or q.bounded  # bounded property exists
+    assert q.bounded
+    assert not q.over_budget(1, 0)
+    assert q.over_budget(2, 0)  # >= semantics: AT the cap is over
+    qt = TenantQuotas(max_waiting_prefill_tokens=100)
+    assert not qt.over_budget(50, 99)
+    assert qt.over_budget(0, 100)
+    assert not TenantQuotas().bounded  # 0/0 = unbounded
+
+
+def test_quotas_check_raises_with_tenant_and_retry_after():
+    q = TenantQuotas(max_waiting_requests=1)
+    q.check("acme", 0, 0)  # under budget: no-op
+    with pytest.raises(EngineOverloadedError) as ei:
+        q.check("acme", 1, 0)
+    assert ei.value.tenant == "acme"
+    assert ei.value.retry_after_s > 0
+
+
+def test_retry_after_derives_from_the_tenants_own_waits():
+    q = TenantQuotas(max_waiting_requests=4)
+    for _ in range(10):
+        q.note_queue_wait("storm", 2.0)
+        q.note_queue_wait("calm", 0.01)
+    assert q.queue_wait_p50("storm") == pytest.approx(2.0)
+    # p50 x depth, clamped to [0.5, 30]
+    assert q.retry_after_s("storm", 3) == pytest.approx(6.0)
+    assert q.retry_after_s("storm", 100) == 30.0
+    assert q.retry_after_s("calm", 3) == 0.5
+    # no observations yet: the default per-request wait stands in
+    assert q.retry_after_s("fresh", 2) == pytest.approx(2.0)
+
+
+def test_weight_defaults_and_zero_weight_floor():
+    q = TenantQuotas(weights={"big": 4.0, "typo": 0.0})
+    assert q.weight("big") == 4.0
+    assert q.weight("unknown") == 1.0
+    assert q.weight("typo") == pytest.approx(1e-3)  # never divides by 0
+
+
+def test_quotas_snapshot_shape():
+    q = TenantQuotas(max_waiting_requests=2, weights={"a": 2.0})
+    q.note_queue_wait("a", 0.5)
+    snap = q.snapshot()
+    assert snap == {"a": {"weight": 2.0, "queue_wait_p50_s": 0.5}}
+
+
+# ---------------------------------------------------------------------------
+# mocker: per-tenant quota bounce, fair-share ordering, debug view
+
+
+async def test_mocker_tenant_quota_bounces_only_the_offender():
+    """Storming tenant hits ITS budget and 429s with its own Retry-After;
+    a different tenant admits straight through the same engine."""
+    eng = MockerEngine(MockerArgs(
+        speedup_ratio=100.0,
+        tenant_max_waiting_requests=1,
+        max_decode_slots=1,  # serialized service: the rest must wait
+    ))
+    try:
+        prompt = list(range(1, 17))  # 4 blocks
+        gens = [collect(eng, req(prompt, 8, tenant="storm"))
+                for _ in range(6)]
+        tasks = [asyncio.ensure_future(g) for g in gens]
+        done, rejected = 0, []
+        for t in tasks:
+            try:
+                toks = await t
+                assert len(toks) == 8
+                done += 1
+            except EngineOverloadedError as e:
+                rejected.append(e)
+        assert done >= 1
+        assert rejected, "the storm must exhaust its own tenant budget"
+        for e in rejected:
+            assert e.tenant == "storm"
+            assert e.retry_after_s > 0
+        # the OTHER tenant's slice is untouched: admits immediately
+        toks = await collect(eng, req(prompt, 4, tenant="calm"))
+        assert len(toks) == 4
+        assert TENANT.get("dynamo_tenant_rejected_total",
+                          "storm") == len(rejected)
+        assert TENANT.get("dynamo_tenant_rejected_total", "calm") == 0
+        assert TENANT.get("dynamo_tenant_admitted_total", "calm") == 1
+    finally:
+        await eng.stop()
+
+
+async def test_mocker_sfq_lets_light_tenant_overtake_the_storm():
+    """Service is serialized (pool fits one request); tenant-a enqueues
+    a backlog, then tenant-b's single request arrives LAST. SFQ stamps
+    b near the virtual clock, so b finishes ahead of a's backlog tail —
+    strict FIFO would finish b dead last."""
+    eng = MockerEngine(MockerArgs(
+        speedup_ratio=30.0,
+        max_decode_slots=1,  # one request in service at a time
+        tenant_max_waiting_requests=64,
+        tenant_weights={"tenant-b": 4.0},
+    ))
+    try:
+        prompt = list(range(1, 17))
+        order: list[str] = []
+
+        async def run(tenant):
+            await collect(eng, req(prompt, 4, tenant=tenant))
+            order.append(tenant)
+
+        tasks = [asyncio.ensure_future(run("tenant-a")) for _ in range(4)]
+        await asyncio.sleep(0)  # let every a-request enqueue first
+        tasks.append(asyncio.ensure_future(run("tenant-b")))
+        await asyncio.gather(*tasks)
+        assert order.count("tenant-a") == 4
+        # b submitted last but must NOT finish last (FIFO's outcome);
+        # its stamp lands near the head, behind at most the in-flight a
+        assert order.index("tenant-b") <= 1, order
+    finally:
+        await eng.stop()
+
+
+async def test_mocker_tenant_debug_shape_matches_engine_contract():
+    eng = MockerEngine(MockerArgs(
+        speedup_ratio=100.0, tenant_max_waiting_requests=3,
+        tenant_weights={"acme": 2.0},
+    ))
+    try:
+        await collect(eng, req(range(1, 9), 4, tenant="acme"))
+        dbg = eng.tenant_debug()
+        assert dbg["bounded"] is True
+        assert dbg["max_waiting_requests"] == 3
+        assert dbg["n_adapters"] == 0
+        acme = dbg["tenants"]["acme"]
+        assert acme["waiting_requests"] == 0  # drained
+        assert acme["weight"] == 2.0
+        assert acme["queue_wait_p50_s"] >= 0
+        assert acme["metrics"]["dynamo_tenant_admitted_total"] == 1
+        # round-trips as JSON (it is a debug HTTP payload)
+        json.dumps(dbg)
+    finally:
+        await eng.stop()
+
+
+# ---------------------------------------------------------------------------
+# wire: the tenant key survives the endpoint error frame
+
+
+async def test_endpoint_frame_carries_tenant_through_overload():
+    from dynamo_tpu.runtime.endpoint import EndpointServer, call_endpoint
+
+    async def handler(payload):
+        raise EngineOverloadedError(
+            "tenant over quota", retry_after_s=2.5, tenant="acme")
+        yield  # pragma: no cover — makes this an async generator
+
+    srv = EndpointServer(handler)
+    host, port = await srv.start()
+    try:
+        with pytest.raises(EngineOverloadedError) as ei:
+            async for _ in call_endpoint(host, port, {"x": 1}):
+                pass
+        assert ei.value.tenant == "acme"
+        assert ei.value.retry_after_s == 2.5
+    finally:
+        await srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# frontend: variant registration stamps the adapter row + cache salt
+
+
+def test_register_variant_shares_the_base_chain():
+    from dynamo_tpu.frontend.model_manager import (
+        ModelChain,
+        ModelManager,
+        ModelNotFound,
+    )
+
+    class _StubPre:
+        def preprocess_completion(self, r):
+            return PreprocessedRequest(token_ids=[1, 2], model="base")
+
+    engine = object()
+    mgr = ModelManager()
+    mgr.register(ModelChain(name="base", preprocessor=_StubPre(),
+                            engine=engine, backend=None))
+    var = mgr.register_variant("base:support-bot", "base", adapter_id=2)
+    # ONE engine (one weight load, one tokenizer) behind both names
+    assert var.engine is engine
+    assert mgr.get("base:support-bot").adapter_id == 2
+    assert mgr.get("base").adapter_id == 0
+
+    from dynamo_tpu.protocols.openai import CompletionRequest
+
+    creq = CompletionRequest(model="base:support-bot", prompt="hi")
+    pre = var.preprocess(creq)
+    assert pre.adapter_id == 2
+    # the VARIANT name is the prefix-cache salt: adapter deltas change
+    # hidden states, so variants never share cached KV with the base
+    assert pre.model == "base:support-bot"
+    base_pre = mgr.get("base").preprocess(creq)
+    assert base_pre.adapter_id == 0 and base_pre.model == "base"
+
+    with pytest.raises(ValueError):
+        mgr.register_variant("bad", "base", adapter_id=0)
+    with pytest.raises(ModelNotFound):
+        mgr.register_variant("x", "no-such-base", adapter_id=1)
+
+
+# ---------------------------------------------------------------------------
+# model_resolver: aliasing variant names share ONE weight load
+
+
+def test_aliasing_spellings_resolve_to_one_shared_load(tmp_path):
+    from dynamo_tpu.model_resolver import resolve_model, resolver_cache_clear
+
+    resolver_cache_clear()
+    d = tmp_path / "model"
+    d.mkdir()
+    link = tmp_path / "variant-alias"
+    os.symlink(d, link)
+    try:
+        r1 = resolve_model(str(d))
+        r2 = resolve_model(str(d) + "/")       # trailing slash
+        r3 = resolve_model(str(link))          # symlinked variant dir
+        r4 = resolve_model(
+            os.path.join(str(tmp_path), ".", "model"))  # dot segment
+        # one canonical object — engine caches keyed on it load once
+        assert r2 is r1 and r3 is r1 and r4 is r1
+        # the first-seen spelling is preserved (existing contract:
+        # resolve_model(str(d)).path == str(d))
+        assert r1.path == str(d)
+    finally:
+        resolver_cache_clear()
+
+
+def test_resolver_cache_clear_isolates_resolutions(tmp_path):
+    from dynamo_tpu.model_resolver import resolve_model, resolver_cache_clear
+
+    resolver_cache_clear()
+    d = tmp_path / "m"
+    d.mkdir()
+    r1 = resolve_model(str(d))
+    resolver_cache_clear()
+    assert resolve_model(str(d)) is not r1
+    resolver_cache_clear()
+
+
+# ---------------------------------------------------------------------------
+# engine: adapter 0 is the exact identity; nonzero adapters diverge
+
+
+def _ecfg(**kw):
+    from dynamo_tpu.engine.config import EngineConfig
+
+    base = dict(
+        num_pages=128, page_size=16, max_pages_per_seq=16,
+        max_decode_slots=4, prefill_buckets=(64,),
+        cache_dtype="float32",
+    )
+    base.update(kw)
+    return EngineConfig(**base)
+
+
+@pytest.mark.asyncio_timeout(300)
+async def test_adapter_zero_is_token_identical_and_variants_diverge():
+    from dynamo_tpu.engine.engine import TpuEngine
+    from dynamo_tpu.models import llama
+    from dynamo_tpu.models.config import ModelConfig
+    from dynamo_tpu.parallel.mesh import MeshConfig
+    from dynamo_tpu.tenancy.adapters import random_adapter
+
+    cfg = ModelConfig.tiny(dtype="float32")
+    params = llama.init_params(cfg, 0)
+    plain = TpuEngine(cfg, _ecfg(), params=params,
+                      mesh_config=MeshConfig(tp=1))
+    banked = TpuEngine(cfg, _ecfg(lora_adapters=4, lora_rank=4),
+                       params=params, mesh_config=MeshConfig(tp=1))
+    try:
+        banked.install_adapter(
+            2, random_adapter(cfg, 4, seed=7, scale=0.5))
+        rng = np.random.RandomState(0)
+        prompt = rng.randint(1, 256, 40).tolist()
+        base_toks = await collect(plain, req(prompt, 12))
+
+        # adapter 0 through a BANKED engine: greedy token-identical to
+        # an engine with no bank at all (the zero-factor delta is 0.0)
+        assert await collect(banked, req(prompt, 12)) == base_toks
+        # the installed variant actually changes the stream. The variant
+        # model name rides along as the prefix-cache salt — exactly what
+        # ModelChain.preprocess stamps — so the variant never reuses the
+        # base run's cached KV
+        var_toks = await collect(
+            banked, req(prompt, 12, adapter_id=2, model="base:v2"))
+        assert var_toks != base_toks
+        # mixed adapters in ONE batch keep per-stream identity
+        mixed = await asyncio.gather(
+            collect(banked, req(prompt, 12)),
+            collect(banked,
+                    req(prompt, 12, adapter_id=2, model="base:v2")),
+        )
+        assert mixed[0] == base_toks and mixed[1] == var_toks
+        # tenant-sliced adapter accounting saw the variant rounds
+        assert TENANT.get("dynamo_tenant_adapter_rounds_total",
+                          DEFAULT_TENANT) >= 1
+        # out-of-range rows are refused at intake, not on device
+        with pytest.raises(ValueError, match="out of range"):
+            await collect(banked, req(prompt, 4, adapter_id=9))
+        with pytest.raises(ValueError):
+            await collect(plain, req(prompt, 4, adapter_id=1))
+    finally:
+        await plain.stop()
+        await banked.stop()
+
+
+# ---------------------------------------------------------------------------
+# tools/tenant_stats.py exit contract (like tools/kv_fleet.py's)
+
+
+async def _run_tool(*args):
+    proc = await asyncio.create_subprocess_exec(
+        sys.executable, str(REPO_ROOT / "tools" / "tenant_stats.py"),
+        *args,
+        stdout=asyncio.subprocess.PIPE, stderr=asyncio.subprocess.PIPE,
+        cwd=str(REPO_ROOT),
+    )
+    out, err = await proc.communicate()
+    return proc.returncode, out.decode(), err.decode()
+
+
+async def test_tenant_stats_tool_exit_contract():
+    from aiohttp.test_utils import TestServer
+
+    from dynamo_tpu.frontend import HttpService, ModelManager
+    from dynamo_tpu.frontend.model_manager import ModelChain
+
+    eng = MockerEngine(MockerArgs(speedup_ratio=100.0,
+                                  tenant_max_waiting_requests=4))
+    mgr = ModelManager()
+    mgr.register(ModelChain(name="mock", preprocessor=None,
+                            engine=eng, backend=None))
+    svc = HttpService(mgr)
+    server = TestServer(svc.app)
+    await server.start_server()
+    addr = f"127.0.0.1:{server.port}"
+    try:
+        # 1: reachable but no tenant has been seen yet
+        rc, out, _ = await _run_tool("--frontend", addr)
+        assert rc == 1, out
+        assert json.loads(out)["engines"]["mock"]["tenants"] == {}
+
+        # 0: traffic observed, JSON view on stdout
+        await collect(eng, req(range(1, 9), 4, tenant="acme"))
+        rc, out, _ = await _run_tool("--frontend", addr)
+        assert rc == 0, out
+        body = json.loads(out)
+        view = body["engines"]["mock"]["tenants"]["acme"]
+        assert view["metrics"]["dynamo_tenant_admitted_total"] == 1
+        assert "acme" in body["tenants"]
+
+        # 0 with a known --tenant filter; other tenants drop out
+        await collect(eng, req(range(1, 9), 4, tenant="other"))
+        rc, out, _ = await _run_tool("--frontend", addr,
+                                     "--tenant", "acme")
+        assert rc == 0
+        assert set(json.loads(out)["engines"]["mock"]["tenants"]) == {
+            "acme"}
+
+        # 2: unknown tenant, unreachable endpoint, usage error
+        rc, _, err = await _run_tool("--frontend", addr,
+                                     "--tenant", "ghost")
+        assert rc == 2 and "not seen" in err
+        rc, _, err = await _run_tool("--frontend", "127.0.0.1:1")
+        assert rc == 2 and "cannot reach" in err
+        rc, _, _ = await _run_tool()  # missing --frontend
+        assert rc == 2
+    finally:
+        await eng.stop()
+        await server.close()
