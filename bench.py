@@ -572,7 +572,8 @@ async def _run_spec_phase() -> dict:
         prompts.append((pat * (isl // 16 + 1))[:isl])
 
     async def measure(speculative: str, *, draft=False, batch_draft=True,
-                      out_len=osl):
+                      out_len=osl, work=None, **spec_kw):
+        work = prompts if work is None else work
         ekw = {}
         if draft:
             from dynamo_tpu.models import llama as _llama
@@ -585,7 +586,7 @@ async def _run_spec_phase() -> dict:
             cfg,
             EngineConfig(**ecfg_kw, speculative=speculative,
                          num_speculative_tokens=k,
-                         spec_batch_draft=batch_draft),
+                         spec_batch_draft=batch_draft, **spec_kw),
             mesh_config=MeshConfig(tp=1), **ekw,
         )
         eng.start()
@@ -602,10 +603,10 @@ async def _run_spec_phase() -> dict:
             return n
 
         # warmup compiles (prefill buckets, decode round / draft / verify)
-        await asyncio.gather(*[one(p, 8) for p in prompts[:2]])
+        await asyncio.gather(*[one(p, 8) for p in work[:2]])
         t0 = time.monotonic()
         tokens = sum(await asyncio.gather(
-            *[one(p, out_len) for p in prompts]
+            *[one(p, out_len) for p in work]
         ))
         wall = time.monotonic() - t0
         stats = eng.spec.stats() if eng.spec else None
@@ -649,6 +650,48 @@ async def _run_spec_phase() -> dict:
         "spec_draft_per_slot_dispatches_per_token": round(
             pst["spec_draft_dispatch_total"] / max(p_toks, 1), 4
         ),
+    })
+    # tree vs linear vs off at the same repetitive workload: the tree
+    # hedges divergence points with sibling branches and fetches ONE
+    # packed result per verify — same dispatch budget, longer accepted
+    # paths whenever the top-1 chain isn't the whole story
+    tree_tok_s, tst, t_toks = await measure(
+        "ngram", spec_tree=True, spec_branches=4)
+    out.update({
+        "spec_tree_tok_s": round(tree_tok_s, 2),
+        "spec_tree_speedup": round(tree_tok_s / base_tok_s, 3),
+        "spec_tree_vs_linear": round(
+            tree_tok_s / spec_tok_s, 3) if spec_tok_s else None,
+        "spec_accept_rate": round(tst["spec_acceptance_rate"], 4),
+        "spec_tree_mean_path_len": round(
+            tst["spec_tree_mean_path_len"], 3
+        ),
+        "spec_tree_nodes_total": tst["spec_tree_nodes_total"],
+        "spec_branch_accept_hist": tst["spec_branch_accept_hist"],
+        "spec_tree_verify_dispatches_per_token": round(
+            tst["spec_verify_dispatch_total"] / max(t_toks, 1), 4
+        ),
+    })
+    # chat-shaped arm: incompressible random prompts — n-gram acceptance
+    # collapses, the gate must hand every stream back to the fused round
+    # and throughput must hold ~baseline (the de-speculated floor)
+    chat = [rng.randint(1, cfg.vocab_size, isl).tolist()
+            for _ in range(n_req)]
+    c_osl = max(osl // 2, 16)
+    chat_base_tok_s, _, _ = await measure("off", work=chat, out_len=c_osl)
+    chat_tok_s, cst, _ = await measure(
+        "ngram", work=chat, out_len=c_osl, spec_tree=True,
+        spec_branches=4, spec_gate_acceptance=0.35, spec_gate_window=2,
+        spec_rearm_tokens=256,
+    )
+    out.update({
+        "spec_chat_gated_tok_s": round(chat_tok_s, 2),
+        "spec_chat_baseline_tok_s": round(chat_base_tok_s, 2),
+        "spec_chat_gated_speedup": round(
+            chat_tok_s / chat_base_tok_s, 3) if chat_base_tok_s else None,
+        "spec_gated_streams": cst["spec_gated_despec_total"],
+        "spec_rearm_total": cst["spec_rearm_total"],
+        "spec_chat_accept_rate": round(cst["spec_acceptance_rate"], 4),
     })
     return out
 

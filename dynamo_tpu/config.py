@@ -57,6 +57,15 @@ class RuntimeConfig:
     # acceptance-adaptive K (per-slot effective K in [spec_min_k, K])
     spec_adaptive: bool = True
     spec_min_k: int = 1
+    # tree speculation: multi-branch drafts under one tree-causal verify
+    # (budget 0 = auto: 1 + K * branches)
+    spec_tree: bool = False
+    spec_branches: int = 4
+    spec_tree_budget: int = 0
+    # acceptance gating (0.0 = off) + re-arm pacing
+    spec_gate_acceptance: float = 0.0
+    spec_gate_window: int = 4
+    spec_rearm_tokens: int = 256
     # chunk-pipelined KV-transfer plane (kv_transfer.py): pages per
     # streamed chunk (0 = monolithic single-blob transfers), chunk
     # gathers/D2H copies in flight per export stream, and the deadline

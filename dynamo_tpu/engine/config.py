@@ -111,6 +111,26 @@ class EngineConfig:
     # per round (False = legacy per-slot dispatch loop, kept for A/B
     # dispatch-overhead measurement in bench/profile_round)
     spec_batch_draft: bool = True
+    # tree speculation (spec/verifier.py spec_verify_tree): proposals
+    # form a packed token tree — up to spec_branches candidates per
+    # divergence point — verified in ONE forward under a tree-causal
+    # ancestor mask; acceptance walks the deepest surviving root-to-leaf
+    # path and commits only that path's KV rows. spec_tree_budget bounds
+    # the packed node count (root included) so one compiled verify shape
+    # serves every tree; 0 = auto (1 + K * branches, the full comb).
+    spec_tree: bool = False
+    spec_branches: int = 4
+    spec_tree_budget: int = 0
+    # acceptance gating: a stream whose live acceptance EWMA stays below
+    # spec_gate_acceptance for spec_gate_window consecutive verify steps
+    # de-speculates back to the fused round (0.0 disables the gate —
+    # adaptive-K despec still applies); it may re-arm after
+    # spec_rearm_tokens emitted tokens (doubling each time it re-gates),
+    # so chat-shaped traffic stops paying draft overhead while a stream
+    # that turns repetitive mid-flight gets another chance
+    spec_gate_acceptance: float = 0.0
+    spec_gate_window: int = 4
+    spec_rearm_tokens: int = 256
 
     # overload plane (dynamo_tpu/overload/): bounded admission. Intake
     # past either budget raises the retriable EngineOverloadedError

@@ -80,6 +80,8 @@ from dynamo_tpu.overload import (
     PreemptedError,
 )
 from dynamo_tpu.parallel.mesh import MeshConfig, make_mesh
+from dynamo_tpu.spec.metrics import SPEC
+from dynamo_tpu.spec.proposer import comb_parents
 from dynamo_tpu.protocols.common import (
     FinishReason,
     LLMEngineOutput,
@@ -199,6 +201,16 @@ class _Request:
     spec_counts: Optional[np.ndarray] = None
     spec_proposed: int = 0
     spec_accepted: int = 0
+    # acceptance gating: a gated stream runs on the fused round
+    # (spec=False) but keeps mirroring its sequence/counts through
+    # _spec_gated_advance so speculation can re-arm mid-stream
+    spec_gated: bool = False
+    spec_rearm_left: int = 0     # fused tokens until a re-arm attempt
+    spec_gate_backoff: int = 1   # re-arm budget multiplier (doubles)
+    # two-phase re-arm drain: in-flight round entries whose dispatch-time
+    # snapshot still steps this lane (the clear patch lands after them
+    # in program order; their tokens are real and must be mirrored)
+    spec_rearm_wait: int = 0
     # forensics: frontend marks candidates with a "trace_detail"
     # annotation — lifts the round-span cap so late (finish-time) trace
     # promotion still sees the full decode path
@@ -1527,6 +1539,17 @@ class TpuEngine:
         # pool capacity in blocks: the kv_quant=int8 headline — the same
         # HBM budget holds ~2x the blocks of a bf16 pool
         KV_QUANT.set("dynamo_kv_pool_capacity_blocks", a.total_pages)
+        spec_k_mean = spec_k_p50 = spec_k_p95 = 0.0
+        if self.spec is not None:
+            # per-slot adaptive-K distribution over currently-speculating
+            # slots: the mean alone hid bimodal fleets (half the slots
+            # collapsed to min_k, half pinned at the cap)
+            spec_k_mean, spec_k_p50, spec_k_p95 = self.spec.effective_k_dist(
+                np.flatnonzero(self._slot_spec).tolist()
+            )
+            SPEC.set(
+                "dynamo_spec_accept_rate", self.spec.acceptance_rate()
+            )
         return ForwardPassMetrics(
             worker_id=self.ecfg.worker_id,
             worker_stats=WorkerStats(
@@ -1552,13 +1575,20 @@ class TpuEngine:
                 spec_acceptance_rate=(
                     self.spec.acceptance_rate() if self.spec else 0.0
                 ),
-                # mean adaptive K over currently-speculating slots — the
-                # planner-facing signal for how deep speculation is
-                # actually running (0 when off / nothing speculates)
-                spec_effective_k=(
-                    self.spec.effective_k_mean(
-                        np.flatnonzero(self._slot_spec).tolist()
-                    ) if self.spec else 0.0
+                # adaptive-K distribution over currently-speculating
+                # slots — the planner-facing signal for how deep
+                # speculation actually runs (0 when off / idle)
+                spec_effective_k=spec_k_mean,
+                spec_effective_k_p50=spec_k_p50,
+                spec_effective_k_p95=spec_k_p95,
+                spec_tree_nodes_total=(
+                    self.spec.tree_nodes_total if self.spec else 0
+                ),
+                spec_tree_accepted_path_len_total=(
+                    self.spec.tree_path_len_total if self.spec else 0
+                ),
+                spec_gated_despecs_total=(
+                    self.spec.gated_despec_total if self.spec else 0
                 ),
             ),
             histograms=self._histograms_snapshot(),
@@ -2321,6 +2351,8 @@ class TpuEngine:
         when every participant's acceptance sags, the whole round
         shrinks. Returns True if anything was dispatched.
         """
+        if self.spec.tree:
+            return self._dispatch_spec_tree()
         e = self.ecfg
         K_cap = self.spec.k
         ready = [
@@ -2425,15 +2457,174 @@ class TpuEngine:
         ))
         return True
 
-    def _despeculate(self, slot: int, r: _Request) -> None:
+    def _dispatch_spec_tree(self) -> bool:
+        """Tree-speculation round (--spec-tree): same dispatch budget as
+        the linear path — at most ONE draft program + ONE fused verify —
+        but the fetch count IMPROVES to ONE packed [B, 2D+4] handle
+        (tokens | accepted path | n_out | keys; see spec_verify_tree)
+        instead of three.
+
+        Each row carries a packed token tree (flat tokens + parent
+        pointers, node 0 = pending token): the n-gram proposer merges
+        its top-M continuations into a trie on the host; the draft model
+        emits a comb (M branches per depth off a greedy spine) from the
+        SAME fused batch_draft program. The verify scores every node
+        under a tree-causal ancestor mask in one q_start>0 forward,
+        walks the deepest surviving root-to-leaf path on device, and
+        commits only that path's KV rows — sibling rows are never
+        written, so rollback stays pointer truncation.
+
+        Round shape (D depths x M branches) is the bucketed max of the
+        per-slot adaptive controller's (k, m) votes — the branch axis
+        moves OPPOSITE to depth (high acceptance -> deep + narrow; low
+        -> shallow + wide hedging), see AdaptiveKController.observe.
+        """
+        e = self.ecfg
+        T_cap = self.spec.tree_budget
+        ready = [
+            (i, r) for i, r in enumerate(self._slots)
+            if r is not None and r.spec and r.spec_ready
+            and not r.finished and not r.cancelled and not r.spec_inflight
+        ]
+        if not ready:
+            return False
+        rows: list[tuple[int, _Request, int, int, int]] = []
+        dispatched = False
+        for slot, r in ready:
+            n_hist = len(r.spec_tokens)
+            # the dense-mode commit spans up to T_cap rows at [N, N+T);
+            # when that no longer fits the region, hand the slot back
+            # (checked against the BUDGET — the round T isn't known yet)
+            if (n_hist - 1) + T_cap > e.max_context:
+                self._despeculate(slot, r)
+                dispatched = True
+                continue
+            rows.append((
+                slot, r, n_hist,
+                self.spec.k_for(slot), self.spec.m_for(slot),
+            ))
+        if not rows:
+            return dispatched
+        K = self.spec.round_k([k for *_, k, _m in rows])
+        M = self.spec.round_m([m for *_, m in rows])
+        draft_mode = self.spec.draft is not None
+        # comb drafts pack exactly 1 + K*M nodes (the [B, K*M] device
+        # draft splices in verbatim), so M clamps to the budget; n-gram
+        # tries use the full budget so every trie shape compiles to ONE
+        # program shape
+        while draft_mode and 1 + K * M > T_cap and M > 1:
+            M //= 2
+        T = 1 + K * M if draft_mode else T_cap
+        B = self._B
+        toks = np.zeros((B, T), np.int32)
+        parents = np.full((B, T), -2, np.int32)   # -2 = padding node
+        parents[:, 0] = -1                        # node 0 = root
+        slots_a = np.full(B, B, np.int32)         # dummies -> scratch
+        q_starts = np.zeros(B, np.int32)
+        seq_lens = np.zeros(B, np.int32)          # 0: dummy rows masked
+        keys = np.zeros((B, 2), np.uint32)
+        temps = np.zeros(B, np.float32)
+        top_ks = np.zeros(B, np.int32)
+        top_ps = np.ones(B, np.float32)
+        nodes_used = np.zeros(B, np.int32)
+        penalties = None
+        if any(r.spec_counts is not None for _, r, *_ in rows):
+            penalties = (
+                np.zeros((B, self.config.vocab_size), np.int32),
+                np.zeros(B, np.float32),          # freq
+                np.zeros(B, np.float32),          # pres
+                np.ones(B, np.float32),           # rep
+            )
+        comb = (
+            np.asarray(comb_parents(K, M), np.int32)
+            if draft_mode else None
+        )
+        for j, (slot, r, n_hist, _k, _m) in enumerate(rows):
+            toks[j, 0] = r.spec_tokens[-1]        # pending token
+            slots_a[j] = slot
+            q_starts[j] = n_hist - 1
+            seq_lens[j] = (n_hist - 1) + T
+            keys[j] = r.spec_keys
+            so = r.req.sampling_options
+            temps[j] = so.temperature or 0.0
+            top_ks[j] = so.top_k or 0
+            top_ps[j] = so.top_p if so.top_p is not None else 1.0
+            if draft_mode:
+                parents[j] = comb
+                nodes_used[j] = T - 1
+            if penalties is not None and r.spec_counts is not None:
+                penalties[0][j] = r.spec_counts
+                penalties[1][j] = so.frequency_penalty or 0.0
+                penalties[2][j] = so.presence_penalty or 0.0
+                penalties[3][j] = so.repetition_penalty or 1.0
+        t_disp = time.monotonic()
+        drafted = None
+        if draft_mode:
+            # tree drafting always takes the fused batch path: the comb
+            # shape IS a batch_draft output (spine + per-depth top-M)
+            self.dispatch_counts["spec_draft"] += 1
+            drafted = self.spec.propose_batch_tree(
+                [(slot, r.spec_tokens) for slot, r, *_ in rows], B, K, M,
+            )
+        else:
+            for j, (slot, r, _n, _k, _m) in enumerate(rows):
+                tks, prs = self.spec.propose_tree(r.spec_tokens, K, M)
+                n = min(len(tks), T - 1)
+                toks[j, 1:1 + n] = tks[:n]
+                parents[j, 1:1 + n] = prs[:n]
+                nodes_used[j] = n
+        t_draft_end = time.monotonic()
+        self.dispatch_counts["spec_verify"] += 1
+        self.ctx, packed = self.spec.verify_tree(
+            self.params, self.ctx, jnp.asarray(toks), drafted,
+            jnp.asarray(parents), slots_a, q_starts, seq_lens, keys,
+            temps, top_ks, top_ps, K, penalties=penalties,
+        )
+        packed.copy_to_host_async()
+        self.dispatch_counts["fetch"] += 1
+        t_verify_end = time.monotonic()
+        self.flight.record(
+            "spec_verify_tree", slots=[slot for slot, *_ in rows],
+            k=K, m=M, nodes=T - 1, fetches=1,
+            dispatch_ms=round((t_verify_end - t_disp) * 1e3, 3),
+        )
+        for slot, r, *_ in rows:
+            r.spec_ready = False
+            r.spec_inflight = True
+        self._entries.append(_Entry(
+            kind="spec_tree", handle=packed, rows=rows,
+            aux=(M, parents, nodes_used), n_steps=K, t_dispatch=t_disp,
+            spec_host=(t_draft_end - t_disp, t_verify_end - t_draft_end),
+        ))
+        return True
+
+    def _despeculate(
+        self, slot: int, r: _Request, gated: bool = False
+    ) -> None:
         """Hand a speculating slot back to the fused decode round: the
         admit patch restores the exact device state the non-spec path
         would carry (pending token, ctx length, PRNG keys) — the
-        continuation is token-identical."""
+        continuation is token-identical.
+
+        ``gated=True`` marks an acceptance-gate despec (--spec-gate-
+        acceptance): the stream keeps mirroring its sequence on the
+        fused round (_spec_gated_advance) and re-arms speculation after
+        --spec-rearm-tokens fused tokens; each re-gate doubles that
+        budget so a persistently incompressible stream converges to the
+        plain fused round."""
         so = r.req.sampling_options
         r.spec = False
         r.spec_ready = False
-        self.spec.on_despec(slot)
+        if gated:
+            r.spec_gated = True
+            r.spec_rearm_left = (
+                self.ecfg.spec_rearm_tokens * r.spec_gate_backoff
+            )
+            r.spec_gate_backoff *= 2
+            SPEC.inc("dynamo_spec_tree_gated_despecs_total")
+            self.spec.on_gated_despec(slot)
+        else:
+            self.spec.on_despec(slot)
         self._slot_on(slot, r)  # back into the fused round's active set
         self._ctx_disp[slot] = len(r.spec_tokens)
         self._dispatch_patch(admit=dict(
@@ -2515,11 +2706,94 @@ class TpuEngine:
                     r.spec_counts[t] += 1
             r.spec_tokens.extend(toks)  # accepted + bonus, all emitted
             r.spec_keys = new_keys[j]
+            if self.spec.should_gate(slot):
+                # acceptance EWMA pinned under the gate for a full
+                # window: this workload isn't speculation-shaped right
+                # now — run it fused, revisit after the re-arm budget
+                self._despeculate(slot, r, gated=True)
+                continue
             if self.spec.should_despec(slot):
                 # acceptance collapsed: every verify here costs a full
                 # forward for ~1 emitted token — strictly worse than the
                 # fused round. Token-identical continuation, like the
                 # context-limit despec.
+                self._despeculate(slot, r)
+                continue
+            r.spec_ready = True
+            self._ctx_disp[slot] = len(r.spec_tokens)
+
+    def _process_spec_tree(self, entry: _Entry) -> None:
+        """Consume one tree-verify result: the single packed [B, 2D+4]
+        fetch carries, per row, the accepted-path tokens + bonus
+        (cols [0, D]), the accepted node indices (cols [D+1, 2D]), the
+        emitted count n_out (col 2D+1) and the advanced PRNG key
+        bitcast to i32 (cols 2D+2, 2D+3) — see spec_verify_tree.
+
+        Emission is identical to the linear path; the extra tree
+        bookkeeping is the per-branch acceptance histogram + draft-KV
+        spine rollback (on_result_tree), the tree counters on the SPEC
+        scrape registry, and the acceptance gate (a stream whose EWMA
+        pins under --spec-gate-acceptance de-speculates with re-arm
+        armed instead of permanently)."""
+        packed = np.asarray(entry.handle)       # [B, 2D+4] i32
+        D = entry.n_steps                       # round depth (d_max)
+        m_round, parents, nodes_used = entry.aux
+        for j, (slot, r, hist_len, _k, _m) in enumerate(entry.rows):
+            r.spec_inflight = False
+            if r.finished or self._slots[slot] is not r:
+                continue
+            if r.cancelled:
+                self._finish(r, None)
+                continue
+            n = int(packed[j, 2 * D + 1])
+            accepted = n - 1
+            self.spec.on_result_tree(
+                slot, hist_len, accepted, D, m_round,
+                int(nodes_used[j]),
+                [int(x) for x in packed[j, D + 1:D + 1 + accepted]],
+                [int(x) for x in parents[j]],
+            )
+            SPEC.inc("dynamo_spec_tree_nodes_total", int(nodes_used[j]))
+            SPEC.inc("dynamo_spec_tree_accepted_path_len_total", accepted)
+            # acceptance stays tokens-per-depth — directly comparable
+            # to the linear chain at the same K
+            r.spec_proposed += D
+            r.spec_accepted += accepted
+            toks = [int(t) for t in packed[j, :n]]
+            batch: list[int] = []
+            finish: Optional[FinishReason] = None
+            for tok in toks:
+                finish = self._advance_token(r, tok)
+                if finish is FinishReason.EOS:
+                    break  # stop token itself is not emitted
+                batch.append(tok)
+                if finish is not None:
+                    break
+            if batch:
+                self._note_emit(r, len(batch), entry, "spec_verify_round")
+            if batch or finish is not None:
+                extra = (
+                    {"annotations": self._final_annotations(r)}
+                    if finish is not None else {}
+                )
+                r.emit(LLMEngineOutput(
+                    token_ids=batch, finish_reason=finish, **extra
+                ))
+            self.tokens_generated += len(batch)
+            if finish is not None:
+                self._finish(r, None)
+                continue
+            if r.spec_counts is not None:
+                for t in toks:
+                    r.spec_counts[t] += 1
+            r.spec_tokens.extend(toks)  # accepted path + bonus
+            r.spec_keys = np.ascontiguousarray(
+                packed[j, 2 * D + 2:2 * D + 4]
+            ).view(np.uint32)
+            if self.spec.should_gate(slot):
+                self._despeculate(slot, r, gated=True)
+                continue
+            if self.spec.should_despec(slot):
                 self._despeculate(slot, r)
                 continue
             r.spec_ready = True
@@ -3571,7 +3845,7 @@ class TpuEngine:
                 packed[..., 1 + K:])
 
     def _consume_entry(self, entry: _Entry) -> None:
-        if entry.kind in ("round", "spec") and entry.t_dispatch:
+        if entry.kind in ("round", "spec", "spec_tree") and entry.t_dispatch:
             self._h_round.observe(time.monotonic() - entry.t_dispatch)
         data = np.asarray(entry.handle)
         if entry.kind == "first":
@@ -3593,6 +3867,8 @@ class TpuEngine:
             )
         elif entry.kind == "spec":
             self._process_spec(entry)
+        elif entry.kind == "spec_tree":
+            self._process_spec_tree(entry)
         else:
             self._process_round(entry, data)
 
@@ -3686,9 +3962,83 @@ class TpuEngine:
                 ))
             if finish is not None:
                 self._finish(r, None)
+                continue
+            if r.spec_gated or r.spec_rearm_wait > 0:
+                self._spec_gated_advance(slot, r, batch)
         self.tokens_generated += int(
             sum(1 for s in entry.slots if s is not None) * entry.n_steps
         )
+
+    def _spec_gated_advance(
+        self, slot: int, r: _Request, batch: list[int]
+    ) -> None:
+        """Fused-round bookkeeping for a gated (or re-arming) stream:
+        keep the host sequence/penalty mirrors current so speculation
+        can resume exactly where the fused round leaves off — the
+        proposers' lookup corpus and the despec/re-arm patches all read
+        ``spec_tokens``."""
+        if batch:
+            r.spec_tokens.extend(batch)
+            if r.spec_counts is not None:
+                for t in batch:
+                    r.spec_counts[t] += 1
+        if r.spec_rearm_wait > 0:
+            # phase 2 of the re-arm drain: one in-flight round entry
+            # whose snapshot still stepped this lane has been consumed
+            # (its tokens were real — mirrored above); once the last one
+            # lands, the clear patch has taken effect in program order
+            # and the first verify can dispatch
+            r.spec_rearm_wait -= 1
+            if r.spec_rearm_wait == 0:
+                r.spec_ready = True
+            return
+        if self.ecfg.spec_rearm_tokens <= 0:
+            return  # gate is permanent: no re-arm budget configured
+        r.spec_rearm_left -= len(batch)
+        if r.spec_rearm_left <= 0:
+            self._rearm_spec(slot, r)
+
+    def _rearm_spec(self, slot: int, r: _Request) -> None:
+        """Re-arm speculation on a gated stream (two-phase drain).
+
+        Phase 1 (here): flip the request back to spec mode and PARK the
+        device lane. Unlike spec admission (_process_first), the lane is
+        LIVE mid-stream — rounds already dispatched keep stepping it
+        until the clear patch lands in program order — so count the
+        in-flight round entries whose snapshot still contains this lane.
+        Phase 2 (_spec_gated_advance): each such entry's consumption
+        decrements the counter while still mirroring its emitted tokens;
+        at zero the device has drained and spec_ready arms the first
+        verify. Without the drain, that verify's commit would race the
+        in-flight rounds' writes over the same ctx rows.
+        """
+        r.spec = True
+        r.spec_gated = False
+        r.spec_ready = False
+        self.spec.on_rearm(slot)
+        self._slot_off(slot, spec=True)
+        self._dispatch_patch(clear_slots=[slot])
+        self._ctx_disp[slot] = len(r.spec_tokens)
+        # the device PRNG key advanced privately while the stream ran on
+        # the fused round — the host cannot recover it without an extra
+        # fetch. Reseed deterministically from the stale key and the
+        # produced count: greedy streams are unaffected (keys unused),
+        # sampled streams keep seeded reproducibility (same request +
+        # schedule -> same fold) though the draw sequence diverges from
+        # an ungated run's.
+        stale = np.asarray(r.spec_keys, np.uint32)
+        fold = (r.produced * 2654435761 + 0x9E3779B9) & 0xFFFFFFFF
+        r.spec_keys = np.asarray(
+            [int(stale[0]) ^ fold, int(stale[1]) ^ (fold >> 1)],
+            np.uint32,
+        )
+        r.spec_rearm_wait = sum(
+            1 for en in self._entries
+            if en.kind == "round" and slot < len(en.slots)
+            and en.slots[slot] is r
+        )
+        if r.spec_rearm_wait == 0:
+            r.spec_ready = True
 
     def _advance_token(
         self, r: _Request, tok: int

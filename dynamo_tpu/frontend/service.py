@@ -340,6 +340,7 @@ class HttpService:
         from dynamo_tpu.planner_metrics import PLANNER
         from dynamo_tpu.resilience.metrics import RESILIENCE
         from dynamo_tpu.runtime.store_metrics import STORE
+        from dynamo_tpu.spec.metrics import SPEC
         from dynamo_tpu.telemetry.prof import PROF
 
         # SLO burn-rate gauges refresh at scrape time from the frontend's
@@ -362,6 +363,7 @@ class HttpService:
                 + STORE.render().encode()
                 + PLANNER.render().encode()
                 + KV_FLEET.render().encode()
+                + SPEC.render().encode()
                 + FLEET_FEED.render(openmetrics=om).encode()
                 + TENANT.render(openmetrics=om).encode()
                 + FORENSICS.render().encode())

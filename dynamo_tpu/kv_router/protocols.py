@@ -87,10 +87,19 @@ class WorkerStats:
     spec_proposed_total: int = 0
     spec_accepted_total: int = 0
     spec_acceptance_rate: float = 0.0
-    # mean acceptance-adaptive effective K over currently-speculating
-    # slots (0 when speculation is off or nothing speculates) — how deep
-    # speculation is actually running vs the configured cap
+    # acceptance-adaptive effective-K DISTRIBUTION over currently-
+    # speculating slots (0 when speculation is off or nothing
+    # speculates) — how deep speculation actually runs vs the configured
+    # cap. Mean alone hid bimodal fleets (half collapsed to min_k, half
+    # pinned at the cap), hence the per-slot p50/p95.
     spec_effective_k: float = 0.0
+    spec_effective_k_p50: float = 0.0
+    spec_effective_k_p95: float = 0.0
+    # tree speculation (--spec-tree): nodes scored vs path tokens
+    # accepted (budget spent vs bought) and acceptance-gate despecs
+    spec_tree_nodes_total: int = 0
+    spec_tree_accepted_path_len_total: int = 0
+    spec_gated_despecs_total: int = 0
 
 
 @dataclass

@@ -187,6 +187,38 @@ def build_parser() -> argparse.ArgumentParser:
                         "(exported as dynamo_spec_effective_k)")
     p.add_argument("--spec-min-k", type=int, default=cfg.spec_min_k,
                    help="adaptive-K floor per slot")
+    p.add_argument("--spec-tree",
+                   default="on" if cfg.spec_tree else "off",
+                   choices=["on", "off"],
+                   help="tree speculation: draft up to --spec-branches "
+                        "candidates per divergence point and verify the "
+                        "whole tree in one forward under a tree-causal "
+                        "mask; acceptance keeps the deepest surviving "
+                        "root-to-leaf path")
+    p.add_argument("--spec-branches", type=int, default=cfg.spec_branches,
+                   help="branch fan per tree level (the cap when "
+                        "--spec-adaptive walks the branches axis)")
+    p.add_argument("--spec-tree-budget", type=int,
+                   default=cfg.spec_tree_budget,
+                   help="packed tree node budget incl. the root (one "
+                        "compiled verify shape serves every tree); 0 = "
+                        "auto: 1 + K * branches")
+    p.add_argument("--spec-gate-acceptance", type=float,
+                   default=cfg.spec_gate_acceptance,
+                   help="de-speculate a stream whose live acceptance "
+                        "EWMA stays below this for --spec-gate-window "
+                        "consecutive verify steps (0 = no gate); gated "
+                        "streams may re-arm after --spec-rearm-tokens "
+                        "emitted tokens")
+    p.add_argument("--spec-gate-window", type=int,
+                   default=cfg.spec_gate_window,
+                   help="consecutive below-gate verify steps before a "
+                        "stream de-speculates")
+    p.add_argument("--spec-rearm-tokens", type=int,
+                   default=cfg.spec_rearm_tokens,
+                   help="emitted tokens before a gated stream re-arms "
+                        "speculation (doubles each time it re-gates; "
+                        "0 = gated streams never re-arm)")
     p.add_argument("--draft-model-config", default=None,
                    help="canned ModelConfig name for the draft model "
                         "(speculative=draft; must share the target "
@@ -565,6 +597,12 @@ def build_chain(args) -> "Any":
             num_speculative_tokens=args.num_speculative_tokens,
             spec_adaptive=args.spec_adaptive == "on",
             spec_min_k=args.spec_min_k,
+            spec_tree=args.spec_tree == "on",
+            spec_branches=args.spec_branches,
+            spec_tree_budget=args.spec_tree_budget,
+            spec_gate_acceptance=args.spec_gate_acceptance,
+            spec_gate_window=args.spec_gate_window,
+            spec_rearm_tokens=args.spec_rearm_tokens,
             kv_transfer_chunk_pages=args.kv_transfer_chunk_pages,
             kv_transfer_inflight_chunks=args.kv_transfer_inflight_chunks,
             xfer_op_timeout_s=args.xfer_op_timeout,

@@ -159,6 +159,14 @@ class MetricsExporter:
               "mean acceptance-adaptive effective K over speculating slots",
               {w: m.worker_stats.spec_effective_k
                for w, m in snap.metrics.items()})
+        gauge("dynamo_spec_effective_k_p50",
+              "median per-slot effective K over speculating slots",
+              {w: m.worker_stats.spec_effective_k_p50
+               for w, m in snap.metrics.items()})
+        gauge("dynamo_spec_effective_k_p95",
+              "p95 per-slot effective K over speculating slots",
+              {w: m.worker_stats.spec_effective_k_p95
+               for w, m in snap.metrics.items()})
         # latency histograms shipped inside ForwardPassMetrics: one
         # HELP/TYPE block per family, all workers' labelled series under
         # it (the Prometheus text-format grouping requirement)
@@ -191,13 +199,14 @@ class MetricsExporter:
         from dynamo_tpu.planner_metrics import PLANNER
         from dynamo_tpu.resilience.metrics import RESILIENCE
         from dynamo_tpu.runtime.store_metrics import STORE
+        from dynamo_tpu.spec.metrics import SPEC
         from dynamo_tpu.telemetry.prof import PROF
 
         return ("\n".join(lines) + "\n" + RESILIENCE.render()
                 + KV_TRANSFER.render() + KV_QUANT.render()
                 + KV_INTEGRITY.render() + OVERLOAD.render()
                 + PROF.render() + STORE.render() + PLANNER.render()
-                + KV_FLEET.render()
+                + KV_FLEET.render() + SPEC.render()
                 + FLEET_FEED.render(openmetrics=openmetrics)
                 + TENANT.render(openmetrics=openmetrics)
                 + FORENSICS.render())

@@ -739,13 +739,24 @@ def _batch_forward(
     seq_lens: jnp.ndarray,  # [K] i32
     ctx_span: int,
     adapter_ids: Optional[jnp.ndarray] = None,  # [K] i32 bank rows
+    depths: Optional[jnp.ndarray] = None,       # [K, T] i32 tree depths
+                            # (RoPE position = q_start + depth; -1 pad)
+    chunk_masks: Optional[jnp.ndarray] = None,  # [K, T, T] bool tree-
+                            # causal in-chunk visibility (spec tree)
 ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Read-only vmapped layer stack shared by batch_prefill and
     batch_score: K chunks through the model in one program. Returns
     (ks, vs, h) — stacked per-layer KV [K, L, T, kvh, hd] and final
     hidden states [K, T, H]; region writes happen OUTSIDE the vmap (a
     shared-buffer update inside vmap would be a scatter with
-    lane-conflict semantics)."""
+    lane-conflict semantics).
+
+    Tree mode (``depths``/``chunk_masks`` given, always together): the
+    chunk is a packed token TREE, not a linear run — node t's RoPE
+    position is q_start + depths[t] (siblings at one depth share a
+    position) and in-chunk attention follows the caller's ancestor mask
+    instead of index order. Tree chunks never carry adapters (spec is
+    confined to the base model)."""
     c = config
     K, T = tokens.shape
     inv_freq = jnp.asarray(
@@ -756,9 +767,19 @@ def _batch_forward(
     # gather bank rows OUTSIDE the vmap ([K, L, d, r] per site), then vmap
     # over the gathered rows so each lane sees its own [L, d, r] factors
     ag = _gather_adapters(params.get("adapters"), adapter_ids)
+    if depths is not None:
+        assert ag is None, "tree chunks are base-model only"
 
-    def compute(toks, slot, q_start, seq_len, ag_row):
-        positions = q_start + jnp.arange(T, dtype=jnp.int32)
+    def compute(toks, slot, q_start, seq_len, ag_row, depth_row=None,
+                cm_row=None):
+        if depth_row is None:
+            positions = q_start + jnp.arange(T, dtype=jnp.int32)
+            node_valid = positions < seq_len
+        else:
+            # padding nodes (depth -1) pin to position q_start and are
+            # masked out of attention (cm_row) and MoE routing below
+            positions = q_start + jnp.maximum(depth_row, 0)
+            node_valid = (positions < seq_len) & (depth_row >= 0)
         cos, sin = rope_cos_sin(positions, inv_freq)
         h = _embed_rows(params, toks, cdt)
         new_ks: list[jnp.ndarray] = []
@@ -781,11 +802,12 @@ def _batch_forward(
                 else:
                     k_ctx = v_ctx = None
                 return flash_prefill_attention(
-                    q, k_ctx, v_ctx, k_new, v_new, q_start, seq_len
+                    q, k_ctx, v_ctx, k_new, v_new, q_start, seq_len,
+                    chunk_mask=cm_row,
                 )
 
             h, _ = _layer_body(c, lp, h, cos, sin, write_kv, attend,
-                               ffn_valid=positions < seq_len,
+                               ffn_valid=node_valid,
                                ad=_adapter_layer(ag_row, l, per_row=False))
         return (
             jnp.stack(new_ks).astype(cdt),
@@ -793,6 +815,10 @@ def _batch_forward(
             h,
         )
 
+    if depths is not None:
+        return jax.vmap(
+            lambda t, s, q, sl, d, cm: compute(t, s, q, sl, None, d, cm)
+        )(tokens, slots, q_starts, seq_lens, depths, chunk_masks)
     if ag is None:
         return jax.vmap(
             lambda t, s, q, sl: compute(t, s, q, sl, None)
@@ -912,6 +938,62 @@ def batch_score_impl(
     return ctx_kv, _logits(config, params, h)
 
 
+def batch_score_tree_impl(
+    config: ModelConfig,
+    params: Params,
+    ctx_kv: Cache,
+    tokens: jnp.ndarray,       # [B, T] i32 packed tree (node 0 = pending)
+    slots: jnp.ndarray,        # [B] i32 (dummies -> scratch lane)
+    q_starts: jnp.ndarray,     # [B] i32 — tokens already in each region
+    seq_lens: jnp.ndarray,     # [B] i32 — q_start + T live, 0 dummy
+    depths: jnp.ndarray,       # [B, T] i32 node depths (-1 = padding)
+    chunk_masks: jnp.ndarray,  # [B, T, T] bool ancestor-or-self
+    ctx_span: int,             # STATIC prior-context window (> 0)
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Tree-verification scorer: one q_start>0 batched forward over a
+    packed token TREE per slot — RoPE by node depth, in-chunk attention
+    by ancestor mask — returning logits for EVERY node [B, T, V]. Row t
+    scores the target's distribution for the token FOLLOWING node t's
+    root-to-node path.
+
+    Unlike batch_score_impl this does NOT write ctx: a tree's rows are
+    position-aliased (siblings share a RoPE position), so the optimistic
+    linear tail write would land sibling KV in rows the accepted path
+    must own. The caller runs acceptance on device, gathers exactly the
+    accepted path's rows out of the returned (ks, vs), and commits them
+    via commit_tree_path — rollback stays pointer-shaped."""
+    ks, vs, h = _batch_forward(
+        config, params, ctx_kv, tokens, slots, q_starts, seq_lens,
+        ctx_span, None, depths, chunk_masks,
+    )
+    return ks, vs, _logits(config, params, h)
+
+
+def commit_tree_path(
+    ctx_kv: Cache,
+    ks: jnp.ndarray,          # [B, L, T, kvh, hd] from batch_score_tree_impl
+    vs: jnp.ndarray,
+    path: jnp.ndarray,        # [B, T] i32 — accepted node index per output
+                              # position (path[:, 0] == 0, the pending
+                              # token; entries past n_out are ignored)
+    slots: jnp.ndarray,       # [B] i32
+    q_starts: jnp.ndarray,    # [B] i32
+    commit_lens: jnp.ndarray,  # [B] i32 — q_start + n_out (live), 0 dummy
+) -> Cache:
+    """Commit ONLY the accepted root-to-leaf path's KV rows: reorder the
+    fresh-chunk KV by the path's node indices (sibling rows are simply
+    never gathered) and span-write at [q_start, commit_len). Rows past
+    n_out gather clamped garbage but stay dead — attention masks by
+    seq_len, the quantized store bounds its scale window at
+    commit_len - q_start, and the next round's write starts exactly at
+    commit_len. This is what keeps tree rollback pointer truncation."""
+    idx = jnp.clip(path, 0, ks.shape[2] - 1)[:, None, :, None, None]
+    ks_path = jnp.take_along_axis(ks, idx, axis=2)
+    vs_path = jnp.take_along_axis(vs, idx, axis=2)
+    return _write_chunks(ctx_kv, ks_path, vs_path, slots, q_starts,
+                         commit_lens)
+
+
 def batch_draft_impl(
     config: ModelConfig,
     params: Params,
@@ -922,6 +1004,8 @@ def batch_draft_impl(
     seq_lens: jnp.ndarray,  # [B] i32 — q_start + chunk for live rows, 0 dummy
     ctx_span: int,          # STATIC prior-context window
     k: int,                 # STATIC draft depth
+    m: int = 1,             # STATIC branches per level (comb tree; 1 =
+                            # the original linear chain, bit-identical)
 ) -> tuple[Cache, jnp.ndarray]:
     """Draft ``k`` greedy continuation tokens for EVERY speculating slot
     in ONE program: the catch-up chunk (the tokens accepted since the
@@ -936,6 +1020,14 @@ def batch_draft_impl(
     drafted token's KV is never computed (it is never fed back). Rollback
     stays pointer truncation. Dummy rows (seq_len 0) write the scratch
     lane at position 0 and are masked out of attention and MoE routing.
+
+    ``m > 1`` (tree drafts): each fori step records the top-m candidates
+    instead of just the argmax, but ONLY the top-1 "spine" feeds back
+    (and owns the KV written at seq_len + s) — a comb-shaped tree, depth
+    k with m-way fan at every level, from the same program at the same
+    dispatch cost. Returns drafted [B, k*m] in level-major node order
+    (level s occupies columns [s*m, s*m + m), column s*m = the spine);
+    spec/proposer.py comb_parents gives the matching parent pointers.
     """
     B, T = tokens.shape
     ks, vs, h = _batch_forward(
@@ -945,13 +1037,43 @@ def batch_draft_impl(
     last = jnp.maximum(seq_lens - q_starts - 1, 0)
     h_last = jnp.take_along_axis(h, last[:, None, None], axis=1)[:, 0]
     logits = _logits(config, params, h_last)
+    live = seq_lens > 0
+
+    if m > 1:
+        drafted = jnp.zeros((B, k * m), jnp.int32)
+        _, top0 = jax.lax.top_k(logits, m)  # idx 0 == argmax (ties: low)
+        drafted = jax.lax.dynamic_update_slice_in_dim(
+            drafted, top0.astype(jnp.int32), 0, axis=1
+        )
+        if k == 1:
+            return ctx_kv, drafted
+
+        def body_m(s, carry):
+            ctx_kv, drafted = carry
+            # feed level s's spine (column s*m) back, as the m=1 path
+            # feeds its single candidate
+            toks_s = jax.lax.dynamic_slice_in_dim(drafted, s * m, 1, axis=1)
+            pos = jnp.where(live, seq_lens + s, 0)
+            sl = jnp.where(live, pos + 1, 0)
+            ks, vs, h = _batch_forward(
+                config, params, ctx_kv, toks_s, slots, pos, sl, ctx_span
+            )
+            ctx_kv = _write_chunks(ctx_kv, ks, vs, slots, pos, sl)
+            logits = _logits(config, params, h[:, 0])
+            _, nxt = jax.lax.top_k(logits, m)
+            drafted = jax.lax.dynamic_update_slice_in_dim(
+                drafted, nxt.astype(jnp.int32), (s + 1) * m, axis=1
+            )
+            return ctx_kv, drafted
+
+        return jax.lax.fori_loop(0, k - 1, body_m, (ctx_kv, drafted))
+
     drafted = jnp.zeros((B, k), jnp.int32)
     drafted = drafted.at[:, 0].set(
         jnp.argmax(logits, axis=-1).astype(jnp.int32)
     )
     if k == 1:
         return ctx_kv, drafted
-    live = seq_lens > 0
 
     def body(s, carry):
         ctx_kv, drafted = carry
@@ -976,7 +1098,7 @@ def batch_draft_impl(
 
 
 batch_draft = jax.jit(
-    batch_draft_impl, static_argnums=(0, 7, 8), donate_argnums=(2,)
+    batch_draft_impl, static_argnums=(0, 7, 8, 9), donate_argnums=(2,)
 )
 
 

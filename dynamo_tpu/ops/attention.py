@@ -128,6 +128,8 @@ def flash_prefill_attention(
     q_start: jnp.ndarray,  # scalar i32 — #tokens already in the region
     seq_len: jnp.ndarray,  # scalar i32 — total valid context length
     block: int = 256,
+    chunk_mask: Optional[jnp.ndarray] = None,  # [T, T] bool in-chunk
+                            # visibility (tree-causal); None = causal
 ) -> jnp.ndarray:
     """Blocked running-softmax ("flash") prefill attention in pure XLA.
 
@@ -145,6 +147,15 @@ def flash_prefill_attention(
     context scan is omitted entirely from the compiled program instead of
     masked out. The reference's analogue of this split is vLLM's
     prefill-vs-extend kernel dispatch.
+
+    ``chunk_mask`` replaces the causal in-chunk mask with an explicit
+    [T, T] visibility matrix (chunk_mask[i, j] = query row i may attend
+    chunk key j) — the tree-speculation hook: verify chunks hold a packed
+    token TREE whose nodes attend their ancestor chain, not their index
+    predecessors (spec/verifier.py builds it from parent pointers). The
+    prior-context scan is unaffected: every tree node attends the full
+    committed prefix. Rows with no visible key anywhere (padding nodes)
+    fall out of the m > NEG_INF/2 gate below and emit zeros.
     """
     T, n_heads, hd = q.shape
     kvh = k_new.shape[1]
@@ -208,12 +219,21 @@ def flash_prefill_attention(
             ),
             carry,
         )
-    # the chunk itself: causal, bounded by seq_len
+    # the chunk itself: causal, bounded by seq_len — or the caller's
+    # explicit (tree-causal) visibility matrix, sliced per key block
+    if chunk_mask is None:
+        in_chunk = lambda kp: (  # noqa: E731 — tiny closure pair
+            ((q_start + kp)[None, :] <= q_pos[:, None])
+            & ((q_start + kp) < seq_len)[None, :]
+        )
+    else:
+        in_chunk = lambda kp: jnp.take(  # noqa: E731
+            chunk_mask, kp, axis=1
+        )
     carry = blocked(
         k_new.transpose(1, 0, 2).astype(qt.dtype),
         v_new.transpose(1, 0, 2).astype(qt.dtype),
-        lambda kp: ((q_start + kp)[None, :] <= q_pos[:, None])
-        & ((q_start + kp) < seq_len)[None, :],
+        in_chunk,
         carry,
     )
     m, l, acc = carry

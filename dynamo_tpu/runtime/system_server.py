@@ -158,6 +158,12 @@ class SystemServer:
                 g("dynamo_spec_effective_k",
                   "mean acceptance-adaptive effective K over "
                   "speculating slots", ws.spec_effective_k)
+                g("dynamo_spec_effective_k_p50",
+                  "median per-slot effective K over speculating slots",
+                  ws.spec_effective_k_p50)
+                g("dynamo_spec_effective_k_p95",
+                  "p95 per-slot effective K over speculating slots",
+                  ws.spec_effective_k_p95)
                 for name, snap in sorted(
                     (getattr(m, "histograms", None) or {}).items()
                 ):
@@ -175,13 +181,14 @@ class SystemServer:
         from dynamo_tpu.overload import OVERLOAD
         from dynamo_tpu.planner_metrics import PLANNER
         from dynamo_tpu.runtime.store_metrics import STORE
+        from dynamo_tpu.spec.metrics import SPEC
         from dynamo_tpu.telemetry.prof import PROF
 
         return ("\n".join(lines) + "\n" + RESILIENCE.render()
                 + KV_TRANSFER.render() + KV_QUANT.render()
                 + KV_INTEGRITY.render() + OVERLOAD.render()
                 + PROF.render() + STORE.render() + PLANNER.render()
-                + KV_FLEET.render()
+                + KV_FLEET.render() + SPEC.render()
                 + FLEET_FEED.render(openmetrics=openmetrics)
                 + TENANT.render(openmetrics=openmetrics)
                 + FORENSICS.render())

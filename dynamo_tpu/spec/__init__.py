@@ -26,10 +26,33 @@ the scratch lane, exactly like freed slots) and drives them through
 verify dispatches instead; rejected tokens need no device-side cleanup
 because the contiguous ctx region masks attention by sequence length and
 later writes overwrite the dead span — rollback is pointer truncation.
+
+Tree mode (--spec-tree): proposals form a packed token tree (flat
+tokens + parent pointers, bounded by --spec-tree-budget) drafted either
+by the n-gram trie (propose_tree) or the comb-shaped multi-branch
+batch_draft; spec_verify_tree scores every node in one forward under a
+tree-causal ancestor mask, walks the deepest surviving root-to-leaf
+path on device, and commits ONLY that path's KV rows — so a first-token
+mismatch no longer throws away the whole draft, and rollback stays
+pointer truncation. Acceptance gating (--spec-gate-acceptance) hands
+persistently low-acceptance streams back to the fused round;
+metrics.py's SPEC registry carries the tree counters to every scrape
+surface.
 """
 from dynamo_tpu.spec.decoder import AdaptiveKController, SpecDecoder
-from dynamo_tpu.spec.proposer import DraftModelProposer, NGramProposer
-from dynamo_tpu.spec.verifier import accept_tokens, spec_verify
+from dynamo_tpu.spec.metrics import SPEC
+from dynamo_tpu.spec.proposer import (
+    DraftModelProposer,
+    NGramProposer,
+    comb_parents,
+)
+from dynamo_tpu.spec.verifier import (
+    accept_tokens,
+    accept_tree,
+    spec_verify,
+    spec_verify_tree,
+    tree_meta,
+)
 
 __all__ = [
     "SpecDecoder",
@@ -37,5 +60,10 @@ __all__ = [
     "NGramProposer",
     "DraftModelProposer",
     "accept_tokens",
+    "accept_tree",
+    "comb_parents",
     "spec_verify",
+    "spec_verify_tree",
+    "tree_meta",
+    "SPEC",
 ]

@@ -38,7 +38,7 @@ import numpy as np
 from dynamo_tpu.engine.config import EngineConfig, pow2_cover
 from dynamo_tpu.models.config import ModelConfig
 from dynamo_tpu.spec.proposer import DraftModelProposer, NGramProposer
-from dynamo_tpu.spec.verifier import spec_verify
+from dynamo_tpu.spec.verifier import spec_verify, spec_verify_tree
 
 
 class AdaptiveKController:
@@ -58,15 +58,18 @@ class AdaptiveKController:
 
     def __init__(self, k_max: int, k_min: int, *, grow_at: float,
                  shrink_at: float, despec_at: float, ewma: float,
-                 min_obs: int):
+                 min_obs: int, m_max: int = 1):
         if not 1 <= k_min <= k_max:
             raise ValueError("need 1 <= spec_min_k <= num_speculative_tokens")
         if not 0.0 <= despec_at <= shrink_at <= grow_at <= 1.0:
             raise ValueError(
                 "need 0 <= despec_at <= shrink_at <= grow_at <= 1"
             )
+        if m_max < 1:
+            raise ValueError("spec_branches must be >= 1")
         self.k_max = k_max
         self.k_min = k_min
+        self.m_max = m_max
         self.grow_at = grow_at
         self.shrink_at = shrink_at
         self.despec_at = despec_at
@@ -78,10 +81,13 @@ class AdaptiveKController:
         # spec-round k lookups and the metrics-path effective-K mean are
         # array reads, not dict traffic on the engine hot loop.
         self._k = np.full(8, k_max, np.int32)
+        self._m = np.full(8, m_max, np.int32)
         self._rate = np.full(8, np.nan, np.float64)
         self._obs = np.zeros(8, np.int32)
         self.grow_total = 0
         self.shrink_total = 0
+        self.branch_grow_total = 0
+        self.branch_shrink_total = 0
 
     def _ensure(self, slot: int) -> None:
         n = len(self._k)
@@ -90,6 +96,8 @@ class AdaptiveKController:
         grow = max(slot + 1, 2 * n)
         self._k = np.concatenate(
             [self._k, np.full(grow - n, self.k_max, np.int32)])
+        self._m = np.concatenate(
+            [self._m, np.full(grow - n, self.m_max, np.int32)])
         self._rate = np.concatenate(
             [self._rate, np.full(grow - n, np.nan, np.float64)])
         self._obs = np.concatenate(
@@ -110,6 +118,21 @@ class AdaptiveKController:
         out[mask] = self._k[slots[mask]]
         return out
 
+    def m_for(self, slot: int) -> int:
+        """The slot's effective branch fan (tree speculation). Starts at
+        m_max — a fresh stream hedges WIDE until evidence says the top-1
+        chain is reliable."""
+        if slot >= len(self._m):
+            return self.m_max
+        return int(self._m[slot])
+
+    def m_for_slots(self, slots) -> np.ndarray:
+        slots = np.asarray(slots, np.int64)
+        out = np.full(len(slots), self.m_max, np.int32)
+        mask = slots < len(self._m)
+        out[mask] = self._m[slots[mask]]
+        return out
+
     def rate_for(self, slot: int) -> Optional[float]:
         if slot >= len(self._rate) or np.isnan(self._rate[slot]):
             return None
@@ -125,12 +148,25 @@ class AdaptiveKController:
         self._rate[slot] = rate
         self._obs[slot] += 1
         k = int(self._k[slot])
-        if rate >= self.grow_at and k < self.k_max:
-            self._k[slot] = k + 1
-            self.grow_total += 1
-        elif rate <= self.shrink_at and k > self.k_min:
-            self._k[slot] = k - 1
-            self.shrink_total += 1
+        m = int(self._m[slot])
+        if rate >= self.grow_at:
+            # accepting well: the spine is reliable — go DEEPER and
+            # NARROWER (hedging siblings stop earning their node budget)
+            if k < self.k_max:
+                self._k[slot] = k + 1
+                self.grow_total += 1
+            if m > 1:
+                self._m[slot] = m - 1
+                self.branch_shrink_total += 1
+        elif rate <= self.shrink_at:
+            # rejecting early: shallower, but hedge WIDER — divergence
+            # at the first level is exactly what sibling branches catch
+            if k > self.k_min:
+                self._k[slot] = k - 1
+                self.shrink_total += 1
+            if m < self.m_max:
+                self._m[slot] = m + 1
+                self.branch_grow_total += 1
 
     def should_despec(self, slot: int) -> bool:
         # NaN (never observed) compares False against despec_at — the
@@ -142,6 +178,7 @@ class AdaptiveKController:
     def release(self, slot: int) -> None:
         if slot < len(self._k):
             self._k[slot] = self.k_max
+            self._m[slot] = self.m_max
             self._rate[slot] = np.nan
             self._obs[slot] = 0
 
@@ -166,6 +203,19 @@ class SpecDecoder:
         self.k = ecfg.num_speculative_tokens
         self.config = config
         self.ecfg = ecfg
+        # tree speculation: B branches per divergence point, verified
+        # under one tree-causal mask; budget bounds the packed node
+        # count so ONE compiled verify shape serves every tree
+        self.tree = bool(ecfg.spec_tree)
+        self.branches = max(int(ecfg.spec_branches), 1)
+        self.tree_budget = int(ecfg.spec_tree_budget) or (
+            1 + self.k * self.branches
+        )
+        if self.tree and self.tree_budget < 1 + self.k:
+            raise ValueError(
+                "spec_tree_budget must cover the root plus one full-"
+                f"depth chain (need >= {1 + self.k})"
+            )
         self.adaptive: Optional[AdaptiveKController] = None
         if ecfg.spec_adaptive:
             self.adaptive = AdaptiveKController(
@@ -175,6 +225,7 @@ class SpecDecoder:
                 despec_at=ecfg.spec_despec_threshold,
                 ewma=ecfg.spec_rate_ewma,
                 min_obs=ecfg.spec_min_observations,
+                m_max=self.branches if self.tree else 1,
             )
         self.ngram: Optional[NGramProposer] = None
         self.draft: Optional[DraftModelProposer] = None
@@ -205,6 +256,25 @@ class SpecDecoder:
         # draft_dispatch_total growing O(rounds), not O(slots * K)
         self.draft_dispatch_total = 0
         self.verify_dispatch_total = 0
+        # tree statistics
+        self.tree_nodes_total = 0        # tree nodes scored (excl. root)
+        self.tree_path_len_total = 0     # accepted path tokens
+        self.tree_verify_steps = 0
+        # accepted nodes by branch ordinal (position among same-parent
+        # siblings, index order) — the per-branch acceptance breakdown
+        self.branch_accept_hist = np.zeros(
+            max(self.branches, 1), np.int64
+        )
+        # acceptance gating: a stream whose live acceptance EWMA sits
+        # below spec_gate_acceptance for spec_gate_window consecutive
+        # verify steps de-speculates (chat traffic stops paying draft
+        # overhead); the engine may re-arm it later
+        self.gate_at = float(ecfg.spec_gate_acceptance)
+        self.gate_window = max(int(ecfg.spec_gate_window), 1)
+        self.gated_despec_total = 0
+        self.rearm_total = 0
+        self._gate_rate: dict[int, float] = {}
+        self._gate_low: dict[int, int] = {}
 
     # ------------------------------------------------------------------
 
@@ -243,6 +313,53 @@ class SpecDecoder:
     def should_despec(self, slot: int) -> bool:
         return self.adaptive is not None and self.adaptive.should_despec(slot)
 
+    def m_for(self, slot: int) -> int:
+        """The slot's effective branch fan (1 when tree spec is off)."""
+        if not self.tree:
+            return 1
+        if self.adaptive is None:
+            return self.branches
+        return self.adaptive.m_for(slot)
+
+    def round_m(self, ms: list[int]) -> int:
+        """The round's branch fan: max effective m, bucketed to a power
+        of two and clamped to the CLI fan — same compile-count argument
+        as round_k, applied to the tree's second axis."""
+        return min(pow2_cover(max(ms)), self.branches)
+
+    # ------------------------------------------------------------------
+    # acceptance gating (per-workload de-speculation)
+
+    def observe_gate(self, slot: int, accepted: int, k_used: int) -> None:
+        """Track the stream's live acceptance EWMA against the gate
+        threshold; a window of consecutive below-gate steps marks the
+        stream as losing money on speculation."""
+        if self.gate_at <= 0.0:
+            return
+        step = accepted / max(k_used, 1)
+        prev = self._gate_rate.get(slot)
+        ew = self.ecfg.spec_rate_ewma
+        rate = step if prev is None else ew * prev + (1.0 - ew) * step
+        self._gate_rate[slot] = rate
+        if rate < self.gate_at:
+            self._gate_low[slot] = self._gate_low.get(slot, 0) + 1
+        else:
+            self._gate_low[slot] = 0
+
+    def should_gate(self, slot: int) -> bool:
+        return (self.gate_at > 0.0
+                and self._gate_low.get(slot, 0) >= self.gate_window)
+
+    def gate_rate_for(self, slot: int) -> Optional[float]:
+        return self._gate_rate.get(slot)
+
+    def on_gated_despec(self, slot: int) -> None:
+        self.gated_despec_total += 1
+        self.on_despec(slot)
+
+    def on_rearm(self, slot: int) -> None:
+        self.rearm_total += 1
+
     # ------------------------------------------------------------------
     # proposing
 
@@ -263,6 +380,25 @@ class SpecDecoder:
         """ONE batched draft dispatch for all speculating slots."""
         self.draft_dispatch_total += 1
         return self.draft.propose_batch(rows, width, k)
+
+    def propose_tree(
+        self, history: list[int], depth: int, branches: int
+    ) -> tuple[list[int], list[int]]:
+        """N-gram trie proposal: (tokens, parents) excluding the root,
+        at most tree_budget - 1 nodes (see NGramProposer.propose_tree)."""
+        return self.ngram.propose_tree(
+            history, depth, branches, self.tree_budget
+        )
+
+    def propose_batch_tree(
+        self, rows: list[tuple[int, list[int]]], width: int, k: int,
+        m: int,
+    ) -> jnp.ndarray:
+        """ONE batched comb-tree draft dispatch (llama.batch_draft with
+        branches=m); parents for the emitted [width, k*m] node order are
+        proposer.comb_parents(k, m)."""
+        self.draft_dispatch_total += 1
+        return self.draft.propose_batch(rows, width, k, branches=m)
 
     def verify(
         self,
@@ -294,6 +430,37 @@ class SpecDecoder:
             penalties,
         )
 
+    def verify_tree(
+        self,
+        params: Any,
+        ctx_kv: Any,
+        tokens: jnp.ndarray,
+        draft: Optional[jnp.ndarray],
+        parents: np.ndarray,
+        slots: np.ndarray,
+        q_starts: np.ndarray,
+        seq_lens: np.ndarray,
+        keys: np.ndarray,
+        temps: np.ndarray,
+        top_ks: np.ndarray,
+        top_ps: np.ndarray,
+        d_max: int,
+        penalties=None,
+    ):
+        """Tree score + accept + path-commit; returns (ctx_kv, packed
+        [B, 2*d_max + 4]) — ONE fetched array per round."""
+        self.verify_dispatch_total += 1
+        if penalties is not None:
+            penalties = tuple(jnp.asarray(a) for a in penalties)
+        return spec_verify_tree(
+            self.config, params, ctx_kv, tokens, draft,
+            jnp.asarray(parents), jnp.asarray(slots),
+            jnp.asarray(q_starts), jnp.asarray(seq_lens),
+            jnp.asarray(keys), jnp.asarray(temps), jnp.asarray(top_ks),
+            jnp.asarray(top_ps), self.ecfg.max_top_k,
+            self.ecfg.max_context, d_max, penalties,
+        )
+
     # ------------------------------------------------------------------
 
     def on_result(
@@ -310,8 +477,58 @@ class SpecDecoder:
             self.reject_events += 1
         if self.adaptive is not None:
             self.adaptive.observe(slot, accepted, k_used)
+        self.observe_gate(slot, accepted, k_used)
         if self.draft is not None:
             self.draft.truncate(slot, hist_len + accepted)
+
+    def on_result_tree(
+        self,
+        slot: int,
+        hist_len: int,
+        accepted: int,
+        d_used: int,
+        m_used: int,
+        nodes: int,
+        path_nodes: list[int],
+        parents: list[int],
+    ) -> None:
+        """One TREE verify landed: ``accepted`` path tokens out of a
+        depth-``d_used`` tree carrying ``nodes`` proposal nodes;
+        ``path_nodes`` is the accepted node-index chain (depth 1..) and
+        ``parents`` the slot's full parent list (root at 0). Acceptance
+        rate stays tokens-per-depth (accepted / d_used) — the same
+        currency the linear path and the controller thresholds use, so
+        tree and linear EWMAs are comparable."""
+        self.proposed_total += d_used
+        self.accepted_total += accepted
+        self.verify_steps += 1
+        self.tree_verify_steps += 1
+        self.tree_nodes_total += nodes
+        self.tree_path_len_total += accepted
+        if accepted < d_used:
+            self.reject_events += 1
+        # per-branch breakdown: each accepted node's ordinal among its
+        # same-parent siblings (index order — ordinal 0 is the spine /
+        # best candidate)
+        for node in path_nodes[:accepted]:
+            par = parents[node]
+            ordinal = sum(1 for j in range(1, node) if parents[j] == par)
+            if ordinal < len(self.branch_accept_hist):
+                self.branch_accept_hist[ordinal] += 1
+        if self.adaptive is not None:
+            self.adaptive.observe(slot, accepted, d_used)
+        self.observe_gate(slot, accepted, d_used)
+        if self.draft is not None:
+            # only the comb SPINE's KV sits in the draft region — the
+            # valid draft prefix is the accepted path's run along it
+            # (spine node at depth t+1 is index 1 + t*m)
+            spine = 0
+            for t, node in enumerate(path_nodes[:accepted]):
+                if node == 1 + t * m_used:
+                    spine += 1
+                else:
+                    break
+            self.draft.truncate(slot, hist_len + spine)
 
     def on_despec(self, slot: int) -> None:
         self.despec_total += 1
@@ -322,6 +539,8 @@ class SpecDecoder:
             self.draft.release(slot)
         if self.adaptive is not None:
             self.adaptive.release(slot)
+        self._gate_rate.pop(slot, None)
+        self._gate_low.pop(slot, None)
 
     def acceptance_rate(self) -> float:
         return self.accepted_total / max(self.proposed_total, 1)
@@ -337,6 +556,27 @@ class SpecDecoder:
             return float(self.k)
         return float(self.adaptive.k_for_slots(slots).mean())
 
+    def effective_k_dist(self, slots) -> tuple[float, float, float]:
+        """(mean, p50, p95) of per-slot effective K over the given
+        speculating slots. The distribution matters: one hot repetitive
+        stream at K=8 disappears into a fleet mean pulled down by a
+        crowd of chat streams at K=2 — exactly the signal a planner
+        gate reading only the mean would miss."""
+        if len(slots) == 0:
+            return 0.0, 0.0, 0.0
+        if self.adaptive is None:
+            k = float(self.k)
+            return k, k, k
+        ks = self.adaptive.k_for_slots(slots).astype(np.float64)
+        return (
+            float(ks.mean()),
+            float(np.percentile(ks, 50)),
+            float(np.percentile(ks, 95)),
+        )
+
+    def tree_mean_path_len(self) -> float:
+        return self.tree_path_len_total / max(self.tree_verify_steps, 1)
+
     def stats(self) -> dict[str, Any]:
         out = {
             "mode": self.mode,
@@ -350,8 +590,22 @@ class SpecDecoder:
             "spec_draft_dispatch_total": self.draft_dispatch_total,
             "spec_verify_dispatch_total": self.verify_dispatch_total,
             "spec_adaptive": self.adaptive is not None,
+            "spec_tree": self.tree,
+            "spec_branches": self.branches,
+            "spec_tree_budget": self.tree_budget,
+            "spec_tree_nodes_total": self.tree_nodes_total,
+            "spec_tree_accepted_path_len_total": self.tree_path_len_total,
+            "spec_tree_verify_steps": self.tree_verify_steps,
+            "spec_tree_mean_path_len": self.tree_mean_path_len(),
+            "spec_branch_accept_hist": self.branch_accept_hist.tolist(),
+            "spec_gated_despec_total": self.gated_despec_total,
+            "spec_rearm_total": self.rearm_total,
         }
         if self.adaptive is not None:
             out["spec_k_grow_total"] = self.adaptive.grow_total
             out["spec_k_shrink_total"] = self.adaptive.shrink_total
+            out["spec_branch_grow_total"] = self.adaptive.branch_grow_total
+            out["spec_branch_shrink_total"] = (
+                self.adaptive.branch_shrink_total
+            )
         return out

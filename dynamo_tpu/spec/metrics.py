@@ -1,0 +1,52 @@
+"""Speculation metric plane: tree/gating counters on every scrape surface.
+
+One process-wide CounterRegistry (the resilience/kv-transfer pattern —
+telemetry/metrics.py) holding the tree-speculation families that the
+ROADMAP perf loop reads:
+
+  dynamo_spec_tree_nodes_total          tree nodes scored by verify
+                                        (root excluded) — the budget
+                                        actually spent
+  dynamo_spec_tree_accepted_path_len_total
+                                        accepted path tokens — what the
+                                        budget bought
+  dynamo_spec_tree_gated_despecs_total  streams de-speculated by the
+                                        acceptance gate
+  dynamo_spec_accept_rate               live fleet acceptance fraction
+                                        (gauge, accepted/proposed)
+
+The engine's spec result path increments these; frontend/service.py,
+runtime/system_server.py and metrics_exporter.py all append
+``SPEC.render()`` to their /metrics responses, so the same series is
+visible whichever surface a given deployment scrapes (the DTL005
+metrics-contract rule pins all three).
+"""
+from __future__ import annotations
+
+from dynamo_tpu.telemetry.metrics import CounterRegistry
+
+SPEC_FAMILIES: tuple[tuple[str, str, str], ...] = (
+    (
+        "dynamo_spec_tree_nodes_total",
+        "counter",
+        "Speculative tree nodes scored by verification (root excluded)",
+    ),
+    (
+        "dynamo_spec_tree_accepted_path_len_total",
+        "counter",
+        "Accepted root-to-leaf path tokens across tree verify steps",
+    ),
+    (
+        "dynamo_spec_tree_gated_despecs_total",
+        "counter",
+        "Streams de-speculated by the acceptance gate "
+        "(--spec-gate-acceptance)",
+    ),
+    (
+        "dynamo_spec_accept_rate",
+        "gauge",
+        "Live speculation acceptance fraction (accepted/proposed)",
+    ),
+)
+
+SPEC = CounterRegistry(SPEC_FAMILIES, label="spec")

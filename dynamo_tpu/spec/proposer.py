@@ -71,6 +71,70 @@ class NGramProposer:
                     return cont + [0] * (k - len(cont))
         return [0] * k
 
+    def propose_tree(
+        self, history: list[int], depth: int, branches: int, budget: int
+    ) -> tuple[list[int], list[int]]:
+        """Multi-candidate prompt lookup: collect up to ``branches``
+        distinct earlier occurrences of the tail n-gram (longest n
+        first, most recent first — the same preference order as
+        propose) and merge their continuation chains into one token
+        trie. Shared prefixes dedup into a single node, so disagreeing
+        continuations fork exactly at their divergence point instead of
+        burning budget on duplicated stems.
+
+        Returns (tokens, parents) EXCLUDING the root: parent value 0
+        points at the pending token, otherwise at the 1-based index of
+        an earlier returned node — ready to pack behind the verifier's
+        node 0. At most ``budget - 1`` nodes come back (the root takes
+        one slot of the tree budget); no match degrades to the single
+        zero-chain the linear path proposes."""
+        hist = history[-self.max_lookback:]
+        L = len(hist)
+        conts: list[list[int]] = []
+        for n in range(min(self.max_n, L - 1), self.min_n - 1, -1):
+            tail = hist[-n:]
+            for j in range(L - n - 1, -1, -1):
+                if hist[j : j + n] == tail:
+                    cont = hist[j + n : j + n + depth]
+                    if cont and cont not in conts:
+                        conts.append(cont)
+                        if len(conts) >= branches:
+                            break
+            if len(conts) >= branches:
+                break
+        if not conts:
+            conts = [[0] * depth]
+        tokens: list[int] = []
+        parents: list[int] = []
+        children: dict[tuple[int, int], int] = {}  # (parent, tok) -> node
+        cap = budget - 1
+        for cont in conts:
+            parent = 0  # the pending-token root
+            for tok in cont:
+                node = children.get((parent, tok))
+                if node is None:
+                    if len(tokens) >= cap:
+                        break
+                    tokens.append(tok)
+                    parents.append(parent)
+                    node = len(tokens)  # 1-based: 0 is the root
+                    children[(parent, tok)] = node
+                parent = node
+        return tokens, parents
+
+
+def comb_parents(k: int, m: int) -> list[int]:
+    """Parent pointers for the comb tree llama.batch_draft emits in
+    branch mode (m > 1): depth k, m-way fan at every level, only the
+    top-1 "spine" extends. Node order matches the drafted [B, k*m]
+    array — level s occupies 1 + s*m .. 1 + s*m + m - 1 with column
+    s*m the spine. Returns the FULL [1 + k*m] list including the root's
+    -1; pad with -2 up to the tree budget."""
+    parents = [-1]
+    for s in range(k):
+        parents.extend([0 if s == 0 else 1 + (s - 1) * m] * m)
+    return parents
+
 
 class DraftModelProposer:
     """Draft-model proposer with a private contiguous ctx region.
@@ -152,7 +216,8 @@ class DraftModelProposer:
         return jnp.stack(drafted)
 
     def propose_batch(
-        self, rows: list[tuple[int, list[int]]], width: int, k: int
+        self, rows: list[tuple[int, list[int]]], width: int, k: int,
+        branches: int = 1,
     ) -> jnp.ndarray:
         """Draft k tokens for EVERY speculating slot in ONE device
         dispatch (llama.batch_draft): the per-slot catch-up chunks run as
@@ -164,6 +229,11 @@ class DraftModelProposer:
         lanes up to ``width`` are dummies (scratch lane, seq_len 0),
         mirroring the verifier's batch layout so the returned [width, k]
         array splices row-aligned into the verify dispatch.
+
+        ``branches > 1`` drafts the comb tree (see comb_parents) at the
+        SAME dispatch cost — the returned array is [width, k * branches]
+        in level-major node order, and only the spine's KV lands in the
+        draft region, so the rollback pointer math below is unchanged.
         """
         S = self.ecfg.max_context
         scratch = self.ecfg.max_decode_slots
@@ -195,7 +265,7 @@ class DraftModelProposer:
         self.ctx, drafted = llama.batch_draft(
             self.config, self.params, self.ctx,
             jnp.asarray(toks), jnp.asarray(slots_a),
-            jnp.asarray(q_starts), jnp.asarray(seq_lens), S, k,
+            jnp.asarray(q_starts), jnp.asarray(seq_lens), S, k, branches,
         )
         for slot, hist, _ in chunks:
             # KV written: history plus drafted[:-1] (the last draft is

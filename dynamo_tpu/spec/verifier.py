@@ -35,6 +35,29 @@ The scan consumes the SAME PRNG key stream as the vectorized path, so a
 zero-count/identity-penalty slot produces bit-identical draws on either
 variant. Rounds with no penalized slot keep the vectorized no-histogram
 path (and skip the [B, V] counts upload entirely).
+
+Tree verification (spec_verify_tree): the proposals form a packed token
+TREE per slot — flat tokens [T] + parent pointers [T] (node 0 = the
+pending token/root, parents[0] = -1, proposal nodes point at a
+lower-indexed parent, padding nodes carry parent -2). tree_meta derives
+node depths and the ancestor-or-self visibility matrix on device; the
+forward (llama.batch_score_tree_impl) scores every node under that
+tree-causal mask in ONE q_start>0 program, acceptance walks the tree
+level by level picking the deepest root-to-leaf path that matches
+(greedy) or survives sequential multi-draft rejection sampling
+(sampled), and only the accepted path's KV rows are committed
+(llama.commit_tree_path) — sibling rows never touch the region, so
+rollback stays pointer truncation. One packed [B, 2*d_max+4] i32 array
+returns to the host (tokens, path node indices, n_out, bitcast keys):
+ONE fetch where the linear path takes three.
+
+Tree PRNG contract (distinct from the linear chain's, but internally
+lane-for-lane across variants): new_key, sub = split(key);
+subs = split(sub, T); candidate node j consumes uniform(subs[j])
+unconditionally; the bonus resample consumes categorical(subs[0]) (node
+0 is the root — never a candidate, so the lane is free). The penalized
+walk replays the identical stream, so a zero-count/identity-penalty
+slot draws bit-identically on either variant.
 """
 from __future__ import annotations
 
@@ -270,3 +293,296 @@ def spec_verify(
         )(logits, tokens, keys, temps, top_ks, top_ps,
           counts, freqs, press, reps)
     return ctx_kv, out, n_out, new_keys
+
+
+# ---------------------------------------------------------------------------
+# Tree speculation
+
+
+def tree_meta(
+    parents: jnp.ndarray,  # [T] i32 — -1 root, -2 padding, else < index
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Derive (depth [T] i32, anc [T, T] bool, valid [T] bool) from
+    parent pointers by a T-1-step simultaneous pointer walk — runs
+    inside the verify program so the host ships only the two flat
+    arrays. depth is -1 for padding nodes (their anc row is empty, so
+    they fall out of attention entirely); anc[i, j] is ancestor-OR-SELF,
+    which IS the tree-causal in-chunk visibility matrix."""
+    T = parents.shape[0]
+    idx = jnp.arange(T, dtype=jnp.int32)
+    valid = parents >= -1
+    anc0 = (idx[:, None] == idx[None, :]) & valid[:, None]
+    depth0 = jnp.where(valid, 0, -1).astype(jnp.int32)
+
+    def body(_, carry):
+        anc, depth, cur = carry
+        # cur[i] = i's current ancestor pointer; negative = walk ended
+        anc = anc | (cur[:, None] == idx[None, :])
+        depth = depth + (cur >= 0).astype(jnp.int32)
+        cur = jnp.where(cur >= 0, parents[jnp.maximum(cur, 0)], cur)
+        return anc, depth, cur
+
+    anc, depth, _ = jax.lax.fori_loop(
+        0, T - 1, body, (anc0, depth0, parents)
+    )
+    return depth, anc, valid
+
+
+def _accept_tree_walk(
+    logits: jnp.ndarray,   # [T, V] f32 — row t scores the token AFTER node t
+    toks: jnp.ndarray,     # [T] i32 node tokens (node 0 = pending)
+    parents: jnp.ndarray,  # [T] i32
+    valid: jnp.ndarray,    # [T] bool
+    key: jnp.ndarray,      # [2] uint32
+    temp: jnp.ndarray,
+    top_k: jnp.ndarray,
+    top_p: jnp.ndarray,
+    pen,                   # None, or (counts [V] i32, freq, pres, rep)
+    *,
+    max_top_k: int,
+    d_max: int,
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Per-slot tree acceptance (vmapped by spec_verify_tree). Walks the
+    tree from the root: at each level, the children of the current node
+    are tried in index order — greedy accepts the first child matching
+    the row's argmax; sampled runs sequential multi-draft rejection
+    (child with token d accepts iff u_d < p(d) / (1 - mass of siblings
+    already rejected at this node), duplicate-token siblings see p=0),
+    which keeps every emitted token an exact sample from the target
+    distribution. The walk stops at the first level with no accepted
+    child; the bonus token resamples that node's residual (rejected
+    sibling tokens masked out) — or, greedy, takes its argmax.
+
+    Returns (out [d_max+1] emitted tokens, path [d_max+1] node indices
+    with path[0] = 0, n_out scalar, new_key [2])."""
+    T = logits.shape[0]
+    idx = jnp.arange(T, dtype=jnp.int32)
+    temps = jnp.maximum(temp, 1e-6)
+    lanes = jnp.arange(max_top_k)
+    k_eff = jnp.where(top_k <= 0, max_top_k, top_k)
+    mask_k = lanes < jnp.minimum(k_eff, max_top_k)
+
+    base = jax.random.wrap_key_data(key, impl="threefry2x32")
+    new_key, sub = jax.random.split(base)
+    subs = jax.random.split(sub, T)
+    u = jax.vmap(jax.random.uniform)(subs)   # u[j] belongs to node j
+    bonus_key = subs[0]
+
+    if pen is None:
+        counts0 = jnp.zeros((0,), jnp.int32)  # placeholder carry
+    else:
+        counts0 = pen[0]
+
+    def penalize(row, counts):
+        if pen is None:
+            return row
+        _, freq, pres, rep = pen
+        seen = counts > 0
+        lr = row - freq * counts.astype(jnp.float32)
+        lr = lr - pres * seen.astype(jnp.float32)
+        p_adj = jnp.where(lr > 0, lr / rep, lr * rep)
+        return jnp.where(seen, p_adj, lr)
+
+    def row_dist(cur, counts):
+        """(greedy argmax, top-k lane ids, scaled vals, final mask, p)
+        of node cur's penalty-adjusted row — the masking order of
+        sample_step_impl, matching accept_tokens float for float."""
+        row = penalize(jnp.take(logits, cur, axis=0), counts)
+        greedy_t = jnp.argmax(row).astype(jnp.int32)
+        vals, idxs = jax.lax.top_k(row, max_top_k)
+        scaled = vals / temps
+        probs = jax.nn.softmax(jnp.where(mask_k, scaled, NEG_INF))
+        cum = jnp.cumsum(probs)
+        mask_p = (cum - probs) < top_p
+        final_mask = mask_k & mask_p
+        p = jax.nn.softmax(jnp.where(final_mask, scaled, NEG_INF))
+        return greedy_t, idxs, scaled, final_mask, p
+
+    def level(_, carry):
+        cur, done, n_acc, path, rej_lanes, counts = carry
+        greedy_t, idxs, scaled, final_mask, p = row_dist(cur, counts)
+        is_child = (parents == cur) & valid
+
+        # greedy: first (lowest-index) child carrying the row argmax
+        match_g = is_child & (toks == greedy_t)
+        j_g = jnp.min(jnp.where(match_g, idx, T))
+
+        # sampled: siblings in index order under one shared rejection
+        # budget; u[j] pre-drawn per node so the stream is walk-invariant
+        def sib(c, j):
+            acc_j, rl, rmass, lvl_done = c
+            lane_hit = (idxs == toks[j]) & final_mask & ~rl
+            p_eff = jnp.sum(jnp.where(lane_hit, p, 0.0))
+            ok = u[j] * jnp.maximum(1.0 - rmass, 1e-9) < p_eff
+            live_c = is_child[j] & ~lvl_done
+            acc_j = jnp.where(live_c & ok, j, acc_j)
+            rejected = live_c & ~ok
+            rl = rl | jnp.where(rejected, idxs == toks[j], False)
+            rmass = rmass + jnp.where(rejected, p_eff, 0.0)
+            return (acc_j, rl, rmass, lvl_done | (live_c & ok)), None
+
+        (j_s, rl, _, _), _ = jax.lax.scan(
+            sib,
+            (jnp.int32(T), jnp.zeros((max_top_k,), bool),
+             jnp.float32(0.0), jnp.bool_(False)),
+            idx,
+        )
+
+        j = jnp.where(temp <= 0.0, j_g, j_s).astype(jnp.int32)
+        found = (j < T) & ~done
+        cur_n = jnp.where(found, jnp.minimum(j, T - 1), cur)
+        n_acc_n = n_acc + found.astype(jnp.int32)
+        path = jnp.where(
+            (jnp.arange(d_max + 1) == n_acc_n) & found, cur_n, path
+        )
+        if pen is not None:
+            tok_j = jnp.take(toks, cur_n)
+            counts = counts.at[jnp.maximum(tok_j, 0)].add(
+                found.astype(jnp.int32)
+            )
+        # a level's rejection record matters only if the walk STOPS here
+        # (the bonus resamples this node's residual); descending resets
+        # it for the child's own sibling set
+        rej_lanes = jnp.where(
+            done, rej_lanes, jnp.where(found, False, rl)
+        )
+        return cur_n, done | ~found, n_acc_n, path, rej_lanes, counts
+
+    cur, _, n_acc, path, rej_lanes, counts = jax.lax.fori_loop(
+        0, d_max, level,
+        (jnp.int32(0), jnp.bool_(False), jnp.int32(0),
+         jnp.zeros((d_max + 1,), jnp.int32),
+         jnp.zeros((max_top_k,), bool), counts0),
+    )
+
+    # bonus from the stop node: argmax, or residual resample with the
+    # stop level's rejected sibling tokens masked (empty set when the
+    # walk ran the full depth — nothing was rejected at the leaf)
+    greedy_t, idxs, scaled, final_mask, _ = row_dist(cur, counts)
+    row_final = jnp.where(
+        rej_lanes, NEG_INF, jnp.where(final_mask, scaled, NEG_INF)
+    )
+    choice = jax.random.categorical(bonus_key, row_final)
+    bonus = jnp.where(
+        temp <= 0.0, greedy_t, idxs[choice].astype(jnp.int32)
+    )
+
+    # out[l] for l < n_acc is the token at path depth l+1 (path[0] is
+    # the PENDING token — emitted last round); out[n_acc] is the bonus
+    nxt = jnp.concatenate([path[1:], jnp.zeros((1,), jnp.int32)])
+    path_toks = jnp.take(toks, jnp.clip(nxt, 0, T - 1))
+    out_idx = jnp.arange(d_max + 1)
+    out = jnp.where(
+        out_idx < n_acc, path_toks,
+        jnp.where(out_idx == n_acc, bonus, 0),
+    ).astype(jnp.int32)
+    return out, path, n_acc + 1, jax.random.key_data(new_key)
+
+
+def accept_tree(logits, toks, parents, valid, key, temp, top_k, top_p,
+                *, max_top_k, d_max):
+    """No-penalty tree acceptance — see _accept_tree_walk."""
+    return _accept_tree_walk(
+        logits, toks, parents, valid, key, temp, top_k, top_p, None,
+        max_top_k=max_top_k, d_max=d_max,
+    )
+
+
+def accept_tree_penalized(logits, toks, parents, valid, key, temp, top_k,
+                          top_p, counts, freq, pres, rep,
+                          *, max_top_k, d_max):
+    """Penalty-aware tree acceptance: the counts histogram advances as
+    the walk descends (each accepted path token penalizes every deeper
+    row), mirroring the fused round's per-token advance. Consumes the
+    identical PRNG stream as accept_tree — zero-count/identity-penalty
+    slots draw bit-identically."""
+    return _accept_tree_walk(
+        logits, toks, parents, valid, key, temp, top_k, top_p,
+        (counts, freq, pres, rep), max_top_k=max_top_k, d_max=d_max,
+    )
+
+
+@functools.partial(jax.jit, static_argnums=(0, 13, 14, 15),
+                   donate_argnums=(2,))
+def spec_verify_tree(
+    config,                 # ModelConfig (static)
+    params,
+    ctx_kv,
+    tokens: jnp.ndarray,    # [B, T] i32 — col 0 pending, rest tree nodes
+    draft: jnp.ndarray,     # [B, T-1] i32 device comb-draft spliced into
+                            # cols 1: in-program (llama.batch_draft m>1
+                            # output, level-major), or None (host tree)
+    parents: jnp.ndarray,   # [B, T] i32 — -1 root, -2 padding
+    slots: jnp.ndarray,     # [B] i32 (dummies -> scratch lane B)
+    q_starts: jnp.ndarray,  # [B] i32 — region KV length per slot
+    seq_lens: jnp.ndarray,  # [B] i32 — q_start + T live, 0 dummy
+    keys: jnp.ndarray,      # [B, 2] uint32 per-slot PRNG keys
+    temps: jnp.ndarray,     # [B] f32
+    top_ks: jnp.ndarray,    # [B] i32
+    top_ps: jnp.ndarray,    # [B] f32
+    max_top_k: int,         # static
+    ctx_span: int,          # static — full region window (q_starts > 0)
+    d_max: int,             # static — deepest root-to-leaf path length
+    penalties=None,         # None, or (counts [B,V] i32, freq/pres/rep [B])
+):
+    """Tree score + accept + path-commit in one program, ONE fetch.
+
+    Builds depth/ancestor metadata from the parent pointers on device,
+    scores every tree node under the tree-causal mask
+    (llama.batch_score_tree_impl — no optimistic write), walks
+    acceptance per slot, then commits exactly the accepted path's KV
+    rows (llama.commit_tree_path), so the host-side rollback contract is
+    unchanged: region length advances to q_start + n_out, nothing else
+    moved.
+
+    Returns (ctx_kv, packed [B, 2*d_max + 4] i32):
+
+      cols [0, d_max]                 emitted tokens (n_out valid)
+      cols [d_max+1, 2*d_max]         accepted node index at depth 1..
+                                      (the draft-spine rollback probe)
+      col  2*d_max+1                  n_out
+      cols [2*d_max+2, 2*d_max+3]     advanced PRNG key, bitcast i32
+
+    versus the linear path's three fetched arrays — the whole round
+    result rides one host transfer."""
+    if draft is not None:
+        tokens = jax.lax.dynamic_update_slice(tokens, draft, (0, 1))
+    depths, ancs, valids = jax.vmap(tree_meta)(parents)
+    ks, vs, logits = llama.batch_score_tree_impl(
+        config, params, ctx_kv, tokens, slots, q_starts, seq_lens,
+        depths, ancs, ctx_span,
+    )
+    if penalties is None:
+        out, path, n_out, new_keys = jax.vmap(
+            functools.partial(accept_tree, max_top_k=max_top_k,
+                              d_max=d_max)
+        )(logits, tokens, parents, valids, keys, temps, top_ks, top_ps)
+    else:
+        counts, freqs, press, reps = penalties
+        out, path, n_out, new_keys = jax.vmap(
+            functools.partial(accept_tree_penalized, max_top_k=max_top_k,
+                              d_max=d_max)
+        )(logits, tokens, parents, valids, keys, temps, top_ks, top_ps,
+          counts, freqs, press, reps)
+
+    live = seq_lens > 0
+    n_out = jnp.where(live, n_out, 0)
+    T = tokens.shape[1]
+    # full-T path row for the commit gather: positions past n_out are
+    # dead rows (clamped gather, masked by the committed length)
+    path_full = jnp.zeros((tokens.shape[0], T), jnp.int32)
+    path_full = jax.lax.dynamic_update_slice(path_full, path, (0, 0))
+    commit_lens = jnp.where(live, q_starts + n_out, 0)
+    ctx_kv = llama.commit_tree_path(
+        ctx_kv, ks, vs, path_full, slots, q_starts, commit_lens
+    )
+    packed = jnp.concatenate(
+        [
+            out,                                            # [B, d_max+1]
+            path[:, 1:],                                    # [B, d_max]
+            n_out[:, None],                                 # [B, 1]
+            jax.lax.bitcast_convert_type(new_keys, jnp.int32),  # [B, 2]
+        ],
+        axis=1,
+    )
+    return ctx_kv, packed

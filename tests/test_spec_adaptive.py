@@ -108,6 +108,81 @@ def test_adaptive_k_ewma_recovers_from_one_bad_step():
     assert not c.should_despec(0)          # EWMA keeps rate ~0.75
 
 
+def test_adaptive_branch_starts_wide_narrows_on_high_acceptance():
+    """Tree axis: a fresh stream hedges WIDE (m = m_max); sustained
+    acceptance walks it deep-and-narrow — K up, branches down to 1."""
+    c = make_controller(k_max=8, k_min=1, m_max=4)
+    assert c.m_for(0) == 4 and c.m_for(7) == 4   # every slot starts wide
+    for _ in range(20):
+        c.observe(0, accepted=c.k_for(0), k_used=c.k_for(0))
+    assert c.k_for(0) == 8
+    assert c.m_for(0) == 1
+    assert c.branch_shrink_total >= 3            # 4 -> 3 -> 2 -> 1
+
+
+def test_adaptive_branch_widens_back_on_low_acceptance():
+    """Early rejection is exactly what sibling branches catch: low
+    acceptance walks the shape shallow-and-wide — K down, branches up,
+    bounded by m_max."""
+    c = make_controller(k_max=8, k_min=1, m_max=4)
+    for _ in range(20):
+        c.observe(0, accepted=c.k_for(0), k_used=c.k_for(0))
+    assert c.m_for(0) == 1
+    for _ in range(30):
+        c.observe(0, accepted=0, k_used=c.k_for(0))
+    assert c.m_for(0) == 4
+    assert c.k_for(0) == 1
+    assert c.branch_grow_total >= 3
+
+
+def test_adaptive_branch_hysteresis_and_tree_off_pins_m_one():
+    """Mid-band rates hold the branch fan where it is; a linear-chain
+    controller (m_max=1, tree off) never moves off m=1."""
+    c = make_controller(k_max=8, m_max=4)
+    for _ in range(16):
+        c.observe(0, accepted=5, k_used=8)       # 0.625: inside the band
+    assert c.m_for(0) == 4
+    assert c.branch_grow_total == 0 and c.branch_shrink_total == 0
+    lin = make_controller(k_max=8)               # m_max defaults to 1
+    for _ in range(16):
+        lin.observe(0, accepted=0, k_used=8)
+    assert lin.m_for(0) == 1
+    assert lin.branch_grow_total == 0
+
+
+def test_adaptive_branch_despec_on_collapse_and_release_resets():
+    """Hedging wider must not save a dead stream: a slot already at
+    m_max with collapsed acceptance still de-speculates, and release()
+    hands the lane back with the full wide shape."""
+    c = make_controller(k_max=4, m_max=4, min_obs=8)
+    for _ in range(12):
+        c.observe(0, accepted=0, k_used=4)
+    assert c.m_for(0) == 4                       # saturated wide...
+    assert c.should_despec(0)                    # ...and still despecs
+    assert c.k_for(0) < 4
+    c.release(0)
+    assert c.k_for(0) == 4 and c.m_for(0) == 4
+    assert not c.should_despec(0)
+
+
+def test_round_m_buckets_to_pow2_clamped_at_branches():
+    cfg = ModelConfig.tiny(dtype="float32")
+    dec = SpecDecoder(
+        cfg, EngineConfig(speculative="ngram", num_speculative_tokens=4,
+                          spec_tree=True, spec_branches=4),
+    )
+    assert dec.round_m([1]) == 1
+    assert dec.round_m([2, 1]) == 2
+    assert dec.round_m([3]) == 4       # pow2 bucket
+    assert dec.round_m([4, 2]) == 4    # clamped at --spec-branches
+    # tree off: the branch axis is pinned at 1 whatever the slots say
+    lin = SpecDecoder(
+        cfg, EngineConfig(speculative="ngram", num_speculative_tokens=4),
+    )
+    assert lin.round_m([1]) == 1
+    assert lin.m_for(0) == 1
+
+
 def test_round_k_buckets_to_pow2_clamped_at_cli_k():
     cfg = ModelConfig.tiny(dtype="float32")
     dec = SpecDecoder(

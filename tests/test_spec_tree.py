@@ -1,0 +1,300 @@
+"""Tree speculative decoding (dynamo_tpu/spec/ --spec-tree).
+
+The keystone stays differential: greedy tree speculation — n-gram trie
+and comb draft proposers, across (K, branches) shapes — must produce
+token-for-token identical output to both the linear-chain speculative
+engine and the non-speculative baseline, and must leave the prefix-cache
+block-hash registry identical after sibling-row rollbacks (the verify
+scores sibling nodes that alias the SAME ctx positions; only the
+accepted path's KV rows are ever committed).
+
+On top of that: the packed-tree metadata walk (tree_meta), the trie /
+comb proposers, the penalized acceptance walk's PRNG-stream
+compatibility with the unpenalized walk, and the acceptance gate's
+despec -> fused-round -> re-arm cycle under a synthetic low-acceptance
+stream.
+"""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from dynamo_tpu.models import llama
+from dynamo_tpu.models.config import ModelConfig
+from dynamo_tpu.protocols.common import SamplingOptions
+from dynamo_tpu.spec.proposer import NGramProposer, comb_parents
+from dynamo_tpu.spec.verifier import (
+    accept_tree,
+    accept_tree_penalized,
+    tree_meta,
+)
+from tests.test_spec import _prompts, make_engine, run_engine
+
+
+# ---------------------------------------------------------------------------
+# tree_meta (the on-device pointer walk)
+
+def test_tree_meta_depth_ancestors_padding():
+    #        0(root)  1<-0  2<-0  3<-1  4=pad
+    parents = jnp.asarray([-1, 0, 0, 1, -2], jnp.int32)
+    depth, anc, valid = tree_meta(parents)
+    assert np.asarray(depth).tolist() == [0, 1, 1, 2, -1]
+    assert np.asarray(valid).tolist() == [True, True, True, True, False]
+    anc = np.asarray(anc)
+    # ancestor-or-self rows ARE the in-chunk visibility mask
+    assert anc[0].tolist() == [True, False, False, False, False]
+    assert anc[3].tolist() == [True, True, False, True, False]
+    assert anc[2].tolist() == [True, False, True, False, False]
+    # padding row is fully masked — the scorer emits zeros for it
+    assert anc[4].tolist() == [False] * 5
+
+
+def test_tree_meta_linear_chain_reduces_to_causal():
+    parents = jnp.asarray([-1, 0, 1, 2], jnp.int32)
+    depth, anc, valid = tree_meta(parents)
+    assert np.asarray(depth).tolist() == [0, 1, 2, 3]
+    # lower-triangular == plain causal: the linear chain is the
+    # degenerate tree
+    assert np.array_equal(np.asarray(anc), np.tri(4, dtype=bool))
+
+
+# ---------------------------------------------------------------------------
+# proposers
+
+def test_comb_parents_shape():
+    # depth 2, fan 3: root, 3 children of root, 3 children of the
+    # level-0 spine (node 1)
+    assert comb_parents(2, 3) == [-1, 0, 0, 0, 1, 1, 1]
+    # m=1 degenerates to the linear chain
+    assert comb_parents(3, 1) == [-1, 0, 1, 2]
+
+
+def test_ngram_propose_tree_merges_shared_prefixes():
+    p = NGramProposer(k=4, max_n=2, min_n=1)
+    # tail [1, 2] continues with [4, ...] (recent) and [3, ...] (older)
+    history = [1, 2, 3, 9, 1, 2, 4, 9, 1, 2]
+    toks, pars = p.propose_tree(history, depth=2, branches=2, budget=16)
+    assert len(toks) == len(pars) <= 15
+    # both continuations fork at the root (parent 0 = pending token)
+    assert pars.count(0) == 2
+    first_level = [t for t, par in zip(toks, pars) if par == 0]
+    assert set(first_level) == {3, 4}
+    # parents always point at earlier nodes (packable as-is)
+    for i, par in enumerate(pars):
+        assert 0 <= par <= i
+
+
+def test_ngram_propose_tree_budget_cap_and_fallback():
+    p = NGramProposer(k=4, max_n=3, min_n=1)
+    history = [1, 2, 3, 9, 1, 2, 4, 9, 1, 2]
+    toks, pars = p.propose_tree(history, depth=4, branches=4, budget=4)
+    assert len(toks) <= 3  # budget - 1: the root takes a slot
+    # no match at all -> the linear path's zero chain
+    toks, pars = p.propose_tree([1, 2, 3, 4], depth=3, branches=2,
+                                budget=8)
+    assert toks == [0, 0, 0]
+    assert pars == [0, 1, 2]
+
+
+# ---------------------------------------------------------------------------
+# penalized acceptance: PRNG-stream compatibility
+
+def test_penalized_walk_matches_unpenalized_at_zero_penalties():
+    """accept_tree_penalized with a zero histogram and identity
+    penalties must draw the SAME PRNG stream and produce bit-identical
+    (tokens, path, count, key) as accept_tree — the contract that lets
+    the engine mix penalized and plain rows in one verify program."""
+    rng = np.random.RandomState(11)
+    V, T, D = 32, 7, 3
+    parents = jnp.asarray([-1, 0, 0, 1, 1, 3, -2], jnp.int32)
+    _, _, valid = tree_meta(parents)
+    toks = jnp.asarray(rng.randint(1, V, T), jnp.int32)
+    logits = jnp.asarray(rng.randn(T, V).astype(np.float32) * 3)
+    for temp, tk, tp in ((0.0, 0, 1.0), (0.9, 8, 0.95), (1.3, 0, 1.0)):
+        key = jnp.asarray([5, 17], jnp.uint32)
+        a = accept_tree(
+            logits, toks, parents, valid, key, jnp.float32(temp),
+            jnp.int32(tk), jnp.float32(tp), max_top_k=8, d_max=D,
+        )
+        b = accept_tree_penalized(
+            logits, toks, parents, valid, key, jnp.float32(temp),
+            jnp.int32(tk), jnp.float32(tp),
+            jnp.zeros(V, jnp.int32), jnp.float32(0.0), jnp.float32(0.0),
+            jnp.float32(1.0), max_top_k=8, d_max=D,
+        )
+        for x, y in zip(a, b):
+            assert np.array_equal(np.asarray(x), np.asarray(y)), (
+                f"penalized walk diverged at temp={temp}"
+            )
+
+
+# ---------------------------------------------------------------------------
+# engine differentials
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = ModelConfig.tiny(dtype="float32")
+    return cfg, llama.init_params(cfg, 0)
+
+
+# non-speculative reference runs, computed once per (max_tokens) and
+# reused across the differential tests below (each engine run costs
+# ~10s of JIT on CPU; the 120s per-test budget can't fit a full sweep)
+_REF: dict = {}
+
+
+async def _baseline(setup, max_tokens=24):
+    if max_tokens not in _REF:
+        _REF[max_tokens] = await run_engine(
+            setup, _prompts(), max_tokens=max_tokens
+        )
+    return _REF[max_tokens]
+
+
+@pytest.mark.parametrize("k", (2, 4, 8))
+async def test_tree_greedy_differential_ngram(setup, k):
+    """THE pin: greedy tree speculation is token-identical to the
+    linear-chain speculative engine AND the plain baseline across
+    K x branches shapes, and the prefix-cache hash registry matches a
+    clean run despite sibling-row rollbacks."""
+    prompts = _prompts()
+    ref, _, ref_hashes = await _baseline(setup)
+    lin, _, lin_hashes = await run_engine(
+        setup, prompts, speculative="ngram",
+        num_speculative_tokens=k,
+    )
+    assert lin_hashes == ref_hashes
+    for b in (2, 4):
+        tree, st, hashes = await run_engine(
+            setup, prompts, speculative="ngram",
+            num_speculative_tokens=k, spec_tree=True,
+            spec_branches=b,
+        )
+        for (rt, _), (lt, _), (tt, _) in zip(ref, lin, tree):
+            assert rt == tt, f"K={k} B={b}: tree != baseline"
+            assert lt == tt, f"K={k} B={b}: tree != linear"
+        assert st["spec_tree_verify_steps"] > 0
+        # KV-hash consistency after sibling-row rollback: only the
+        # accepted path was committed, blocks sealed under the same
+        # chained hashes as a clean run
+        assert hashes == ref_hashes, f"K={k} B={b}"
+
+
+async def test_tree_greedy_differential_comb_draft(setup):
+    """Comb drafts (batch_draft branch mode) stay token-identical with
+    draft == target, and acceptance is near-total — the multi-branch
+    draft program feeds the verify without a host round trip."""
+    prompts = _prompts()
+    ref, _, ref_hashes = await _baseline(setup)
+    tree, st, hashes = await run_engine(
+        setup, prompts, draft=True, speculative="draft",
+        num_speculative_tokens=4, spec_tree=True, spec_branches=2,
+    )
+    for (rt, _), (tt, _) in zip(ref, tree):
+        assert rt == tt, "comb-draft tree diverged from baseline"
+    assert st["spec_acceptance_rate"] > 0.9
+    assert st["spec_tree_verify_steps"] > 0
+    assert hashes == ref_hashes
+
+
+async def test_tree_seeded_temperature_reproducible(setup):
+    so = SamplingOptions(temperature=0.8, top_k=8, seed=7)
+    prompts = _prompts()
+    runs = []
+    for _ in range(2):
+        res, _, _ = await run_engine(
+            setup, prompts, so=so, speculative="ngram",
+            num_speculative_tokens=4, spec_tree=True, spec_branches=2,
+        )
+        runs.append([t for t, _ in res])
+    assert runs[0] == runs[1]
+
+
+async def test_tree_penalized_greedy_differential(setup):
+    """Penalized greedy requests ride the penalized tree walk (counts
+    advancing down the accepted path) and still match the fused
+    baseline token-for-token."""
+    so = SamplingOptions(frequency_penalty=0.6, presence_penalty=0.3,
+                        repetition_penalty=1.2)
+    prompts = _prompts()
+    ref, _, _ = await run_engine(setup, prompts, so=so)
+    tree, st, _ = await run_engine(
+        setup, prompts, so=so, speculative="ngram",
+        num_speculative_tokens=4, spec_tree=True, spec_branches=2,
+    )
+    for (rt, _), (tt, _) in zip(ref, tree):
+        assert rt == tt, "penalized tree diverged"
+    assert st["spec_tree_verify_steps"] > 0
+
+
+async def test_gate_despec_and_rearm_cycle(setup):
+    """Synthetic low-acceptance stream (random prompts reject n-gram
+    drafts): the acceptance gate must hand streams back to the fused
+    round, re-arm them after the re-arm budget, and the whole gated
+    run stays token-identical to the plain baseline."""
+    prompts = _prompts()
+    ref, _, ref_hashes = await _baseline(setup, max_tokens=48)
+    gated, st, hashes = await run_engine(
+        setup, prompts, max_tokens=48, speculative="ngram",
+        num_speculative_tokens=4, spec_tree=True, spec_branches=2,
+        spec_adaptive=False,
+        spec_gate_acceptance=0.5, spec_gate_window=2,
+        spec_rearm_tokens=4,
+    )
+    for (rt, _), (gt, _) in zip(ref, gated):
+        assert rt == gt, "gated run diverged from baseline"
+    assert st["spec_gated_despec_total"] >= 1
+    assert st["spec_rearm_total"] >= 1
+    assert hashes == ref_hashes
+
+
+async def test_gate_without_rearm_stays_despeculated(setup):
+    """spec_rearm_tokens=0 makes the gate permanent: streams gate once
+    and finish on the fused round, never re-arming."""
+    prompts = _prompts()
+    gated, st, _ = await run_engine(
+        setup, prompts, max_tokens=32, speculative="ngram",
+        num_speculative_tokens=4, spec_tree=True, spec_branches=2,
+        spec_adaptive=False,
+        spec_gate_acceptance=0.9, spec_gate_window=1,
+        spec_rearm_tokens=0,
+    )
+    assert st["spec_gated_despec_total"] >= 1
+    assert st["spec_rearm_total"] == 0
+
+
+async def test_tree_metrics_surface(setup):
+    """Tree counters reach SpecDecoder.stats(), the engine WorkerStats
+    distribution fields, and the SPEC scrape registry."""
+    from dynamo_tpu.spec.metrics import SPEC
+
+    prompts = _prompts()
+    eng = make_engine(
+        setup, speculative="ngram", num_speculative_tokens=4,
+        spec_tree=True, spec_branches=2,
+    )
+    eng.start()
+    try:
+        from tests.test_spec import drive
+
+        nodes0 = SPEC.get("dynamo_spec_tree_nodes_total")
+        await drive(eng, prompts, max_tokens=16)
+        st = eng.spec.stats()
+        assert st["spec_tree"] is True
+        assert st["spec_tree_nodes_total"] > 0
+        assert st["spec_tree_mean_path_len"] >= 0.0
+        assert len(st["spec_branch_accept_hist"]) == 2
+        m = eng.metrics()
+        ws = m.worker_stats
+        assert ws.spec_tree_nodes_total == st["spec_tree_nodes_total"]
+        assert ws.spec_effective_k_p95 >= ws.spec_effective_k_p50 >= 0.0
+        # the scrape registry advanced and renders all four families
+        assert SPEC.get("dynamo_spec_tree_nodes_total") > nodes0
+        text = SPEC.render()
+        for fam in ("dynamo_spec_tree_nodes_total",
+                    "dynamo_spec_tree_accepted_path_len_total",
+                    "dynamo_spec_tree_gated_despecs_total",
+                    "dynamo_spec_accept_rate"):
+            assert fam in text
+    finally:
+        await eng.stop()

@@ -13,6 +13,13 @@ dispatch breakdown (rounds, patches, draft programs, verify programs).
 Batched drafting must show O(1) draft dispatches per round regardless of
 the speculating slot count; the per-slot path shows the O(slots*K) cost
 it replaced. Run: python tools/profile_round.py --spec all
+
+Tree modes: ``--spec tree`` (n-gram trie) / ``--spec tree-draft`` (comb
+batch_draft) add accepted-per-emitted, mean accepted path length, and
+the per-branch acceptance histogram; ``--spec tree-vs-linear`` runs
+off / linear ngram / tree at the same workload and prints a comparison
+line — the tree must hold the linear path's dispatch budget (and one
+FEWER fetch per verify: the packed result).
 """
 from __future__ import annotations
 
@@ -147,11 +154,15 @@ def _spec_dispatch_mode(modes: list[str], n_req: int, osl: int) -> int:
     prompts = [pat * 6 for _ in range(n_req)]
 
     async def run_mode(mode: str) -> dict:
-        speculative, batch_draft = {
-            "off": ("off", True),
-            "ngram": ("ngram", True),
-            "draft": ("draft", True),
-            "draft-perslot": ("draft", False),
+        speculative, batch_draft, tree = {
+            "off": ("off", True, False),
+            "ngram": ("ngram", True, False),
+            "draft": ("draft", True, False),
+            "draft-perslot": ("draft", False, False),
+            # tree speculation: multi-branch trie drafts, tree-masked
+            # verify, ONE packed fetch per verify round
+            "tree": ("ngram", True, True),
+            "tree-draft": ("draft", True, True),
         }[mode]
         ekw = {}
         if speculative == "draft":
@@ -163,6 +174,7 @@ def _spec_dispatch_mode(modes: list[str], n_req: int, osl: int) -> int:
                 max_decode_slots=max(n_req, 2), prefill_buckets=(64,),
                 cache_dtype="float32", speculative=speculative,
                 num_speculative_tokens=4, spec_batch_draft=batch_draft,
+                spec_tree=tree, spec_branches=4,
             ),
             mesh_config=MeshConfig(tp=1), **ekw,
         )
@@ -197,7 +209,7 @@ def _spec_dispatch_mode(modes: list[str], n_req: int, osl: int) -> int:
         draft_d = st.get("spec_draft_dispatch_total", 0)
         verify_d = st.get("spec_verify_dispatch_total", 0)
         total = sum(counts.values()) + draft_d + verify_d
-        return {
+        out = {
             "mode": mode,
             "slots": n_req,
             "tokens": tokens,
@@ -213,8 +225,45 @@ def _spec_dispatch_mode(modes: list[str], n_req: int, osl: int) -> int:
             "spec_acceptance_rate": round(
                 st.get("spec_acceptance_rate", 0.0), 4
             ),
+            # accepted draft tokens per emitted token: the speculation
+            # payoff — 0 when off, -> 1 as every emission comes from an
+            # accepted draft (the bonus token keeps it < 1)
+            "accepted_per_emitted": round(
+                st.get("spec_accepted_total", 0) / max(tokens, 1), 4
+            ),
         }
+        if st.get("spec_tree"):
+            out["tree_nodes_per_verify"] = round(
+                st["spec_tree_nodes_total"]
+                / max(st["spec_tree_verify_steps"], 1), 3
+            )
+            out["tree_mean_path_len"] = round(
+                st["spec_tree_mean_path_len"], 4
+            )
+            # accepted nodes by branch ordinal (0 = spine / best
+            # candidate) — how much the sibling hedging actually buys
+            out["branch_accept_hist"] = st["spec_branch_accept_hist"]
+            out["gated_despecs"] = st["spec_gated_despec_total"]
+        return out
 
+    if "tree-vs-linear" in modes:
+        # A/B at the same workload: linear chain vs tree at equal depth,
+        # plus off as the floor — one JSON line each, then a comparison
+        results = {}
+        for mode in ("off", "ngram", "tree"):
+            results[mode] = asyncio.run(run_mode(mode))
+            print(json.dumps(results[mode]))
+        lin, tr = results["ngram"], results["tree"]
+        print(json.dumps({
+            "mode": "tree-vs-linear",
+            "linear_dispatches_per_token": lin["dispatches_per_token"],
+            "tree_dispatches_per_token": tr["dispatches_per_token"],
+            "linear_accepted_per_emitted": lin["accepted_per_emitted"],
+            "tree_accepted_per_emitted": tr["accepted_per_emitted"],
+            "tree_mean_path_len": tr.get("tree_mean_path_len", 0.0),
+            "branch_accept_hist": tr.get("branch_accept_hist", []),
+        }))
+        return 0
     for mode in modes:
         print(json.dumps(asyncio.run(run_mode(mode))))
     return 0
@@ -423,7 +472,8 @@ if __name__ == "__main__":
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument(
         "--spec", default=None, nargs="?", const="all",
-        choices=["off", "ngram", "draft", "draft-perslot", "all"],
+        choices=["off", "ngram", "draft", "draft-perslot", "tree",
+                 "tree-draft", "tree-vs-linear", "all"],
         help="dispatch-count mode instead of kernel timing",
     )
     ap.add_argument(
@@ -456,7 +506,8 @@ if __name__ == "__main__":
             )
         )
     if args.spec:
-        modes = (["off", "ngram", "draft", "draft-perslot"]
+        modes = (["off", "ngram", "draft", "draft-perslot", "tree",
+                  "tree-draft"]
                  if args.spec == "all" else [args.spec])
         raise SystemExit(_spec_dispatch_mode(modes, args.requests, args.osl))
     main()
